@@ -1,0 +1,79 @@
+"""Convolution and normalization primitives (NHWC / HWIO).
+
+Semantics match the reference's torch modules exactly:
+- ``conv2d``: symmetric explicit padding like ``nn.Conv2d(padding=p)``;
+- ``frozen_batch_norm``: ``nn.BatchNorm2d`` in eval mode — the reference always
+  freezes BN (``train_stereo.py:151,193``; ``core/raft_stereo.py:41-44``), so BN
+  is a pure affine transform of stored running statistics;
+- ``instance_norm``: ``nn.InstanceNorm2d`` defaults — no affine, no running
+  stats, biased variance, eps 1e-5 (``core/extractor.py:29-32,135``);
+- ``group_norm``: ``nn.GroupNorm`` (``core/extractor.py:17-20,129``).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Padding = Union[int, Tuple[int, int]]
+
+
+def _pad_pair(padding: Padding) -> Tuple[Tuple[int, int], Tuple[int, int]]:
+    if isinstance(padding, int):
+        return ((padding, padding), (padding, padding))
+    ph, pw = padding
+    return ((ph, ph), (pw, pw))
+
+
+def conv2d(x: jax.Array, w: jax.Array, b: jax.Array | None = None, *,
+           stride: Union[int, Tuple[int, int]] = 1,
+           padding: Padding = 0) -> jax.Array:
+    """2D convolution, NHWC input, HWIO kernel, torch-style symmetric padding.
+
+    The conv runs in the dtype of ``x`` (bf16 under the mixed-precision policy)
+    with fp32 accumulation on the MXU via ``preferred_element_type``.
+    """
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    out = lax.conv_general_dilated(
+        x, w.astype(x.dtype), window_strides=stride, padding=_pad_pair(padding),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32)
+    if b is not None:
+        out = out + b.astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+def frozen_batch_norm(x: jax.Array, params: dict, *, eps: float = 1e-5) -> jax.Array:
+    """BatchNorm2d in (permanently) eval mode: affine over stored running stats.
+
+    params: {"scale", "bias", "mean", "var"} each shaped (C,).
+    """
+    # Fold stats into a single scale/shift (fp32), then apply in compute dtype.
+    inv = params["scale"] * lax.rsqrt(params["var"] + eps)
+    shift = params["bias"] - params["mean"] * inv
+    return (x * inv.astype(x.dtype) + shift.astype(x.dtype)).astype(x.dtype)
+
+
+def instance_norm(x: jax.Array, *, eps: float = 1e-5) -> jax.Array:
+    """InstanceNorm2d with torch defaults: per-(sample, channel) over H, W,
+    biased variance, no affine parameters."""
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=(1, 2), keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mean), axis=(1, 2), keepdims=True)
+    return ((x32 - mean) * lax.rsqrt(var + eps)).astype(x.dtype)
+
+
+def group_norm(x: jax.Array, params: dict, num_groups: int, *,
+               eps: float = 1e-5) -> jax.Array:
+    """GroupNorm over (H, W, C//G) per group, affine. params: {"scale","bias"}."""
+    b, h, w, c = x.shape
+    xg = x.astype(jnp.float32).reshape(b, h, w, num_groups, c // num_groups)
+    mean = jnp.mean(xg, axis=(1, 2, 4), keepdims=True)
+    var = jnp.mean(jnp.square(xg - mean), axis=(1, 2, 4), keepdims=True)
+    xg = (xg - mean) * lax.rsqrt(var + eps)
+    out = xg.reshape(b, h, w, c) * params["scale"] + params["bias"]
+    return out.astype(x.dtype)

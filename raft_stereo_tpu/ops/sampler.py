@@ -1,0 +1,62 @@
+"""Bilinear sampling with grid_sample semantics (align_corners=True, zero pad).
+
+The reference samples the correlation volume through
+``bilinear_sampler`` (``core/utils/utils.py:59-73``), a pixel-coordinate wrapper
+over ``F.grid_sample(align_corners=True)`` that asserts the problem is 1D
+(H == 1). Out-of-range taps contribute zero (grid_sample ``padding_mode='zeros'``):
+a sample at x gets ``(1-frac)*v[floor(x)] + frac*v[floor(x)+1]`` with each tap
+zeroed when its index falls outside ``[0, W-1]``.
+
+Because every lookup in this problem is along a single row (epipolar line),
+both samplers here are 1D gather-lerps — no 2D grid_sample is ever needed
+(the reference's ``alt`` path calls 2D grid_sample with integer y, which
+reduces to the same row gather; ``core/corr.py:82``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _taps(x: jax.Array, width: int):
+    """Common tap/weight computation for zero-padded linear interpolation."""
+    x0 = jnp.floor(x)
+    frac = x - x0
+    i0 = x0.astype(jnp.int32)
+    i1 = i0 + 1
+    in0 = (i0 >= 0) & (i0 <= width - 1)
+    in1 = (i1 >= 0) & (i1 <= width - 1)
+    i0c = jnp.clip(i0, 0, width - 1)
+    i1c = jnp.clip(i1, 0, width - 1)
+    w0 = jnp.where(in0, 1.0 - frac, 0.0)
+    w1 = jnp.where(in1, frac, 0.0)
+    return i0c, i1c, w0, w1
+
+
+def sample_1d_zeros(values: jax.Array, x: jax.Array) -> jax.Array:
+    """Sample rows of scalars at fractional positions.
+
+    values: (..., W) — per-row 1D signals (e.g. a correlation-volume row).
+    x:      (..., K) — fractional sample positions, batch dims matching values.
+    Returns (..., K).
+    """
+    width = values.shape[-1]
+    i0, i1, w0, w1 = _taps(x, width)
+    v0 = jnp.take_along_axis(values, i0, axis=-1)
+    v1 = jnp.take_along_axis(values, i1, axis=-1)
+    return v0 * w0 + v1 * w1
+
+
+def sample_rows_zeros(fmap: jax.Array, x: jax.Array) -> jax.Array:
+    """Sample feature rows at fractional x positions (vector-valued signal).
+
+    fmap: (..., W, D) — per-row features (e.g. fmap2 rows).
+    x:    (..., K)    — fractional sample positions.
+    Returns (..., K, D).
+    """
+    width = fmap.shape[-2]
+    i0, i1, w0, w1 = _taps(x, width)
+    v0 = jnp.take_along_axis(fmap, i0[..., None], axis=-2)
+    v1 = jnp.take_along_axis(fmap, i1[..., None], axis=-2)
+    return v0 * w0[..., None] + v1 * w1[..., None]

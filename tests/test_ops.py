@@ -1,0 +1,187 @@
+"""Unit tests for the numerics kernel layer against torch (CPU) oracles.
+
+torch here is purely a *semantics oracle* for the conventions the reference
+relies on (symmetric conv padding, align_corners sampling/resize, pooling with
+count_include_pad, unfold ordering); no reference code is involved.
+"""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as tF
+
+import jax.numpy as jnp
+
+from raft_stereo_tpu import ops
+
+
+def t2j(x):
+    """NCHW torch tensor -> NHWC jnp array."""
+    return jnp.asarray(x.detach().numpy().transpose(0, 2, 3, 1))
+
+
+def j2t(x):
+    """NHWC jnp array -> NCHW torch tensor."""
+    return torch.from_numpy(np.asarray(x).transpose(0, 3, 1, 2))
+
+
+@pytest.mark.parametrize("stride,pad,k", [(1, 1, 3), (2, 1, 3), (2, 3, 7), (1, 0, 1)])
+def test_conv2d_matches_torch(rng, stride, pad, k):
+    x = rng.standard_normal((2, 10, 12, 5), dtype=np.float32)
+    w = rng.standard_normal((k, k, 5, 7), dtype=np.float32) * 0.1
+    b = rng.standard_normal((7,), dtype=np.float32)
+    out = ops.conv2d(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b),
+                     stride=stride, padding=pad)
+    ref = tF.conv2d(j2t(x), torch.from_numpy(w.transpose(3, 2, 0, 1)),
+                    torch.from_numpy(b), stride=stride, padding=pad)
+    np.testing.assert_allclose(np.asarray(out), t2j(ref), atol=2e-5)
+
+
+def test_instance_norm_matches_torch(rng):
+    x = rng.standard_normal((2, 8, 9, 6), dtype=np.float32) * 3 + 1
+    out = ops.instance_norm(jnp.asarray(x))
+    ref = torch.nn.InstanceNorm2d(6)(j2t(x))
+    np.testing.assert_allclose(np.asarray(out), t2j(ref), atol=1e-5)
+
+
+def test_frozen_batch_norm_matches_torch_eval(rng):
+    c = 6
+    x = rng.standard_normal((2, 8, 9, c), dtype=np.float32)
+    bn = torch.nn.BatchNorm2d(c)
+    with torch.no_grad():
+        bn.weight.copy_(torch.from_numpy(rng.standard_normal(c).astype(np.float32)))
+        bn.bias.copy_(torch.from_numpy(rng.standard_normal(c).astype(np.float32)))
+        bn.running_mean.copy_(torch.from_numpy(rng.standard_normal(c).astype(np.float32)))
+        bn.running_var.copy_(torch.from_numpy(np.abs(rng.standard_normal(c)).astype(np.float32) + 0.5))
+    bn.eval()
+    params = {"scale": jnp.asarray(bn.weight.detach().numpy()),
+              "bias": jnp.asarray(bn.bias.detach().numpy()),
+              "mean": jnp.asarray(bn.running_mean.numpy()),
+              "var": jnp.asarray(bn.running_var.numpy())}
+    out = ops.frozen_batch_norm(jnp.asarray(x), params)
+    ref = bn(j2t(x))
+    np.testing.assert_allclose(np.asarray(out), t2j(ref), atol=1e-5)
+
+
+def test_group_norm_matches_torch(rng):
+    c, g = 16, 2
+    x = rng.standard_normal((2, 6, 7, c), dtype=np.float32)
+    gn = torch.nn.GroupNorm(g, c)
+    with torch.no_grad():
+        gn.weight.copy_(torch.from_numpy(rng.standard_normal(c).astype(np.float32)))
+        gn.bias.copy_(torch.from_numpy(rng.standard_normal(c).astype(np.float32)))
+    params = {"scale": jnp.asarray(gn.weight.detach().numpy()),
+              "bias": jnp.asarray(gn.bias.detach().numpy())}
+    out = ops.group_norm(jnp.asarray(x), params, g)
+    np.testing.assert_allclose(np.asarray(out), t2j(gn(j2t(x))), atol=1e-5)
+
+
+@pytest.mark.parametrize("h,w", [(8, 9), (7, 12)])
+def test_pool2x_matches_torch(rng, h, w):
+    x = rng.standard_normal((2, h, w, 3), dtype=np.float32)
+    out = ops.pool2x(jnp.asarray(x))
+    ref = tF.avg_pool2d(j2t(x), 3, stride=2, padding=1)
+    np.testing.assert_allclose(np.asarray(out), t2j(ref), atol=1e-5)
+
+
+def test_pool4x_matches_torch(rng):
+    x = rng.standard_normal((1, 12, 16, 3), dtype=np.float32)
+    out = ops.pool4x(jnp.asarray(x))
+    ref = tF.avg_pool2d(j2t(x), 5, stride=4, padding=1)
+    np.testing.assert_allclose(np.asarray(out), t2j(ref), atol=1e-5)
+
+
+@pytest.mark.parametrize("w", [8, 9])
+def test_avg_pool_w2_matches_torch(rng, w):
+    x = rng.standard_normal((2, 5, w, 4), dtype=np.float32)
+    out = ops.avg_pool_w2(jnp.asarray(x))
+    ref = tF.avg_pool2d(j2t(x), (1, 2), stride=(1, 2))
+    np.testing.assert_allclose(np.asarray(out), t2j(ref), atol=1e-5)
+
+
+@pytest.mark.parametrize("size", [(12, 20), (5, 7), (8, 10)])
+def test_interp_align_corners_matches_torch(rng, size):
+    x = rng.standard_normal((2, 8, 10, 3), dtype=np.float32)
+    out = ops.interp_align_corners(jnp.asarray(x), size)
+    ref = tF.interpolate(j2t(x), size=size, mode="bilinear", align_corners=True)
+    np.testing.assert_allclose(np.asarray(out), t2j(ref), atol=1e-5)
+
+
+def test_sample_1d_zeros_matches_grid_sample(rng):
+    """1D lerp with zero padding == grid_sample(align_corners=True) on a 1-row image."""
+    n, w, k = 6, 16, 9
+    values = rng.standard_normal((n, w), dtype=np.float32)
+    # Positions straddling the borders to exercise zero-padding.
+    x = rng.uniform(-3, w + 2, size=(n, k)).astype(np.float32)
+    out = ops.sample_1d_zeros(jnp.asarray(values), jnp.asarray(x))
+    img = torch.from_numpy(values).view(n, 1, 1, w)
+    xg = 2 * torch.from_numpy(x) / (w - 1) - 1
+    grid = torch.stack([xg, torch.zeros_like(xg)], dim=-1).view(n, 1, k, 2)
+    ref = tF.grid_sample(img, grid, align_corners=True).view(n, k)
+    np.testing.assert_allclose(np.asarray(out), ref.numpy(), atol=1e-5)
+
+
+def test_sample_rows_zeros_matches_grid_sample(rng):
+    n, w, d, k = 4, 12, 5, 7
+    fmap = rng.standard_normal((n, w, d), dtype=np.float32)
+    x = rng.uniform(-2, w + 1, size=(n, k)).astype(np.float32)
+    out = ops.sample_rows_zeros(jnp.asarray(fmap), jnp.asarray(x))
+    img = torch.from_numpy(fmap.transpose(0, 2, 1)).view(n, d, 1, w)
+    xg = 2 * torch.from_numpy(x) / (w - 1) - 1
+    grid = torch.stack([xg, torch.zeros_like(xg)], dim=-1).view(n, 1, k, 2)
+    ref = tF.grid_sample(img, grid, align_corners=True).view(n, d, k)
+    np.testing.assert_allclose(np.asarray(out), ref.numpy().transpose(0, 2, 1), atol=1e-5)
+
+
+@pytest.mark.parametrize("factor", [4, 8])
+def test_convex_upsample_matches_torch_unfold(rng, factor):
+    """Oracle: softmax-mask convex combination built with torch.unfold directly."""
+    b, h, w, d = 2, 5, 6, 2
+    flow = rng.standard_normal((b, h, w, d), dtype=np.float32)
+    mask = rng.standard_normal((b, h, w, factor * factor * 9), dtype=np.float32)
+    out = ops.convex_upsample(jnp.asarray(flow), jnp.asarray(mask), factor)
+
+    tflow = j2t(flow)
+    tmask = j2t(mask).view(b, 1, 9, factor, factor, h, w)
+    tmask = torch.softmax(tmask, dim=2)
+    patches = tF.unfold(factor * tflow, (3, 3), padding=1).view(b, d, 9, 1, 1, h, w)
+    ref = torch.sum(tmask * patches, dim=2)
+    ref = ref.permute(0, 1, 4, 2, 5, 3).reshape(b, d, factor * h, factor * w)
+    np.testing.assert_allclose(np.asarray(out), t2j(ref), atol=1e-5)
+
+
+def test_coords_grid():
+    g = ops.coords_grid(2, 3, 4)
+    assert g.shape == (2, 3, 4, 2)
+    np.testing.assert_array_equal(np.asarray(g[0, :, :, 0]),
+                                  np.tile(np.arange(4, dtype=np.float32), (3, 1)))
+    np.testing.assert_array_equal(np.asarray(g[1, :, :, 1]),
+                                  np.tile(np.arange(3, dtype=np.float32)[:, None], (1, 4)))
+
+
+def test_upflow_matches_torch(rng):
+    x = rng.standard_normal((1, 4, 5, 2), dtype=np.float32)
+    out = ops.upflow(jnp.asarray(x), 8)
+    ref = 8 * tF.interpolate(j2t(x), size=(32, 40), mode="bilinear", align_corners=True)
+    np.testing.assert_allclose(np.asarray(out), t2j(ref), atol=1e-4)
+
+
+@pytest.mark.parametrize("hw,divis", [((46, 62), 32), ((64, 96), 32), ((375, 1242), 32)])
+def test_input_padder_roundtrip(rng, hw, divis):
+    h, w = hw
+    x = rng.standard_normal((1, h, w, 3), dtype=np.float32)
+    padder = ops.InputPadder((1, h, w, 3), divis_by=divis)
+    (padded,) = padder.pad(jnp.asarray(x))
+    ph, pw = padder.padded_shape
+    assert ph % divis == 0 and pw % divis == 0
+    assert padded.shape == (1, ph, pw, 3)
+    np.testing.assert_array_equal(np.asarray(padder.unpad(padded)), x)
+    # Reference quirk (utils.py:11-12): already-divisible sizes stay unpadded.
+    if h % divis == 0 and w % divis == 0:
+        assert (ph, pw) == (h, w)
+
+
+def test_input_padder_bucketing():
+    padder = ops.InputPadder((1, 375, 1242, 3), divis_by=32, bucket=64)
+    ph, pw = padder.padded_shape
+    assert ph % 64 == 0 and pw % 64 == 0
