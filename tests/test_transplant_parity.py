@@ -1,0 +1,139 @@
+"""Golden parity: transplanted weights must reproduce the torch reference.
+
+The reference implementation mounted at /root/reference is imported (read-only)
+purely as a numerical oracle; with shared random weights the transplanted JAX
+model must match its forward pass. This is the SURVEY.md §7 step-4 gate.
+Skipped automatically when the reference checkout is unavailable.
+"""
+
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_stereo_tpu.config import RAFTStereoConfig
+from raft_stereo_tpu.models import raft_stereo_forward
+from raft_stereo_tpu.models.extractor import apply_basic_encoder, apply_multi_basic_encoder
+from raft_stereo_tpu.transplant import transplant_state_dict
+
+REFERENCE = Path("/root/reference")
+
+pytestmark = pytest.mark.skipif(not (REFERENCE / "core").is_dir(),
+                                reason="reference checkout not available")
+
+
+def _make_reference_model(**overrides):
+    import argparse
+    import torch
+    if str(REFERENCE) not in sys.path:
+        sys.path.insert(0, str(REFERENCE))
+    from core.raft_stereo import RAFTStereo
+    defaults = dict(corr_implementation="reg", shared_backbone=False,
+                    corr_levels=4, corr_radius=4, n_downsample=2,
+                    slow_fast_gru=False, n_gru_layers=3,
+                    hidden_dims=[128, 128, 128], mixed_precision=False)
+    defaults.update(overrides)
+    torch.manual_seed(1234)
+    model = RAFTStereo(argparse.Namespace(**defaults))
+    model.eval()
+    return model, RAFTStereoConfig(**overrides)
+
+
+def _images(rng, h=64, w=96, b=1):
+    import torch
+    img1 = rng.uniform(0, 255, size=(b, 3, h, w)).astype(np.float32)
+    img2 = rng.uniform(0, 255, size=(b, 3, h, w)).astype(np.float32)
+    return (torch.from_numpy(img1), torch.from_numpy(img2),
+            jnp.asarray(img1.transpose(0, 2, 3, 1)),
+            jnp.asarray(img2.transpose(0, 2, 3, 1)))
+
+
+def test_parameter_count_matches_reference():
+    model, cfg = _make_reference_model()
+    ref_count = sum(p.numel() for p in model.parameters() if p.requires_grad)
+    params = transplant_state_dict(model.state_dict(), cfg)
+    # Frozen-BN running stats are buffers in torch (not counted); exclude the
+    # matching leaves here: each batch-norm dict contributes mean/var extras.
+    leaves, _ = jax.tree_util.tree_flatten_with_path(params)
+    ours = sum(int(np.prod(l.shape)) for path, l in leaves
+               if path[-1].key not in ("mean", "var"))
+    assert ours == ref_count
+
+
+def test_fnet_parity(rng):
+    import torch
+    model, cfg = _make_reference_model()
+    t1, t2, j1, j2 = _images(rng)
+    params = transplant_state_dict(model.state_dict(), cfg)
+    with torch.no_grad():
+        fmap1, fmap2 = model.fnet([2 * (t1 / 255.0) - 1.0, 2 * (t2 / 255.0) - 1.0])
+    ours = apply_basic_encoder(
+        params["fnet"], jnp.concatenate([2 * (j1 / 255.0) - 1.0,
+                                         2 * (j2 / 255.0) - 1.0], axis=0),
+        norm_fn="instance", downsample=cfg.n_downsample)
+    ref = np.concatenate([fmap1.numpy(), fmap2.numpy()], axis=0).transpose(0, 2, 3, 1)
+    np.testing.assert_allclose(np.asarray(ours), ref, atol=5e-4)
+
+
+def test_cnet_parity(rng):
+    import torch
+    model, cfg = _make_reference_model()
+    t1, _, j1, _ = _images(rng)
+    params = transplant_state_dict(model.state_dict(), cfg)
+    with torch.no_grad():
+        ref_list = model.cnet(2 * (t1 / 255.0) - 1.0, num_layers=3)
+    ours = apply_multi_basic_encoder(params["cnet"], 2 * (j1 / 255.0) - 1.0,
+                                     norm_fn="batch", downsample=cfg.n_downsample,
+                                     num_layers=3)
+    for scale, (ref_pair, our_pair) in enumerate(zip(ref_list, ours)):
+        for branch, (r, o) in enumerate(zip(ref_pair, our_pair)):
+            np.testing.assert_allclose(
+                np.asarray(o), r.numpy().transpose(0, 2, 3, 1), atol=5e-4,
+                err_msg=f"scale {scale} branch {branch}")
+
+
+@pytest.mark.parametrize("overrides", [
+    dict(),
+    dict(n_gru_layers=2),
+    dict(shared_backbone=True, n_downsample=3, n_gru_layers=2, slow_fast_gru=True),
+])
+def test_full_forward_parity(rng, overrides):
+    import torch
+    model, cfg = _make_reference_model(**overrides)
+    # W=128: the reference builds one unused extra pyramid level
+    # (core/corr.py:122-125) and crashes if W/2^(n_downsample+4) rounds to 0.
+    t1, t2, j1, j2 = _images(rng, w=128)
+    params = transplant_state_dict(model.state_dict(), cfg)
+    iters = 8
+    with torch.no_grad():
+        flow_lr_ref, flow_up_ref = model(t1, t2, iters=iters, test_mode=True)
+    flow_lr, flow_up = raft_stereo_forward(params, cfg, j1, j2, iters=iters,
+                                           test_mode=True)
+    # fp noise (~1e-6/step between frameworks) is amplified by 8 recurrent
+    # iterations of saturating nonlinearities; 1e-2 px on random weights is
+    # far below any metric-visible difference.
+    np.testing.assert_allclose(np.asarray(flow_up),
+                               flow_up_ref.numpy().transpose(0, 2, 3, 1),
+                               atol=1e-2)
+    np.testing.assert_allclose(np.asarray(flow_lr),
+                               flow_lr_ref.numpy().transpose(0, 2, 3, 1),
+                               atol=1e-2)
+
+
+def test_train_mode_prediction_list_parity(rng):
+    import torch
+    model, cfg = _make_reference_model()
+    t1, t2, j1, j2 = _images(rng)
+    params = transplant_state_dict(model.state_dict(), cfg)
+    with torch.no_grad():
+        ref_preds = model(t1, t2, iters=3, test_mode=False)
+    preds = raft_stereo_forward(params, cfg, j1, j2, iters=3)
+    assert len(ref_preds) == preds.shape[0] == 3
+    for i in range(3):
+        np.testing.assert_allclose(np.asarray(preds[i]),
+                                   ref_preds[i].numpy().transpose(0, 2, 3, 1),
+                                   atol=5e-3, err_msg=f"iteration {i}")
