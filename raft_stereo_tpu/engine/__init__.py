@@ -1,0 +1,5 @@
+"""Training and evaluation engines."""
+
+from raft_stereo_tpu.engine.loss import sequence_loss  # noqa: F401
+from raft_stereo_tpu.engine.optimizer import (  # noqa: F401
+    make_optimizer, onecycle_linear_schedule)

@@ -1,0 +1,52 @@
+"""Mesh construction and sharding helpers.
+
+Axes:
+- ``data``  — batch data parallelism (the reference's DataParallel equivalent);
+- ``width`` — optional intra-sample sharding of the correlation volume along
+  image width for full-resolution eval (each output row/column block is
+  independent; collectives only at the einsum boundary).
+
+Multi-host: call ``maybe_distributed_init()`` before device queries; mesh axes
+are laid out so ``data`` spans hosts (DCN) last and ``width`` stays inside the
+ICI domain.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def maybe_distributed_init() -> None:
+    """Initialize jax.distributed when launched multi-host (no-op otherwise)."""
+    if os.environ.get("COORDINATOR_ADDRESS"):
+        jax.distributed.initialize()
+
+
+def make_mesh(n_data: Optional[int] = None, n_width: int = 1,
+              devices: Optional[Sequence] = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    if n_data is None:
+        n_data = len(devices) // n_width
+    use = n_data * n_width
+    dev_array = np.asarray(devices[:use]).reshape(n_data, n_width)
+    return Mesh(dev_array, axis_names=("data", "width"))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Leading-axis (batch) sharding for NHWC arrays."""
+    return NamedSharding(mesh, P("data"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(batch, mesh: Mesh):
+    """Device-put a pytree of batch-leading arrays with batch sharded on 'data'."""
+    sharding = batch_sharding(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
