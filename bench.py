@@ -34,8 +34,10 @@ def main() -> None:
     iters = int(os.environ.get("RAFT_BENCH_ITERS", 32))
     n_frames = int(os.environ.get("RAFT_BENCH_FRAMES", 5))
     corr = os.environ.get("RAFT_BENCH_CORR", "reg")
+    mixed = os.environ.get("RAFT_BENCH_MP", "1").strip().lower() not in (
+        "0", "false", "no", "off")
 
-    cfg = RAFTStereoConfig(corr_implementation=corr, mixed_precision=True)
+    cfg = RAFTStereoConfig(corr_implementation=corr, mixed_precision=mixed)
     params = init_raft_stereo(jax.random.PRNGKey(0), cfg)
 
     @jax.jit
@@ -84,7 +86,8 @@ def main() -> None:
         pass
 
     print(json.dumps({
-        "metric": f"middlebury_F_disparity_fps_per_chip_{iters}iters_{h}x{w}_{corr}",
+        "metric": (f"middlebury_F_disparity_fps_per_chip_{iters}iters_"
+                   f"{h}x{w}_{corr}_{'bf16' if mixed else 'fp32'}"),
         "value": round(fps, 4),
         "unit": "frames/s",
         "vs_baseline": round(fps / baseline, 4) if baseline else None,

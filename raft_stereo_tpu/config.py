@@ -88,8 +88,9 @@ class TrainConfig:
     do_flip: Optional[str] = None  # False/'h'/'v' in the reference CLI
     spatial_scale: Tuple[float, float] = (0.0, 0.0)
     noyjitter: bool = False
-    # TPU-framework extensions (not in the reference CLI)
-    num_workers: int = 4
+    # TPU-framework extensions (not in the reference CLI). num_workers=None
+    # means "size from SLURM_CPUS_PER_TASK - 2" like the reference loader.
+    num_workers: Optional[int] = None
     seed: int = 1234
     ckpt_every: int = 10000  # reference validation/ckpt cadence, train_stereo.py:153
 
