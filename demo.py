@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Demo CLI — flag-for-flag with the reference ``demo.py:53-75``.
+
+Glob a left/right image list, run the model in test mode, save the disparity
+as a jet-colormap PNG (sign-flipped back to positive) and optionally ``.npy``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import logging
+from pathlib import Path
+
+
+def build_parser() -> argparse.ArgumentParser:
+    from raft_stereo_tpu.config import add_model_args
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--restore_ckpt', help="restore checkpoint",
+                        required=True)
+    parser.add_argument('--save_numpy', action='store_true',
+                        help='save output as numpy arrays')
+    parser.add_argument('-l', '--left_imgs',
+                        help="path to all first (left) frames",
+                        default="datasets/Middlebury/MiddEval3/testH/*/im0.png")
+    parser.add_argument('-r', '--right_imgs',
+                        help="path to all second (right) frames",
+                        default="datasets/Middlebury/MiddEval3/testH/*/im1.png")
+    parser.add_argument('--output_directory',
+                        help="directory to save output", default="demo_output")
+    parser.add_argument('--valid_iters', type=int, default=32,
+                        help='number of flow-field updates during forward pass')
+    add_model_args(parser)
+    return parser
+
+
+def demo(args) -> None:
+    import jax
+    import numpy as np
+
+    from raft_stereo_tpu.config import RAFTStereoConfig
+    from raft_stereo_tpu.data.frame_utils import read_image_rgb
+    from raft_stereo_tpu.engine.checkpoint import load_params
+    from raft_stereo_tpu.engine.evaluate import make_eval_forward
+    from raft_stereo_tpu.models import init_raft_stereo
+    from raft_stereo_tpu.ops.padder import InputPadder
+
+    cfg = RAFTStereoConfig.from_namespace(args)
+    template = (None if args.restore_ckpt.endswith(".pth")
+                else init_raft_stereo(jax.random.PRNGKey(0), cfg))
+    params = load_params(args.restore_ckpt, cfg, template)
+    mixed_prec = (cfg.mixed_precision
+                  or args.corr_implementation.endswith(("_cuda", "_tpu")))
+    forward = make_eval_forward(params, cfg, args.valid_iters, mixed_prec)
+
+    output_directory = Path(args.output_directory)
+    output_directory.mkdir(exist_ok=True)
+
+    left_images = sorted(glob.glob(args.left_imgs, recursive=True))
+    right_images = sorted(glob.glob(args.right_imgs, recursive=True))
+    print(f"Found {len(left_images)} images. "
+          f"Saving files to {output_directory}/")
+
+    from matplotlib import pyplot as plt
+
+    for imfile1, imfile2 in zip(left_images, right_images):
+        image1 = read_image_rgb(imfile1).astype(np.float32)[None]
+        image2 = read_image_rgb(imfile2).astype(np.float32)[None]
+        padder = InputPadder(image1.shape, divis_by=32)
+        image1, image2 = padder.pad_np(image1, image2)
+        flow_up, _ = forward(image1, image2)
+        flow_up = np.asarray(padder.unpad(flow_up))[0, ..., 0]
+
+        file_stem = imfile1.split('/')[-2]
+        if args.save_numpy:
+            np.save(output_directory / f"{file_stem}.npy", flow_up.squeeze())
+        plt.imsave(output_directory / f"{file_stem}.png", -flow_up.squeeze(),
+                   cmap='jet')
+
+
+def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    demo(args)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Evaluation CLI — flag-for-flag with the reference ``evaluate_stereo.py:192-243``,
+plus TPU corr choices and ``--dataset_root``/``--bucket``.
+
+Mixed precision policy mirrors the reference (:227-230): full-network bf16 is
+enabled only for the kernel-backed corr implementations (``*_cuda``/``*_tpu``),
+whose lookups accumulate in fp32; the pure-XLA paths keep corr math fp32.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+
+def build_parser() -> argparse.ArgumentParser:
+    from raft_stereo_tpu.config import add_model_args
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--restore_ckpt', help="restore checkpoint "
+                        "(.pth reference weights or native .msgpack)",
+                        default=None)
+    parser.add_argument('--dataset', help="dataset for evaluation",
+                        required=True,
+                        choices=["eth3d", "kitti", "things"]
+                        + [f"middlebury_{s}" for s in 'FHQ'])
+    parser.add_argument('--valid_iters', type=int, default=32,
+                        help='number of flow-field updates during forward pass')
+    add_model_args(parser)
+
+    # TPU-framework extensions
+    parser.add_argument('--dataset_root', default=None,
+                        help="root directory holding the datasets/ tree")
+    parser.add_argument('--bucket', type=int, default=None,
+                        help="pad eval shapes up to multiples of this size "
+                        "to share compilations (must be a multiple of 32)")
+    return parser
+
+
+def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format='%(asctime)s %(levelname)-8s [%(filename)s:%(lineno)d] %(message)s')
+
+    import jax
+
+    from raft_stereo_tpu.config import RAFTStereoConfig
+    from raft_stereo_tpu.engine import evaluate as ev
+    from raft_stereo_tpu.engine.checkpoint import load_params
+    from raft_stereo_tpu.models import init_raft_stereo
+
+    cfg = RAFTStereoConfig.from_namespace(args)
+
+    if args.restore_ckpt is not None:
+        logging.info("Loading checkpoint...")
+        template = (None if args.restore_ckpt.endswith(".pth")
+                    else init_raft_stereo(jax.random.PRNGKey(0), cfg))
+        params = load_params(args.restore_ckpt, cfg, template)
+        logging.info("Done loading checkpoint")
+    else:
+        params = init_raft_stereo(jax.random.PRNGKey(0), cfg)
+
+    print(f"The model has {ev.count_parameters(params) / 1e6:.2f}M "
+          "learnable parameters.")
+
+    # Kernel-backed corr lookups accumulate in fp32, making full-network
+    # mixed precision safe (reference :227-230).
+    use_mixed_precision = args.corr_implementation.endswith(("_cuda", "_tpu"))
+
+    common = dict(iters=args.valid_iters, mixed_prec=use_mixed_precision,
+                  root=args.dataset_root, bucket=args.bucket)
+    if args.dataset == 'eth3d':
+        ev.validate_eth3d(params, cfg, **common)
+    elif args.dataset == 'kitti':
+        ev.validate_kitti(params, cfg, **common)
+    elif args.dataset.startswith('middlebury_'):
+        ev.validate_middlebury(params, cfg, split=args.dataset[-1], **common)
+    elif args.dataset == 'things':
+        ev.validate_things(params, cfg, **common)
+
+
+if __name__ == '__main__':
+    main()

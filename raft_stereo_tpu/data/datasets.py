@@ -125,12 +125,12 @@ class StereoDataset:
         out.extra_info = v * self.extra_info
         return out
 
-    def __add__(self, other: "StereoDataset") -> "StereoDataset":
-        out = copy.deepcopy(self)
-        out.image_list = self.image_list + other.image_list
-        out.disparity_list = self.disparity_list + other.disparity_list
-        out.extra_info = self.extra_info + other.extra_info
-        return out
+    def __add__(self, other) -> "ConcatStereoDataset":
+        # Each part keeps its own reader/sparse-flag/augmentor — merging path
+        # lists (the reference-style shortcut) would decode every dataset with
+        # the first one's reader. Concat dispatches per index instead (what
+        # torch's ConcatDataset does for the reference).
+        return ConcatStereoDataset([self, other])
 
     def __len__(self) -> int:
         return len(self.image_list)
@@ -139,6 +139,34 @@ class StereoDataset:
         for img1, img2, disp in zip(image1_list, image2_list, disp_list):
             self.image_list.append([img1, img2])
             self.disparity_list.append(disp)
+
+
+class ConcatStereoDataset:
+    """Concatenation of stereo datasets, dispatching each index to the part
+    that owns it (so mixed sparse/dense datasets keep their own readers and
+    augmentors). Supports the same ``+`` / ``*`` mixing algebra."""
+
+    def __init__(self, parts):
+        self.parts = []
+        for p in parts:
+            self.parts.extend(p.parts if isinstance(p, ConcatStereoDataset)
+                              else [p])
+        self._cum = np.cumsum([len(p) for p in self.parts])
+
+    def __len__(self) -> int:
+        return int(self._cum[-1]) if len(self.parts) else 0
+
+    def __getitem__(self, index, rng: Optional[np.random.Generator] = None):
+        index = index % len(self)
+        part = int(np.searchsorted(self._cum, index, side="right"))
+        local = index - (int(self._cum[part - 1]) if part else 0)
+        return self.parts[part].__getitem__(local, rng=rng)
+
+    def __add__(self, other) -> "ConcatStereoDataset":
+        return ConcatStereoDataset([self, other])
+
+    def __mul__(self, v: int) -> "ConcatStereoDataset":
+        return ConcatStereoDataset(self.parts * v)
 
 
 class SceneFlowDatasets(StereoDataset):

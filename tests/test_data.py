@@ -281,6 +281,30 @@ def test_dataset_mul_and_add(tmp_path):
     assert len(ds * 2 + ds) == 9
 
 
+def test_heterogeneous_mix_keeps_per_part_readers(tmp_path):
+    """sceneflow (dense, PFM via read_gen) + kitti (sparse, PNG/256): each
+    part of the mix must decode with its own reader in both concat orders."""
+    root = str(tmp_path)
+    _make_sceneflow_tree(root, n=2, h=40, w=60)
+    kroot = osp.join(root, "KITTI")
+    img = np.random.default_rng(0).integers(0, 256, (40, 60, 3), dtype=np.uint8)
+    for cam in ("image_2", "image_3"):
+        _write_png(osp.join(kroot, "training", cam, "000000_10.png"), img)
+    os.makedirs(osp.join(kroot, "training", "disp_occ_0"), exist_ok=True)
+    cv2.imwrite(osp.join(kroot, "training", "disp_occ_0", "000000_10.png"),
+                (np.full((40, 60), 37.5) * 256).astype(np.uint16))
+
+    sf = SceneFlowDatasets(aug_params=None, root=root)
+    ki = KITTI(aug_params=None, root=kroot)
+    for mix, k_index in ((sf + ki, len(sf)), (ki + sf, 0)):
+        assert len(mix) == 3
+        k_sample = mix.__getitem__(k_index, rng=np.random.default_rng(0))
+        np.testing.assert_allclose(k_sample["flow"][..., 0], -37.5)
+        sf_sample = mix.__getitem__((k_index + 1) % 3,
+                                    rng=np.random.default_rng(0))
+        np.testing.assert_allclose(sf_sample["flow"][..., 0], -5.25)
+
+
 def test_fetch_dataset_sceneflow_weights(tmp_path):
     root = str(tmp_path)
     _make_sceneflow_tree(root)

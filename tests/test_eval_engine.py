@@ -1,0 +1,225 @@
+"""Eval engine tests: metric aggregation quirks, checkpointing, logger, train loop.
+
+The four validators are tested with a stubbed forward (zero predictions ->
+EPE equals |gt| exactly), pinning each benchmark's aggregation quirk without
+paying model compiles. One real end-to-end train-loop smoke runs the full
+stack at tiny shapes.
+"""
+
+import os
+import os.path as osp
+
+import numpy as np
+import pytest
+from PIL import Image
+
+import cv2
+
+import jax
+
+from raft_stereo_tpu.config import RAFTStereoConfig, TrainConfig
+from raft_stereo_tpu.data import frame_utils
+from raft_stereo_tpu.engine import checkpoint as ckpt
+from raft_stereo_tpu.engine import evaluate as ev
+from raft_stereo_tpu.engine.logger import SUM_FREQ, Logger
+from raft_stereo_tpu.engine.optimizer import make_optimizer
+from raft_stereo_tpu.models import init_raft_stereo
+
+TINY = RAFTStereoConfig(hidden_dims=(32, 32, 32), corr_levels=2, corr_radius=2)
+
+
+def _zero_forward(params, cfg, iters, mixed_prec=False):
+    def forward(image1, image2):
+        return np.zeros(image1.shape[:3] + (1,), np.float32), 0.01
+    return forward
+
+
+def _write_png(path, arr):
+    os.makedirs(osp.dirname(str(path)), exist_ok=True)
+    Image.fromarray(arr).save(path)
+
+
+# ---------------------------------------------------------------------------
+# Validators with stubbed forward: aggregation quirks
+# ---------------------------------------------------------------------------
+
+def _make_eth3d_tree(root, disps):
+    """One scene per disp value; disparity is constant over a 40x64 image."""
+    img = np.zeros((40, 64, 3), np.uint8)
+    for i, d in enumerate(disps):
+        scene = f"scene_{i}"
+        _write_png(osp.join(root, "two_view_training", scene, "im0.png"), img)
+        _write_png(osp.join(root, "two_view_training", scene, "im1.png"), img)
+        gt_dir = osp.join(root, "two_view_training_gt", scene)
+        os.makedirs(gt_dir, exist_ok=True)
+        frame_utils.write_pfm(osp.join(gt_dir, "disp0GT.pfm"),
+                              np.full((40, 64), d, np.float32))
+
+
+def test_validate_eth3d_per_image_aggregation(tmp_path, monkeypatch):
+    monkeypatch.setattr(ev, "make_eval_forward", _zero_forward)
+    # Two images, disparities 0.5 (inlier at >1px) and 2.0 (outlier).
+    _make_eth3d_tree(str(tmp_path / "ETH3D"), [0.5, 2.0])
+    res = ev.validate_eth3d(None, TINY, iters=2, root=str(tmp_path))
+    np.testing.assert_allclose(res["eth3d-epe"], (0.5 + 2.0) / 2)
+    np.testing.assert_allclose(res["eth3d-d1"], 50.0)  # per-image mean
+
+
+def test_validate_middlebury_sentinel_filter(tmp_path, monkeypatch):
+    monkeypatch.setattr(ev, "make_eval_forward", _zero_forward)
+    root = str(tmp_path / "Middlebury")
+    img = np.zeros((40, 64, 3), np.uint8)
+    scene = "artroom1"
+    base = osp.join(root, "MiddEval3", "trainingF", scene)
+    _write_png(osp.join(base, "im0.png"), img)
+    _write_png(osp.join(base, "im1.png"), img)
+    disp = np.full((40, 64), 1.0, np.float32)
+    disp[:20] = np.inf  # invalid region -> flow=-inf, filtered by > -1000
+    frame_utils.write_pfm(osp.join(base, "disp0GT.pfm"), disp)
+    mask = np.full((40, 64), 255, np.uint8)
+    mask[:, :32] = 128  # nocc mask is IGNORED by the reference metric
+    _write_png(osp.join(base, "mask0nocc.png"), mask)
+    with open(osp.join(root, "MiddEval3", "official_train.txt"), "w") as f:
+        f.write(f"{scene}\n")
+
+    res = ev.validate_middlebury(None, TINY, iters=2, split="F",
+                                 root=str(tmp_path))
+    # Only the inf rows are filtered; the nocc mask does not reduce the count.
+    np.testing.assert_allclose(res["middleburyF-epe"], 1.0)
+    np.testing.assert_allclose(res["middleburyF-d1"], 0.0)
+
+
+def test_validate_kitti_per_pixel_aggregation(tmp_path, monkeypatch):
+    monkeypatch.setattr(ev, "make_eval_forward", _zero_forward)
+    root = str(tmp_path / "KITTI")
+    img = np.zeros((40, 64, 3), np.uint8)
+    # Image 0: 100 valid px at disp 5 (outliers at >3px);
+    # image 1: 300 valid px at disp 1 (inliers).
+    for i, (n_valid, d) in enumerate([(100, 5.0), (300, 1.0)]):
+        _write_png(osp.join(root, "training", "image_2", f"{i:06d}_10.png"), img)
+        _write_png(osp.join(root, "training", "image_3", f"{i:06d}_10.png"), img)
+        disp = np.zeros((40, 64), np.float32)
+        disp.flat[:n_valid] = d
+        os.makedirs(osp.join(root, "training", "disp_occ_0"), exist_ok=True)
+        cv2.imwrite(osp.join(root, "training", "disp_occ_0", f"{i:06d}_10.png"),
+                    (disp * 256).astype(np.uint16))
+    res = ev.validate_kitti(None, TINY, iters=2, root=str(tmp_path))
+    # Per-pixel: 100 outliers / 400 valid = 25% (per-image would be 50%).
+    np.testing.assert_allclose(res["kitti-d1"], 25.0)
+    np.testing.assert_allclose(res["kitti-epe"], (5.0 + 1.0) / 2)
+
+
+def test_validate_things_192_filter(tmp_path, monkeypatch):
+    monkeypatch.setattr(ev, "make_eval_forward", _zero_forward)
+    root = str(tmp_path)
+    img = np.zeros((40, 64, 3), np.uint8)
+    base = osp.join(root, "FlyingThings3D")
+    _write_png(osp.join(base, "frames_finalpass", "TEST", "A", "0000",
+                        "left", "0006.png"), img)
+    _write_png(osp.join(base, "frames_finalpass", "TEST", "A", "0000",
+                        "right", "0006.png"), img)
+    disp = np.full((40, 64), 2.0, np.float32)
+    disp[0, :10] = 400.0  # beyond the 192 magnitude filter
+    ddir = osp.join(base, "disparity", "TEST", "A", "0000", "left")
+    os.makedirs(ddir, exist_ok=True)
+    frame_utils.write_pfm(osp.join(ddir, "0006.pfm"), disp)
+    res = ev.validate_things(None, TINY, iters=2, root=root)
+    np.testing.assert_allclose(res["things-epe"], 2.0)  # 400s filtered out
+    np.testing.assert_allclose(res["things-d1"], 100.0)  # all >1px
+
+
+# ---------------------------------------------------------------------------
+# Checkpointing
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_roundtrip(tmp_path):
+    cfg = TINY
+    params = init_raft_stereo(jax.random.PRNGKey(0), cfg)
+    tx, _ = make_optimizer(1e-4, 100)
+    opt_state = tx.init(params)
+    path = str(tmp_path / "ck.msgpack")
+    ckpt.save_checkpoint(path, params, opt_state, step=17)
+
+    params2 = init_raft_stereo(jax.random.PRNGKey(1), cfg)
+    opt2 = tx.init(params2)
+    rp, ro, step = ckpt.load_checkpoint(path, params2, opt2)
+    assert step == 17
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(rp)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert len(jax.tree.leaves(ro)) == len(jax.tree.leaves(opt_state))
+
+
+def test_load_params_dispatches_native(tmp_path):
+    cfg = TINY
+    params = init_raft_stereo(jax.random.PRNGKey(0), cfg)
+    path = str(tmp_path / "p.msgpack")
+    ckpt.save_checkpoint(path, params)
+    out = ckpt.load_params(path, cfg, params)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(out)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_count_parameters():
+    params = {"a": np.zeros((2, 3)), "b": [np.zeros(5), np.zeros((1, 1))]}
+    assert ev.count_parameters(params) == 6 + 5 + 1
+
+
+# ---------------------------------------------------------------------------
+# Logger
+# ---------------------------------------------------------------------------
+
+def test_logger_running_mean_flush(tmp_path):
+    log = Logger(log_dir=str(tmp_path / "runs"))
+    # Flush fires on the push where total_steps % SUM_FREQ == SUM_FREQ-1
+    # (reference Logger.push, train_stereo.py:108-118).
+    for _ in range(SUM_FREQ - 1):
+        log.push({"loss": 2.0})
+    assert log.running_loss == {}  # flushed on push SUM_FREQ-1
+    log.push({"loss": 2.0})
+    assert log.running_loss == {"loss": 2.0}  # accumulation restarted
+    log.write_dict({"things-epe": 1.5})
+    log.close()
+    assert any(os.scandir(tmp_path / "runs"))  # event file written
+
+
+# ---------------------------------------------------------------------------
+# Train loop smoke (real model, tiny shapes)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_train_loop_checkpoints_and_resume(tmp_path, monkeypatch):
+    from raft_stereo_tpu.engine.train import train
+
+    root = str(tmp_path / "data")
+    rng = np.random.default_rng(0)
+    for dstype in ("frames_cleanpass", "frames_finalpass"):
+        base = osp.join(root, "FlyingThings3D", dstype, "TRAIN", "A", "0000")
+        for side in ("left", "right"):
+            _write_png(osp.join(base, side, "0006.png"),
+                       rng.integers(0, 256, (48, 64, 3), dtype=np.uint8))
+    ddir = osp.join(root, "FlyingThings3D", "disparity", "TRAIN", "A", "0000",
+                    "left")
+    os.makedirs(ddir, exist_ok=True)
+    frame_utils.write_pfm(osp.join(ddir, "0006.pfm"),
+                          rng.uniform(1, 10, (48, 64)).astype(np.float32))
+
+    monkeypatch.chdir(tmp_path)
+    cfg = TINY
+    tcfg = TrainConfig(name="smoke", batch_size=1, image_size=(32, 48),
+                       num_steps=3, train_iters=2, ckpt_every=2,
+                       num_workers=1, spatial_scale=(-0.2, 0.4))
+    train(cfg, tcfg, data_root=root, validate=False)
+    assert osp.exists("checkpoints/2_smoke.msgpack")
+    assert osp.exists("checkpoints/smoke.msgpack")
+
+    # Resume from the mid-run checkpoint: picks up at step 2.
+    tcfg2 = TrainConfig(name="smoke2", batch_size=1, image_size=(32, 48),
+                        num_steps=4, train_iters=2, ckpt_every=100,
+                        num_workers=1, restore_ckpt="checkpoints/2_smoke.msgpack",
+                        spatial_scale=(-0.2, 0.4))
+    train(cfg, tcfg2, data_root=root, validate=False)
+    _, _, step = ckpt.load_checkpoint(
+        "checkpoints/smoke2.msgpack",
+        init_raft_stereo(jax.random.PRNGKey(0), cfg),
+        None)
+    assert step == 4

@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Training CLI — flag-for-flag with the reference ``train_stereo.py:214-258``,
+plus the TPU corr choices (``reg_tpu``/``alt_tpu``) and ``--dataset_root``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+from pathlib import Path
+
+
+def build_parser() -> argparse.ArgumentParser:
+    from raft_stereo_tpu.config import add_model_args
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--name', default='raft-stereo',
+                        help="name your experiment")
+    parser.add_argument('--restore_ckpt', help="restore checkpoint "
+                        "(.pth transplants reference weights; .msgpack "
+                        "restores full state incl. optimizer and step)")
+
+    # Training parameters
+    parser.add_argument('--batch_size', type=int, default=6,
+                        help="batch size used during training.")
+    parser.add_argument('--train_datasets', nargs='+', default=['sceneflow'],
+                        help="training datasets.")
+    parser.add_argument('--lr', type=float, default=0.0002,
+                        help="max learning rate.")
+    parser.add_argument('--num_steps', type=int, default=100000,
+                        help="length of training schedule.")
+    parser.add_argument('--image_size', type=int, nargs='+',
+                        default=[320, 720],
+                        help="size of the random image crops used during training.")
+    parser.add_argument('--train_iters', type=int, default=16,
+                        help="number of updates to the disparity field in each forward pass.")
+    parser.add_argument('--wdecay', type=float, default=.00001,
+                        help="Weight decay in optimizer.")
+
+    # Validation parameters
+    parser.add_argument('--valid_iters', type=int, default=32,
+                        help='number of flow-field updates during validation forward pass')
+
+    # Architecture choices (shared flag set, incl. reg_tpu/alt_tpu)
+    add_model_args(parser)
+
+    # Data augmentation
+    parser.add_argument('--img_gamma', type=float, nargs='+', default=None,
+                        help="gamma range")
+    parser.add_argument('--saturation_range', type=float, nargs='+',
+                        default=None, help='color saturation')
+    parser.add_argument('--do_flip', default=False, choices=['h', 'v'],
+                        help='flip the images horizontally or vertically')
+    parser.add_argument('--spatial_scale', type=float, nargs='+',
+                        default=[0, 0], help='re-scale the images randomly')
+    parser.add_argument('--noyjitter', action='store_true',
+                        help="don't simulate imperfect rectification")
+
+    # TPU-framework extensions
+    parser.add_argument('--dataset_root', default=None,
+                        help="root directory holding the datasets/ tree")
+    parser.add_argument('--num_workers', type=int, default=None,
+                        help="loader worker threads (default: SLURM sizing)")
+    parser.add_argument('--seed', type=int, default=1234)
+    return parser
+
+
+def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format='%(asctime)s %(levelname)-8s [%(filename)s:%(lineno)d] %(message)s')
+    Path("checkpoints").mkdir(exist_ok=True, parents=True)
+
+    from raft_stereo_tpu.config import RAFTStereoConfig, TrainConfig
+    from raft_stereo_tpu.engine.train import train
+
+    cfg = RAFTStereoConfig.from_namespace(args)
+    tcfg = TrainConfig.from_namespace(args)
+    train(cfg, tcfg, data_root=args.dataset_root)
+
+
+if __name__ == '__main__':
+    main()
