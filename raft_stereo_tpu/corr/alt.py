@@ -35,14 +35,33 @@ def make_alt_corr_fn(fmap1: jax.Array, fmap2: jax.Array, *,
     dx = jnp.arange(-radius, radius + 1, dtype=jnp.float32)
     k = 2 * radius + 1
 
-    def corr_fn(coords_x: jax.Array) -> jax.Array:
-        b, h, w1 = coords_x.shape
+    def row_lookup(args):
+        """Per-H-chunk lookup; keeps the one-hot weight tensors bounded."""
+        f1_c, coords_c, *pyr_c = args
         out = []
-        for i, f2 in enumerate(pyramid2):
-            xs = coords_x.astype(jnp.float32)[..., None] / (2 ** i) + dx
-            sampled = sample_rows_zeros(f2, xs.reshape(b, h, w1 * k))
-            sampled = sampled.reshape(b, h, w1, k, d)
-            out.append(jnp.einsum("bhwkd,bhwd->bhwk", sampled, f1) * scale)
+        for i, f2 in enumerate(pyr_c):
+            xs = coords_c.astype(jnp.float32)[..., None] / (2 ** i) + dx
+            b, hc, w1 = coords_c.shape
+            sampled = sample_rows_zeros(f2, xs.reshape(b, hc, w1 * k))
+            sampled = sampled.reshape(b, hc, w1, k, d)
+            out.append(jnp.einsum("bhwkd,bhwd->bhwk", sampled, f1_c) * scale)
         return jnp.concatenate(out, axis=-1)
+
+    def corr_fn(coords_x: jax.Array, h_chunk: int = 32) -> jax.Array:
+        b, h, w1 = coords_x.shape
+        if h % h_chunk:
+            return row_lookup((f1, coords_x, *pyramid2))
+        # Scan over H chunks: peak memory O(chunk * W1 * (2r+1) * W2) for the
+        # one-hot sampling weights instead of O(H * ...) — the point of `alt`.
+        def chunk(hs):
+            return row_lookup(tuple(
+                jnp.moveaxis(x, 0, 1) for x in hs))
+
+        chunks = tuple(
+            jnp.moveaxis(x.reshape(b, h // h_chunk, h_chunk, *x.shape[2:]),
+                         1, 0)
+            for x in (f1, coords_x, *pyramid2))
+        out = jax.lax.map(chunk, chunks)
+        return jnp.moveaxis(out, 0, 1).reshape(b, h, w1, out.shape[-1])
 
     return corr_fn

@@ -24,7 +24,6 @@ import jax
 import jax.numpy as jnp
 
 from raft_stereo_tpu.ops.pooling import avg_pool_last
-from raft_stereo_tpu.ops.sampler import sample_1d_zeros
 
 
 def build_volume(fmap1: jax.Array, fmap2: jax.Array) -> jax.Array:
@@ -50,17 +49,31 @@ def build_pyramid(volume: jax.Array, num_levels: int) -> List[jax.Array]:
 
 def lookup_pyramid(pyramid: List[jax.Array], coords_x: jax.Array,
                    radius: int) -> jax.Array:
-    """Gather-lerp ``2r+1`` taps around ``coords_x / 2^i`` at every level.
+    """Sample ``2r+1`` lerped taps around ``coords_x / 2^i`` at every level.
 
     coords_x: (B, H, W1) fractional x positions at full (1/4-res) width.
     Returns (B, H, W1, num_levels * (2r+1)), level-major then offset -r..r
     (the concat order of ``core/corr.py:132-145``).
+
+    TPU formulation: the taps sit at consecutive integer offsets from one
+    fractional base, so the ``2r+1`` samples share ``2r+2`` integer taps and
+    one lerp fraction. Each integer tap is a one-hot reduce over the volume
+    row (regular VPU work; per-pixel gathers lower to serial loops on TPU and
+    measured ~45x slower — see ``ops/sampler.py``).
     """
-    dx = jnp.arange(-radius, radius + 1, dtype=jnp.float32)
     out = []
     for i, vol in enumerate(pyramid):
-        xs = coords_x.astype(jnp.float32)[..., None] / (2 ** i) + dx
-        out.append(sample_1d_zeros(vol, xs))
+        w2 = vol.shape[-1]
+        cl = coords_x.astype(jnp.float32) / (2 ** i)
+        i0 = jnp.floor(cl)
+        frac = (cl - i0)[..., None]
+        j = jnp.arange(w2, dtype=jnp.float32)
+        taps = []
+        for d in range(-radius, radius + 2):  # 2r+2 integer taps
+            onehot = (j == (i0[..., None] + d)).astype(vol.dtype)
+            taps.append(jnp.sum(vol * onehot, axis=-1))
+        g = jnp.stack(taps, axis=-1)  # (B, H, W1, 2r+2)
+        out.append(g[..., :-1] * (1.0 - frac) + g[..., 1:] * frac)
     return jnp.concatenate(out, axis=-1)
 
 
