@@ -9,7 +9,9 @@ The reference publishes no CUDA fps number (BASELINE.md); ``vs_baseline`` is
 the ratio against ``BASELINE.json``'s ``published.fps`` when present, else null.
 
 Env overrides: RAFT_BENCH_H / RAFT_BENCH_W / RAFT_BENCH_ITERS /
-RAFT_BENCH_FRAMES / RAFT_BENCH_CORR (reg|alt|reg_tpu|alt_tpu).
+RAFT_BENCH_FRAMES / RAFT_BENCH_CORR (reg|alt|reg_tpu|alt_tpu) /
+RAFT_BENCH_TRACE (directory: wrap one timed frame in ``jax.profiler.trace``
+for op-level attribution — the SURVEY §5 tracing hook).
 """
 
 from __future__ import annotations
@@ -67,6 +69,11 @@ def main() -> None:
     run(img1, img2)
     run(img1, img2)
 
+    trace_dir = os.environ.get("RAFT_BENCH_TRACE")
+    if trace_dir:
+        with jax.profiler.trace(trace_dir):
+            run(img1, img2)
+
     times = []
     for _ in range(n_frames):
         img1, img2 = frame()
@@ -78,12 +85,22 @@ def main() -> None:
 
     fps = 1.0 / (sum(times) / len(times))
 
+    # Baseline preference: a published reference fps (none exists — the repo
+    # publishes no numbers, BASELINE.md), else our measured torch-reference
+    # datum at the same shape/protocol (CPU-labeled; no GPU in this image).
     baseline = None
+    here = os.path.dirname(__file__)
     try:
-        with open(os.path.join(os.path.dirname(__file__), "BASELINE.json")) as f:
+        with open(os.path.join(here, "BASELINE.json")) as f:
             baseline = json.load(f).get("published", {}).get("fps")
     except (OSError, ValueError):
         pass
+    if baseline is None:
+        try:
+            with open(os.path.join(here, "baseline_measured.json")) as f:
+                baseline = json.load(f).get(f"torch_cpu_fps_{h}x{w}_{iters}iters")
+        except (OSError, ValueError):
+            pass
 
     print(json.dumps({
         "metric": (f"middlebury_F_disparity_fps_per_chip_{iters}iters_"

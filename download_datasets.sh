@@ -1,0 +1,29 @@
+#!/bin/bash
+# Fetch the evaluation datasets the validators expect, laid out under
+# datasets/ exactly as raft_stereo_tpu.data.datasets globs them.
+# Port of the reference fetcher (/root/reference/download_datasets.sh:1-23);
+# same upstream URLs, plus fail-fast flags and idempotent unzips.
+#
+# Training sets (SceneFlow, Sintel, FallingThings, TartanAir, KITTI) are
+# license-gated uploads; see README for their layout.
+set -euo pipefail
+
+mkdir -p datasets/Middlebury
+(
+  cd datasets/Middlebury
+  wget -nc https://www.dropbox.com/s/fn8siy5muak3of3/official_train.txt -P MiddEval3/
+  for split in Q H F; do
+    wget -nc "https://vision.middlebury.edu/stereo/submit3/zip/MiddEval3-data-${split}.zip"
+    unzip -n "MiddEval3-data-${split}.zip"
+    wget -nc "https://vision.middlebury.edu/stereo/submit3/zip/MiddEval3-GT0-${split}.zip"
+    unzip -n "MiddEval3-GT0-${split}.zip"
+  done
+  rm -f ./*.zip
+)
+
+mkdir -p datasets/ETH3D/two_view_testing
+(
+  cd datasets/ETH3D/two_view_testing
+  wget -nc https://www.eth3d.net/data/two_view_test.7z
+  7za x -aos two_view_test.7z
+)

@@ -1,0 +1,15 @@
+#!/bin/bash
+# Fetch the published RAFT-Stereo checkpoints
+# (raftstereo-{middlebury,eth3d,realtime,sceneflow}.pth) from the upstream
+# release bundle. Port of /root/reference/download_models.sh. The .pth files
+# load through the transplant shim: pass them to --restore_ckpt on any CLI
+# (.pth is auto-detected and converted, evaluate_stereo.py:58 / demo.py:50).
+set -euo pipefail
+
+mkdir -p models
+(
+  cd models
+  wget -nc https://www.dropbox.com/s/q4312z8g5znhhkp/models.zip
+  unzip -n models.zip
+  rm -f models.zip
+)

@@ -70,7 +70,11 @@ def main(argv=None) -> None:
     use_mixed_precision = args.corr_implementation.endswith(("_cuda", "_tpu"))
 
     common = dict(iters=args.valid_iters, mixed_prec=use_mixed_precision,
-                  root=args.dataset_root, bucket=args.bucket)
+                  root=args.dataset_root)
+    if args.bucket is not None:
+        # Otherwise keep each validator's own default (KITTI buckets to /64
+        # so its timing protocol never times a recompile).
+        common["bucket"] = args.bucket
     if args.dataset == 'eth3d':
         ev.validate_eth3d(params, cfg, **common)
     elif args.dataset == 'kitti':

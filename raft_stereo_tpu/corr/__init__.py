@@ -13,17 +13,19 @@ Implementations (reference ``core/corr.py`` / ``core/raft_stereo.py:90-100``):
 - ``alt``      — on-the-fly: no W^2 volume, samples pooled fmap2 rows per lookup
                  (PytorchAlternateCorrBlock1D, ``core/corr.py:64-107``); the
                  memory-efficient path for full-resolution inputs.
-- ``reg_tpu``  — ``reg`` with the lookup as a Pallas TPU kernel (the analog of
-                 the reference's CUDA ``corr_sampler`` extension, ``sampler/``).
-- ``alt_tpu``  — blockwise fused build+sample Pallas kernel (fills the hole the
-                 reference left: its ``alt_cuda`` choice crashes,
-                 ``core/corr.py:159-161``).
+- ``reg_tpu``  — ``reg`` with the lookup as a Pallas TPU kernel
+                 (``pallas_reg.py``; the analog of the reference's CUDA
+                 ``corr_sampler`` extension, ``sampler/``).
+- ``alt_tpu``  — blockwise fused build+sample Pallas kernel, no W^2 volume in
+                 HBM (``pallas_alt.py``; fills the hole the reference left:
+                 its ``alt_cuda`` choice crashes, ``core/corr.py:159-161``).
 - ``reg_cuda`` / ``alt_cuda`` — accepted for CLI compatibility, aliased to the
                  TPU-native kernels.
 
-All four produce identical outputs on one protocol (property-tested); channel
-order is level-major, then offset ``-r..r`` — the order the motion encoder's
-weights expect.
+All four implementations produce identical outputs on one protocol
+(property-tested in ``tests/test_corr.py``, gradients included); channel order
+is level-major, then offset ``-r..r`` — the order the motion encoder's weights
+expect.
 """
 
 from __future__ import annotations

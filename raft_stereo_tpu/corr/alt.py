@@ -20,6 +20,7 @@ import math
 import jax
 import jax.numpy as jnp
 
+from raft_stereo_tpu.ops.chunked import map_chunked
 from raft_stereo_tpu.ops.pooling import avg_pool_w2
 from raft_stereo_tpu.ops.sampler import sample_rows_zeros
 
@@ -48,20 +49,9 @@ def make_alt_corr_fn(fmap1: jax.Array, fmap2: jax.Array, *,
         return jnp.concatenate(out, axis=-1)
 
     def corr_fn(coords_x: jax.Array, h_chunk: int = 32) -> jax.Array:
-        b, h, w1 = coords_x.shape
-        if h % h_chunk:
-            return row_lookup((f1, coords_x, *pyramid2))
-        # Scan over H chunks: peak memory O(chunk * W1 * (2r+1) * W2) for the
+        # Map over H chunks: peak memory O(chunk * W1 * (2r+1) * W2) for the
         # one-hot sampling weights instead of O(H * ...) — the point of `alt`.
-        def chunk(hs):
-            return row_lookup(tuple(
-                jnp.moveaxis(x, 0, 1) for x in hs))
-
-        chunks = tuple(
-            jnp.moveaxis(x.reshape(b, h // h_chunk, h_chunk, *x.shape[2:]),
-                         1, 0)
-            for x in (f1, coords_x, *pyramid2))
-        out = jax.lax.map(chunk, chunks)
-        return jnp.moveaxis(out, 0, 1).reshape(b, h, w1, out.shape[-1])
+        return map_chunked(row_lookup, (f1, coords_x, *pyramid2),
+                           chunk=h_chunk, axis=1)
 
     return corr_fn

@@ -58,6 +58,12 @@ class StereoLoader:
         self.prefetch = max(1, prefetch)
         self.return_paths = return_paths
         self.epoch = 0
+        if drop_last and len(dataset) < batch_size:
+            # A zero-batch loader would make train() spin forever in its
+            # while-loop without ever advancing total_steps — fail fast.
+            raise ValueError(
+                f"drop_last=True leaves zero batches: dataset has "
+                f"{len(dataset)} samples < batch_size {batch_size}")
 
     def __len__(self) -> int:
         n = len(self.dataset)
@@ -131,8 +137,10 @@ def device_prefetch(loader, mesh=None, size: int = 2):
     import jax
 
     if mesh is not None:
-        from raft_stereo_tpu.parallel.mesh import batch_sharding
-        sharding = batch_sharding(mesh)
+        # Same sharding rule as make_train_step/make_eval_step, so jit does
+        # not insert a reshard that defeats the double-buffering overlap.
+        from raft_stereo_tpu.parallel.mesh import data_sharding
+        sharding = data_sharding(mesh)
         put = lambda b: {k: (jax.device_put(v, sharding)
                              if isinstance(v, np.ndarray) else v)
                          for k, v in b.items()}

@@ -21,7 +21,7 @@ from jax.sharding import Mesh
 from raft_stereo_tpu.config import RAFTStereoConfig
 from raft_stereo_tpu.engine.loss import sequence_loss
 from raft_stereo_tpu.models import raft_stereo_forward
-from raft_stereo_tpu.parallel.mesh import batch_sharding, replicated
+from raft_stereo_tpu.parallel.mesh import data_sharding, replicated
 
 
 def make_train_step(cfg: RAFTStereoConfig, tx: optax.GradientTransformation,
@@ -49,7 +49,7 @@ def make_train_step(cfg: RAFTStereoConfig, tx: optax.GradientTransformation,
     if mesh is None:
         return jax.jit(step, donate_argnums=(0, 1))
 
-    repl, bsh = replicated(mesh), batch_sharding(mesh)
+    repl, bsh = replicated(mesh), data_sharding(mesh)
     return jax.jit(
         step,
         in_shardings=(repl, repl, bsh),
@@ -67,6 +67,6 @@ def make_eval_step(cfg: RAFTStereoConfig, valid_iters: int,
 
     if mesh is None:
         return jax.jit(step)
-    repl, bsh = replicated(mesh), batch_sharding(mesh)
+    repl, bsh = replicated(mesh), data_sharding(mesh)
     return jax.jit(step, in_shardings=(repl, bsh, bsh),
                    out_shardings=(bsh, bsh))

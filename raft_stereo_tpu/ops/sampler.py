@@ -25,12 +25,18 @@ import jax.numpy as jnp
 def _onehot_lerp_weights(x: jax.Array, width: int) -> jax.Array:
     """Interpolation weight matrix w[..., j] for zero-padded linear sampling.
 
-    x: (...,) fractional positions -> returns (..., width) weights with
+    x: (...,) fractional positions -> returns (..., width) fp32 weights with
     ``w[j] = (1-frac) * [j == floor(x)] + frac * [j == floor(x)+1]``.
+
+    Positions, iota, and weights are computed in float32 unconditionally:
+    integer positions above 256 are not representable in bfloat16, so a
+    bf16 equality comparison would silently drop or duplicate taps for
+    width > 256. Callers cast the final weight matrix to the value dtype.
     """
+    x = x.astype(jnp.float32)
     x0 = jnp.floor(x)
     frac = (x - x0)[..., None]
-    j = jnp.arange(width, dtype=x.dtype)
+    j = jnp.arange(width, dtype=jnp.float32)
     i0 = x0[..., None]
     return jnp.where(j == i0, 1.0 - frac, 0.0) + jnp.where(j == i0 + 1.0,
                                                            frac, 0.0)
@@ -44,12 +50,11 @@ def sample_1d_zeros(values: jax.Array, x: jax.Array) -> jax.Array:
     Returns (..., K).
     """
     width = values.shape[-1]
-    x = x.astype(values.dtype)
     # Per-tap loop keeps the peak intermediate at (..., W) instead of
     # materializing the full (..., K, W) weight tensor.
     taps = []
     for k in range(x.shape[-1]):
-        w = _onehot_lerp_weights(x[..., k], width)
+        w = _onehot_lerp_weights(x[..., k], width).astype(values.dtype)
         taps.append(jnp.sum(values * w, axis=-1))
     return jnp.stack(taps, axis=-1)
 
@@ -65,6 +70,5 @@ def sample_rows_zeros(fmap: jax.Array, x: jax.Array) -> jax.Array:
     MXU work with the lerp folded into the weights.
     """
     width = fmap.shape[-2]
-    x = x.astype(fmap.dtype)
-    w = _onehot_lerp_weights(x, width)  # (..., K, W)
+    w = _onehot_lerp_weights(x, width).astype(fmap.dtype)  # (..., K, W)
     return jnp.einsum("...kw,...wd->...kd", w, fmap)

@@ -4,8 +4,9 @@ The reference's only parallelism is single-process ``nn.DataParallel``
 (``train_stereo.py:134``) — replicate/scatter/gather over GPUs. The TPU-native
 equivalent is a sharding annotation, not a subsystem: batch-shard the data over
 a ``Mesh``, replicate params, and let XLA insert the gradient ``psum`` over
-ICI/DCN. A second, optional axis shards the correlation volume's width for
-full-resolution inputs (the 'long-context' analog; SURVEY.md §5).
+ICI/DCN. A second, optional ``space`` axis shards image height — and with it
+the correlation volume — for full-resolution inputs (the 'long-context'
+analog; SURVEY.md §5), with XLA providing the conv halo exchanges.
 """
 
 from raft_stereo_tpu.parallel.mesh import (  # noqa: F401
@@ -13,4 +14,5 @@ from raft_stereo_tpu.parallel.mesh import (  # noqa: F401
     make_mesh,
     replicated,
     shard_batch,
+    spatial_sharding,
 )

@@ -1,14 +1,19 @@
 """Mesh construction and sharding helpers.
 
 Axes:
-- ``data``  — batch data parallelism (the reference's DataParallel equivalent);
-- ``width`` — optional intra-sample sharding of the correlation volume along
-  image width for full-resolution eval (each output row/column block is
-  independent; collectives only at the einsum boundary).
+- ``data``  — batch data parallelism (the reference's DataParallel equivalent,
+  ``train_stereo.py:134``);
+- ``space`` — intra-sample sharding along image height H. Correlation rows are
+  independent (the 1D corr volume ``(B, H, W1, W2)`` and its lookup partition
+  trivially along H), and XLA's SPMD partitioner inserts the halo exchanges
+  the convolutions need — so one sharding annotation scales full-resolution
+  eval (Middlebury-F) past a single chip's HBM. This is the framework's
+  sequence/context-parallel analog: the "sequence" is the epipolar scanline
+  grid (SURVEY §5 long-context).
 
 Multi-host: call ``maybe_distributed_init()`` before device queries; mesh axes
-are laid out so ``data`` spans hosts (DCN) last and ``width`` stays inside the
-ICI domain.
+are laid out so ``space`` stays inside the ICI domain (halo exchanges and
+volume traffic ride ICI) and ``data`` spans hosts over DCN.
 """
 
 from __future__ import annotations
@@ -27,14 +32,14 @@ def maybe_distributed_init() -> None:
         jax.distributed.initialize()
 
 
-def make_mesh(n_data: Optional[int] = None, n_width: int = 1,
+def make_mesh(n_data: Optional[int] = None, n_space: int = 1,
               devices: Optional[Sequence] = None) -> Mesh:
     devices = list(devices if devices is not None else jax.devices())
     if n_data is None:
-        n_data = len(devices) // n_width
-    use = n_data * n_width
-    dev_array = np.asarray(devices[:use]).reshape(n_data, n_width)
-    return Mesh(dev_array, axis_names=("data", "width"))
+        n_data = len(devices) // n_space
+    use = n_data * n_space
+    dev_array = np.asarray(devices[:use]).reshape(n_data, n_space)
+    return Mesh(dev_array, axis_names=("data", "space"))
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
@@ -42,11 +47,34 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P("data"))
 
 
+def spatial_sharding(mesh: Mesh) -> NamedSharding:
+    """Batch over ``data`` and image height over ``space`` (NHWC axis 1)."""
+    return NamedSharding(mesh, P("data", "space"))
+
+
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    """The batch-input sharding for this mesh: batch over ``data``, plus H
+    over ``space`` when that axis is real (>1). Correlation rows are
+    independent along H and XLA inserts conv halo exchanges, so the corr
+    volume — the memory hog — is split 1/n_space per device (the
+    full-resolution eval enabler; SURVEY §5 long-context)."""
+    if mesh.shape.get("space", 1) > 1:
+        return spatial_sharding(mesh)
+    return batch_sharding(mesh)
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def shard_batch(batch, mesh: Mesh):
-    """Device-put a pytree of batch-leading arrays with batch sharded on 'data'."""
-    sharding = batch_sharding(mesh)
+def shard_batch(batch, mesh: Mesh, spatial: Optional[bool] = None):
+    """Device-put a pytree of batch-leading arrays onto the mesh.
+
+    By default the sharding follows ``data_sharding`` (H sharded over
+    ``space`` whenever the mesh has that axis); pass ``spatial`` to force.
+    """
+    if spatial is None:
+        sharding = data_sharding(mesh)
+    else:
+        sharding = spatial_sharding(mesh) if spatial else batch_sharding(mesh)
     return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)

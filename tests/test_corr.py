@@ -69,14 +69,30 @@ def test_reg_matches_naive(fmaps, coords):
     np.testing.assert_allclose(np.asarray(out), ref, atol=1e-4)
 
 
-def test_alt_matches_reg(fmaps, coords):
+@pytest.mark.parametrize("impl", ["alt", "reg_tpu", "reg_cuda", "alt_tpu",
+                                  "alt_cuda"])
+def test_impls_match_reg(fmaps, coords, impl):
     f1, f2 = fmaps
     reg = make_corr_fn("reg", f1, f2, num_levels=LEVELS, radius=RADIUS)(coords)
-    alt = make_corr_fn("alt", f1, f2, num_levels=LEVELS, radius=RADIUS)(coords)
-    np.testing.assert_allclose(np.asarray(alt), np.asarray(reg), atol=1e-4)
+    out = make_corr_fn(impl, f1, f2, num_levels=LEVELS, radius=RADIUS)(coords)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(reg), atol=1e-4)
 
 
-@pytest.mark.parametrize("impl", ["reg", "alt"])
+@pytest.mark.parametrize("impl", ["reg_tpu", "alt_tpu"])
+@pytest.mark.parametrize("w", [200, 376])
+def test_tpu_impls_match_reg_wide(rng, impl, w):
+    """Wide rows exercise the kernels' coarse window-align path (W2p > 128)."""
+    b, h, d = 1, 4, 16
+    f1 = jnp.asarray(rng.standard_normal((b, h, w, d), dtype=np.float32))
+    f2 = jnp.asarray(rng.standard_normal((b, h, w, d), dtype=np.float32))
+    coords = jnp.asarray(
+        rng.uniform(-8, w + 6, size=(b, h, w)).astype(np.float32))
+    reg = make_corr_fn("reg", f1, f2, num_levels=LEVELS, radius=RADIUS)(coords)
+    out = make_corr_fn(impl, f1, f2, num_levels=LEVELS, radius=RADIUS)(coords)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(reg), atol=1e-4)
+
+
+@pytest.mark.parametrize("impl", ["reg", "alt", "reg_tpu", "alt_tpu"])
 def test_grads_flow_to_fmaps(fmaps, coords, impl):
     f1, f2 = fmaps
 
@@ -89,7 +105,7 @@ def test_grads_flow_to_fmaps(fmaps, coords, impl):
     assert float(jnp.abs(g1).max()) > 0 and float(jnp.abs(g2).max()) > 0
 
 
-@pytest.mark.parametrize("impl", ["reg", "alt"])
+@pytest.mark.parametrize("impl", ["reg", "alt", "reg_tpu", "alt_tpu"])
 def test_grad_matches_across_impls(fmaps, coords, impl):
     """reg and alt must have identical gradients (they are the same function)."""
     f1, f2 = fmaps
@@ -106,16 +122,38 @@ def test_grad_matches_across_impls(fmaps, coords, impl):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
+@pytest.mark.parametrize("h,h_chunk", [(64, 16), (40, 16)])
+def test_alt_chunked_matches_reg(rng, h, h_chunk):
+    """The H-chunked lax.map path must reassemble rows in order.
+
+    Regression: chunk slices arrive as (B, h_chunk, ...) already; an extra
+    moveaxis inside the map body scrambled batch/row axes whenever
+    h % h_chunk == 0 (e.g. KITTI eval at H/4 = 96). Covers both the exact
+    multiple and the padded (h % h_chunk != 0) path, with b > 1 and
+    multiple chunks.
+    """
+    b, w = 2, 24
+    f1 = jnp.asarray(rng.standard_normal((b, h, w, D), dtype=np.float32))
+    f2 = jnp.asarray(rng.standard_normal((b, h, w, D), dtype=np.float32))
+    coords = jnp.asarray(
+        rng.uniform(-4, w + 3, size=(b, h, w)).astype(np.float32))
+    reg = make_corr_fn("reg", f1, f2, num_levels=LEVELS, radius=RADIUS)(coords)
+    alt = make_corr_fn("alt", f1, f2, num_levels=LEVELS, radius=RADIUS)(
+        coords, h_chunk=h_chunk)
+    np.testing.assert_allclose(np.asarray(alt), np.asarray(reg), atol=1e-4)
+
+
 def test_pyramid_shapes(fmaps):
     f1, f2 = fmaps
     pyr = build_pyramid(build_volume(f1, f2), LEVELS)
     assert [p.shape[-1] for p in pyr] == [W, W // 2, W // 4, W // 8]
 
 
-def test_lookup_under_jit_and_scan(fmaps, coords):
+@pytest.mark.parametrize("impl", ["reg", "reg_tpu", "alt_tpu"])
+def test_lookup_under_jit_and_scan(fmaps, coords, impl):
     """The closure must be capturable by lax.scan (the GRU-loop requirement)."""
     f1, f2 = fmaps
-    corr_fn = make_corr_fn("reg", f1, f2, num_levels=LEVELS, radius=RADIUS)
+    corr_fn = make_corr_fn(impl, f1, f2, num_levels=LEVELS, radius=RADIUS)
 
     @jax.jit
     def run(coords0):

@@ -387,6 +387,15 @@ def test_loader_batches_and_determinism(tmp_path):
             np.testing.assert_array_equal(x[k], y[k])
 
 
+def test_loader_rejects_zero_batches(tmp_path):
+    """drop_last leaving zero batches must fail fast, not hang train()."""
+    root = str(tmp_path)
+    _make_sceneflow_tree(root, n=3)
+    ds = SceneFlowDatasets(aug_params=None, root=root)
+    with pytest.raises(ValueError, match="zero batches"):
+        StereoLoader(ds, batch_size=8, num_workers=1)
+
+
 def test_loader_epoch_advances_order(tmp_path):
     root = str(tmp_path)
     _make_sceneflow_tree(root, n=5)

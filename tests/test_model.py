@@ -93,7 +93,7 @@ def test_mixed_precision_runs(rng):
     assert preds.dtype == jnp.float32
 
 
-@pytest.mark.parametrize("impl", ["reg", "alt"])
+@pytest.mark.parametrize("impl", ["reg", "alt", "reg_tpu", "alt_tpu"])
 def test_corr_impl_equivalence_end_to_end(rng, impl):
     cfg_reg = RAFTStereoConfig(corr_implementation="reg")
     cfg_imp = RAFTStereoConfig(corr_implementation=impl)

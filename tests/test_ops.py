@@ -121,6 +121,25 @@ def test_sample_1d_zeros_matches_grid_sample(rng):
     np.testing.assert_allclose(np.asarray(out), ref.numpy(), atol=1e-5)
 
 
+def test_sample_1d_zeros_bf16_values_wide_row(rng):
+    """bf16 inputs must not corrupt tap indexing for width > 256.
+
+    Integer positions above 256 are unrepresentable in bf16; weights are
+    computed in fp32 internally so only the final product is bf16-precision.
+    """
+    n, w = 2, 512
+    values = rng.standard_normal((n, w), dtype=np.float32)
+    x = np.array([[300.0, 400.25, 511.0], [257.0, 510.5, 0.5]], np.float32)
+    out = ops.sample_1d_zeros(jnp.asarray(values, jnp.bfloat16),
+                              jnp.asarray(x))
+    x0 = np.floor(x).astype(int)
+    frac = x - x0
+    ref = (values[np.arange(n)[:, None], x0] * (1 - frac)
+           + values[np.arange(n)[:, None], np.minimum(x0 + 1, w - 1)] * frac)
+    np.testing.assert_allclose(np.asarray(out, np.float32), ref,
+                               atol=0.05, rtol=0.05)
+
+
 def test_sample_rows_zeros_matches_grid_sample(rng):
     n, w, d, k = 4, 12, 5, 7
     fmap = rng.standard_normal((n, w, d), dtype=np.float32)
@@ -185,3 +204,93 @@ def test_input_padder_bucketing():
     padder = ops.InputPadder((1, 375, 1242, 3), divis_by=32, bucket=64)
     ph, pw = padder.padded_shape
     assert ph % 64 == 0 and pw % 64 == 0
+
+
+# ---------------------------------------------------------------------------
+# dead-in-reference parity stubs: SepConvGRU + BottleneckBlock
+# ---------------------------------------------------------------------------
+
+def _conv_p(m):
+    return {"w": jnp.asarray(m.weight.detach().numpy().transpose(2, 3, 1, 0)),
+            "b": jnp.asarray(m.bias.detach().numpy())}
+
+
+def test_sep_conv_gru_matches_torch(rng):
+    """Oracle for the ported-but-unused SepConvGRU (ref core/update.py:34-62)."""
+    from raft_stereo_tpu.models.update import apply_sep_conv_gru
+
+    hidden, cin = 16, 24
+    convs = {}
+    torch.manual_seed(3)
+    for name, k, pad in [("convz1", (1, 5), (0, 2)), ("convr1", (1, 5), (0, 2)),
+                         ("convq1", (1, 5), (0, 2)), ("convz2", (5, 1), (2, 0)),
+                         ("convr2", (5, 1), (2, 0)), ("convq2", (5, 1), (2, 0))]:
+        convs[name] = torch.nn.Conv2d(hidden + cin, hidden, k, padding=pad)
+
+    h0 = rng.standard_normal((2, 6, 7, hidden), dtype=np.float32)
+    x = rng.standard_normal((2, 6, 7, cin), dtype=np.float32)
+
+    ht = j2t(h0)
+    xt = j2t(x)
+    with torch.no_grad():
+        for suffix in ("1", "2"):
+            hx = torch.cat([ht, xt], dim=1)
+            z = torch.sigmoid(convs["convz" + suffix](hx))
+            r = torch.sigmoid(convs["convr" + suffix](hx))
+            q = torch.tanh(convs["convq" + suffix](torch.cat([r * ht, xt], dim=1)))
+            ht = (1 - z) * ht + z * q
+
+    p = {name: _conv_p(m) for name, m in convs.items()}
+    out = apply_sep_conv_gru(p, jnp.asarray(h0), jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(out), t2j(ht), atol=1e-5)
+
+
+@pytest.mark.parametrize("norm_fn,stride", [("group", 1), ("group", 2),
+                                            ("instance", 2), ("none", 1)])
+def test_bottleneck_block_matches_torch(rng, norm_fn, stride):
+    """Oracle for the ported-but-unused BottleneckBlock (ref extractor.py:64-120)."""
+    from raft_stereo_tpu.models.layers import apply_bottleneck_block
+
+    # Reference quirk: downsample exists only for stride != 1, so stride-1
+    # blocks require in_planes == planes (extractor.py:103-108).
+    planes = 16
+    in_planes = planes if stride == 1 else 12
+    torch.manual_seed(4)
+    conv1 = torch.nn.Conv2d(in_planes, planes // 4, 1)
+    conv2 = torch.nn.Conv2d(planes // 4, planes // 4, 3, padding=1, stride=stride)
+    conv3 = torch.nn.Conv2d(planes // 4, planes, 1)
+    groups = planes // 8
+
+    def norm(c):
+        if norm_fn == "group":
+            return torch.nn.GroupNorm(groups, c)
+        if norm_fn == "instance":
+            return torch.nn.InstanceNorm2d(c)
+        return torch.nn.Identity()
+
+    n1, n2, n3, n4 = norm(planes // 4), norm(planes // 4), norm(planes), norm(planes)
+    down = torch.nn.Conv2d(in_planes, planes, 1, stride=stride) if stride != 1 else None
+
+    x = rng.standard_normal((2, 8, 10, in_planes), dtype=np.float32)
+    xt = j2t(x)
+    with torch.no_grad():
+        y = torch.relu(n1(conv1(xt)))
+        y = torch.relu(n2(conv2(y)))
+        y = torch.relu(n3(conv3(y)))
+        sc = n4(down(xt)) if down is not None else xt
+        ref = torch.relu(sc + y)
+
+    def norm_p(m, c):
+        if norm_fn == "group":
+            return {"scale": jnp.asarray(m.weight.detach().numpy()),
+                    "bias": jnp.asarray(m.bias.detach().numpy())}
+        return {}
+
+    p = {"conv1": _conv_p(conv1), "conv2": _conv_p(conv2), "conv3": _conv_p(conv3),
+         "norm1": norm_p(n1, planes // 4), "norm2": norm_p(n2, planes // 4),
+         "norm3": norm_p(n3, planes)}
+    if down is not None:
+        p["downsample"] = {"conv": _conv_p(down), "norm": norm_p(n4, planes)}
+
+    out = apply_bottleneck_block(p, jnp.asarray(x), norm_fn, stride=stride)
+    np.testing.assert_allclose(np.asarray(out), t2j(ref), atol=1e-5)
