@@ -55,7 +55,6 @@ from jax.experimental.pallas import tpu as pltpu
 from raft_stereo_tpu.corr.reg import build_pyramid, build_volume
 
 LANE = 128
-ALIGN = 64  # window-start alignment; any (2r+2)<=64 tap window fits
 TILE = 256  # pixels per grid cell
 
 
@@ -65,8 +64,8 @@ def _interpret() -> bool:
 
 
 def pad_width(w: int) -> int:
-    """Smallest 64-multiple >= max(w, 128)."""
-    return max(LANE, -(-w // ALIGN) * ALIGN)
+    """Smallest vreg-width (128) multiple >= w."""
+    return -(-w // LANE) * LANE
 
 
 def gather_lerp_taps(vol, cl, radius: int, w2: int):
@@ -82,22 +81,34 @@ def gather_lerp_taps(vol, cl, radius: int, w2: int):
     i0 = jnp.floor(cl)
     frac = cl - i0  # (P, 1)
     base = i0.astype(jnp.int32) - radius  # first tap position
-    if w2p > LANE:
-        # Coarse align: pick the 64-aligned 128-lane window containing all
-        # 2r+2 taps (select-scan; ~2 VPU ops per element, once per level).
-        start = jnp.clip((base // ALIGN) * ALIGN, 0, w2p - LANE)
-        window = vol[:, 0:LANE]
-        for cand in range(ALIGN, w2p - LANE + 1, ALIGN):
-            window = jnp.where(start == cand, vol[:, cand:cand + LANE],
-                               window)
-    else:
-        start = jnp.zeros_like(base)
-        window = vol
-    # Fine gather: Mosaic's take_along_axis works on exactly one 128-lane
-    # vreg; lane t then holds tap t.
-    idx = jnp.clip(base - start + lane, 0, LANE - 1)
-    g = jnp.take_along_axis(window, idx, axis=-1)
     xpos = base + lane  # true tap position in the row
+    if w2p > LANE:
+        # Coarse: select the two vreg-aligned 128-lane slabs bracketing the
+        # tap window (select-scans over aligned slices only — no cross-vreg
+        # relayouts; ~2 VPU ops per element per scan, once per level).
+        nslab = w2p // LANE
+        slab = jnp.clip(base // LANE, 0, nslab - 1)
+        slab_b = jnp.minimum(slab + 1, nslab - 1)
+        win_a = vol[:, 0:LANE]
+        win_b = vol[:, (nslab - 1) * LANE:]
+        for s in range(1, nslab):
+            win_a = jnp.where(slab == s, vol[:, s * LANE:(s + 1) * LANE],
+                              win_a)
+        for s in range(1, nslab - 1):
+            win_b = jnp.where(slab_b == s, vol[:, s * LANE:(s + 1) * LANE],
+                              win_b)
+        # Fine: Mosaic's take_along_axis works on exactly one 128-lane vreg;
+        # the 2r+2-tap window may straddle the slab boundary, so gather both
+        # slabs and select per tap. Lane t then holds tap t.
+        rel = base - slab * LANE + lane  # [0, 128+2r+1] when in range
+        g_a = jnp.take_along_axis(win_a, jnp.clip(rel, 0, LANE - 1), axis=-1)
+        g_b = jnp.take_along_axis(win_b, jnp.clip(rel - LANE, 0, LANE - 1),
+                                  axis=-1)
+        g = jnp.where(rel < LANE, g_a, g_b)
+        # rel >= 128 with slab_b == slab reads the wrong slab, but then
+        # xpos >= w2p >= w2, so the bounds mask below zeroes it.
+    else:
+        g = jnp.take_along_axis(vol, jnp.clip(xpos, 0, LANE - 1), axis=-1)
     g = jnp.where((xpos >= 0) & (xpos < w2), g, 0.0)
     return g[:, :k] * (1.0 - frac) + g[:, 1:k + 1] * frac
 

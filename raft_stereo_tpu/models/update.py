@@ -43,17 +43,46 @@ def init_conv_gru(key, hidden_dim: int, input_dim: int, kernel_size: int = 3) ->
             "convq": init_conv(kq, kernel_size, kernel_size, cin, hidden_dim)}
 
 
+def _split_conv(w: jax.Array, b, parts: Sequence[jax.Array],
+                pad: int) -> jax.Array:
+    """conv(concat(parts), w) as a sum of per-part convs.
+
+    Algebraically identical (channel-blocked matmul), but never materializes
+    the concatenated input: at Middlebury-F resolution the concat + layout
+    copy + pad for each gate conv accounted for ~25% of frame time in the
+    profile (HBM-bound data movement the MXU waits on).
+    """
+    from raft_stereo_tpu.ops.basic import conv2d
+    off = 0
+    out = None
+    for t in parts:
+        c = t.shape[-1]
+        y = conv2d(t, jax.lax.slice_in_dim(w, off, off + c, axis=2), None,
+                   padding=pad)
+        out = y if out is None else out + y
+        off += c
+    return out if b is None else out + b.astype(out.dtype)
+
+
 def apply_conv_gru(p: Params, h: jax.Array, context: Sequence[jax.Array],
                    *x_list: jax.Array) -> jax.Array:
-    """context = (cz, cr, cq) additive gate biases (``core/update.py:23-32``)."""
+    """context = (cz, cr, cq) additive gate biases (``core/update.py:23-32``).
+
+    TPU formulation: the z and r gates share one fused conv pair (their
+    weights concatenated along the output channels) and every gate conv is
+    split over its input parts instead of concatenating them — same
+    arithmetic, no materialized ``[h; x]`` tensors in the scan body.
+    """
     cz, cr, cq = context
     pad = p["convz"]["w"].shape[0] // 2
-    x = jnp.concatenate(x_list, axis=-1) if len(x_list) > 1 else x_list[0]
-    hx = jnp.concatenate([h, x], axis=-1)
-    z = jax.nn.sigmoid(apply_conv(p["convz"], hx, padding=pad) + cz)
-    r = jax.nn.sigmoid(apply_conv(p["convr"], hx, padding=pad) + cr)
-    q = jnp.tanh(apply_conv(p["convq"], jnp.concatenate([r * h, x], axis=-1),
-                            padding=pad) + cq)
+    ch = h.shape[-1]
+    wzr = jnp.concatenate([p["convz"]["w"], p["convr"]["w"]], axis=-1)
+    bzr = jnp.concatenate([p["convz"]["b"], p["convr"]["b"]])
+    a = _split_conv(wzr, bzr, (h, *x_list), pad)
+    z = jax.nn.sigmoid(a[..., :ch] + cz)
+    r = jax.nn.sigmoid(a[..., ch:] + cr)
+    q = jnp.tanh(_split_conv(p["convq"]["w"], p["convq"]["b"],
+                             (r * h, *x_list), pad) + cq)
     return (1 - z) * h + z * q
 
 
@@ -97,9 +126,12 @@ def apply_motion_encoder(p: Params, flow: jax.Array, corr: jax.Array) -> jax.Arr
     cor = jax.nn.relu(apply_conv(p["convc2"], cor, padding=1))
     flo = jax.nn.relu(apply_conv(p["convf1"], flow, padding=3))
     flo = jax.nn.relu(apply_conv(p["convf2"], flo, padding=1))
-    out = jax.nn.relu(apply_conv(p["conv"], jnp.concatenate([cor, flo], axis=-1),
-                                 padding=1))
-    return jnp.concatenate([out, flow], axis=-1)
+    out = jax.nn.relu(_split_conv(p["conv"]["w"], p["conv"]["b"], (cor, flo),
+                                  pad=1))
+    # Motion features are (fused 126ch, raw 2ch flow) — returned as parts;
+    # the consuming gate convs split over parts, so the reference's channel
+    # order (update.py:85) is preserved without materializing the concat.
+    return out, flow
 
 
 def init_update_block(key, cfg: RAFTStereoConfig) -> Params:
@@ -144,12 +176,12 @@ def apply_update_block(p: Params, cfg: RAFTStereoConfig,
         else:
             net[1] = apply_conv_gru(p["gru16"], net[1], inp[1], pool2x(net[0]))
     if iter08:
-        motion_features = apply_motion_encoder(p["encoder"], flow, corr)
+        motion_parts = apply_motion_encoder(p["encoder"], flow, corr)
         if n > 1:
-            net[0] = apply_conv_gru(p["gru08"], net[0], inp[0], motion_features,
+            net[0] = apply_conv_gru(p["gru08"], net[0], inp[0], *motion_parts,
                                     interp_align_corners(net[1], net[0].shape[1:3]))
         else:
-            net[0] = apply_conv_gru(p["gru08"], net[0], inp[0], motion_features)
+            net[0] = apply_conv_gru(p["gru08"], net[0], inp[0], *motion_parts)
     net = tuple(net)
     if not update:
         return net

@@ -1,0 +1,31 @@
+import sys, glob, gzip, json, collections, re
+tdir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/trace_full"
+files = glob.glob(f"{tdir}/**/*.trace.json.gz", recursive=True)
+ev = json.load(gzip.open(sorted(files)[-1]))["traceEvents"]
+pids = {}
+for e in ev:
+    if e.get("ph") == "M" and e.get("name") == "process_name":
+        pids[e["pid"]] = e["args"]["name"]
+
+cat = collections.Counter()
+top = collections.Counter()
+total = 0.0
+for e in ev:
+    if e.get("ph") != "X" or "dur" not in e:
+        continue
+    if "TPU" not in pids.get(e.get("pid"), ""):
+        continue
+    name = str(e.get("name", ""))
+    if name.startswith(("jit_", "while")):  # module/control wrappers double-count
+        continue
+    base = re.sub(r"[.\d]+$", "", name)
+    cat[base] += e["dur"]
+    top[name] += e["dur"]
+    total += e["dur"]
+
+print(f"device op total: {total/1e3:.1f} ms")
+for name, dur in cat.most_common(15):
+    print(f"{dur/1e3:9.2f} ms  {100*dur/total:5.1f}%  {name}")
+print("\ntop individual ops:")
+for name, dur in top.most_common(15):
+    print(f"{dur/1e3:9.2f} ms  {name[:80]}")

@@ -1,0 +1,44 @@
+"""Time the torch reference forward on CPU (no GPU exists in this image).
+
+Protocol: reference evaluate_stereo.py:77-81 — time model(image1, image2,
+iters=32, test_mode=True); here warmup is one small-shape run (no jit/caching
+effects on CPU beyond first-touch allocs). CPU-labeled datum for BASELINE.md.
+"""
+import sys, time, json
+sys.path.insert(0, "/root/reference")
+import argparse
+import numpy as np
+import torch
+
+torch.set_num_threads(1)  # the image has 1 core
+
+from core.raft_stereo import RAFTStereo
+
+args = argparse.Namespace(corr_implementation="reg", shared_backbone=False,
+                          corr_levels=4, corr_radius=4, n_downsample=2,
+                          slow_fast_gru=False, n_gru_layers=3,
+                          hidden_dims=[128, 128, 128], mixed_precision=False)
+torch.manual_seed(1234)
+model = RAFTStereo(args)
+model.eval()
+
+rng = np.random.default_rng(0)
+
+def run(h, w, iters=32, frames=1, label=""):
+    times = []
+    for _ in range(frames):
+        i1 = torch.from_numpy(rng.uniform(0, 255, (1, 3, h, w)).astype(np.float32))
+        i2 = torch.from_numpy(rng.uniform(0, 255, (1, 3, h, w)).astype(np.float32))
+        t0 = time.perf_counter()
+        with torch.no_grad():
+            out = model(i1, i2, iters=iters, test_mode=True)
+        dt = time.perf_counter() - t0
+        times.append(dt)
+        print(f"{label} {h}x{w} iters={iters}: {dt:.1f}s "
+              f"({1/dt:.4f} fps) checksum={float(out[1].sum()):.3f}", flush=True)
+    return times
+
+run(64, 96, iters=2, frames=1, label="warmup")
+run(512, 736, frames=1, label="mid")
+run(1024, 1504, frames=1, label="kittiish")
+run(2016, 2976, frames=2, label="middlebury_F")

@@ -1,0 +1,29 @@
+import sys, os; sys.path.insert(0, "/root/repo")
+import time, numpy as np, jax, jax.numpy as jnp
+from raft_stereo_tpu.corr import make_corr_fn
+
+def bench(impl, B, H, W, D=256, iters=32):
+    rng = np.random.default_rng(0)
+    f1 = jnp.asarray(rng.standard_normal((B, H, W, D)), jnp.float32)
+    f2 = jnp.asarray(rng.standard_normal((B, H, W, D)), jnp.float32)
+    c0 = jnp.asarray(rng.uniform(0, W - 1, size=(B, H, W)), jnp.float32)
+    @jax.jit
+    def run(c):
+        fn = make_corr_fn(impl, f1, f2, num_levels=4, radius=4)
+        def step(c, _):
+            out = fn(c)
+            return c + 0.07, jnp.mean(out)
+        _, ys = jax.lax.scan(step, c, None, length=iters)
+        return jnp.sum(ys)
+    float(run(c0))  # compile+warm
+    best = 1e9
+    for _ in range(3):
+        t0 = time.perf_counter(); float(run(c0)); t1 = time.perf_counter()
+        best = min(best, t1 - t0)
+    print(f"{impl:8s} H={H} W={W}: {best*1e3:7.1f} ms for {iters} lookups "
+          f"({best*1e3/iters:6.2f} ms/lookup)", flush=True)
+
+for impl in ("reg", "reg_tpu"):
+    bench(impl, 1, 256, 376)   # 1024x1504 quarter-res
+for impl in ("reg", "reg_tpu"):
+    bench(impl, 1, 504, 744)   # Middlebury-F quarter-res

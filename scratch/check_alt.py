@@ -1,0 +1,18 @@
+import sys; sys.path.insert(0, "/root/repo")
+import numpy as np, jax, jax.numpy as jnp
+from raft_stereo_tpu.corr import make_corr_fn
+
+rng = np.random.default_rng(0)
+for (B, H, W, D) in [(2, 6, 32, 16), (1, 4, 376, 32)]:
+    f1 = jnp.asarray(rng.standard_normal((B, H, W, D), dtype=np.float32))
+    f2 = jnp.asarray(rng.standard_normal((B, H, W, D), dtype=np.float32))
+    coords = jnp.asarray(rng.uniform(-8, W + 6, size=(B, H, W)).astype(np.float32))
+    reg = make_corr_fn("reg", f1, f2, num_levels=4, radius=4)(coords)
+    alt = make_corr_fn("alt_tpu", f1, f2, num_levels=4, radius=4)(coords)
+    print(f"W={W}:", np.abs(np.asarray(alt) - np.asarray(reg)).max(), flush=True)
+    def loss(f1, f2, impl):
+        fn = make_corr_fn(impl, f1, f2, num_levels=4, radius=4)
+        return jnp.mean(fn(coords) ** 2)
+    gr = jax.grad(loss, (0, 1))(f1, f2, "reg")
+    gt = jax.grad(loss, (0, 1))(f1, f2, "alt_tpu")
+    print("  grad diff:", max(float(jnp.abs(a - b).max()) for a, b in zip(gr, gt)), flush=True)

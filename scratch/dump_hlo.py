@@ -1,0 +1,25 @@
+import sys; sys.path.insert(0, "/root/repo")
+import os, re
+import numpy as np, jax, jax.numpy as jnp
+from raft_stereo_tpu.config import RAFTStereoConfig
+from raft_stereo_tpu.models import init_raft_stereo, raft_stereo_forward
+
+h, w = int(os.environ.get("H", 2016)), int(os.environ.get("W", 2976))
+corr = os.environ.get("CORR", "reg_tpu")
+cfg = RAFTStereoConfig(corr_implementation=corr, mixed_precision=True)
+params = init_raft_stereo(jax.random.PRNGKey(0), cfg)
+
+def forward(params, image1, image2):
+    _, flow_up = raft_stereo_forward(params, cfg, image1, image2,
+                                     iters=32, test_mode=True)
+    return flow_up, jnp.sum(flow_up)
+
+img = jnp.zeros((1, h, w, 3), jnp.float32)
+lowered = jax.jit(forward).lower(params, img, img)
+txt = lowered.compile().as_text()
+open("/tmp/hlo_full.txt", "w").write(txt)
+print("bytes:", len(txt))
+for name in sys.argv[1:]:
+    for line in txt.splitlines():
+        if f"%{name} " in line or f" {name} =" in line or line.strip().startswith(name + " ="):
+            print(line.strip()[:300]); break

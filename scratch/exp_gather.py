@@ -1,0 +1,62 @@
+import sys, os; sys.path.insert(0, "/root/repo")
+"""Experiment: which dynamic-gather forms compile in Mosaic on this TPU.
+
+Candidates for the corr-lookup kernel's inner gather:
+ A) jnp.take_along_axis(vol, idx, axis=-1)  — per-row dynamic gather along lanes
+ B) vol row one-hot reduce (the XLA fallback, known to work)
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+P, W2, K = 128, 256, 16
+
+
+def kernel_taa(vol_ref, idx_ref, out_ref):
+    out_ref[:] = jnp.take_along_axis(vol_ref[:], idx_ref[:], axis=-1)
+
+
+def run_taa():
+    vol = jnp.asarray(np.random.default_rng(0).standard_normal((P, W2)), jnp.float32)
+    idx = jnp.asarray(np.random.default_rng(1).integers(0, W2, (P, K)), jnp.int32)
+    out = pl.pallas_call(
+        kernel_taa,
+        out_shape=jax.ShapeDtypeStruct((P, K), jnp.float32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+    )(vol, idx)
+    ref = np.take_along_axis(np.asarray(vol), np.asarray(idx), axis=-1)
+    np.testing.assert_allclose(np.asarray(out), ref)
+    print("A) take_along_axis lanes: OK")
+
+
+def kernel_taa_sub(vol_ref, idx_ref, out_ref):
+    # gather along sublanes (axis 0): out[k, w] = vol[idx[k, w], w]
+    out_ref[:] = jnp.take_along_axis(vol_ref[:], idx_ref[:], axis=0)
+
+
+def run_taa_sub():
+    vol = jnp.asarray(np.random.default_rng(0).standard_normal((P, W2)), jnp.float32)
+    idx = jnp.asarray(np.random.default_rng(1).integers(0, P, (K, W2)), jnp.int32)
+    out = pl.pallas_call(
+        kernel_taa_sub,
+        out_shape=jax.ShapeDtypeStruct((K, W2), jnp.float32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+    )(vol, idx)
+    ref = np.take_along_axis(np.asarray(vol), np.asarray(idx), axis=0)
+    np.testing.assert_allclose(np.asarray(out), ref)
+    print("B) take_along_axis sublanes: OK")
+
+
+if __name__ == "__main__":
+    print(jax.devices())
+    for name, fn in [("A", run_taa), ("B", run_taa_sub)]:
+        try:
+            fn()
+        except Exception as e:  # noqa: BLE001
+            print(f"{name}) FAILED: {type(e).__name__}: {str(e)[:500]}")

@@ -1,0 +1,37 @@
+import sys; sys.path.insert(0, "/root/repo")
+import glob, gzip, json, time, collections
+import numpy as np, jax, jax.numpy as jnp
+from raft_stereo_tpu.corr import make_corr_fn
+
+impl = sys.argv[1] if len(sys.argv) > 1 else "reg_tpu"
+B, H, W, D, iters = 1, int(sys.argv[2]) if len(sys.argv)>2 else 64, int(sys.argv[3]) if len(sys.argv)>3 else 376, 256, 8
+rng = np.random.default_rng(0)
+f1 = jnp.asarray(rng.standard_normal((B, H, W, D)), jnp.float32)
+f2 = jnp.asarray(rng.standard_normal((B, H, W, D)), jnp.float32)
+c0 = jnp.asarray(rng.uniform(0, W - 1, size=(B, H, W)), jnp.float32)
+
+@jax.jit
+def run(c):
+    fn = make_corr_fn(impl, f1, f2, num_levels=4, radius=4)
+    def step(c, _):
+        out = fn(c)
+        return c + 0.07, jnp.mean(out)
+    _, ys = jax.lax.scan(step, c, None, length=iters)
+    return jnp.sum(ys)
+
+float(run(c0))
+tdir = f"/tmp/prof_{impl}"
+with jax.profiler.trace(tdir):
+    float(run(c0))
+
+# Parse the perfetto trace: sum durations by op name on the device track.
+files = glob.glob(f"{tdir}/**/*.trace.json.gz", recursive=True)
+ev = json.load(gzip.open(sorted(files)[-1]))["traceEvents"]
+tot = collections.Counter()
+for e in ev:
+    if e.get("ph") == "X" and "dur" in e:
+        name = e.get("name", "")
+        pid = e.get("pid", 0)
+        tot[name] += e["dur"]
+for name, dur in tot.most_common(25):
+    print(f"{dur/1e3:9.2f} ms  {name[:110]}")

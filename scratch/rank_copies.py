@@ -1,0 +1,20 @@
+import re, collections
+txt = open("/tmp/hlo_full.txt").read()
+sizes = collections.Counter()
+where = {}
+for m in re.finditer(r"%(copy[-.\w]*\d+) = (\w+)\[([\d,]*)\][^\n]*", txt):
+    name, dt, dims = m.group(1), m.group(2), m.group(3)
+    n = 1
+    for d in dims.split(","):
+        if d:
+            n *= int(d)
+    b = n * (2 if dt == "bf16" else 4)
+    key = (dt, dims)
+    sizes[key] += b
+    mm = re.search(r'source_file="([^"]+)" source_line=(\d+)', m.group(0))
+    op = re.search(r'op_name="([^"]+)"', m.group(0))
+    where[key] = ((mm.group(1).split("/")[-1] + ":" + mm.group(2)) if mm else "?",
+                  op.group(1)[:60] if op else "?")
+for key, b in sizes.most_common(12):
+    dt, dims = key
+    print(f"{b/1e6:8.1f} MB  {dt}[{dims}]  {where[key]}")
