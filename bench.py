@@ -35,7 +35,9 @@ def main() -> None:
     w = int(os.environ.get("RAFT_BENCH_W", 2976))
     iters = int(os.environ.get("RAFT_BENCH_ITERS", 32))
     n_frames = int(os.environ.get("RAFT_BENCH_FRAMES", 5))
-    corr = os.environ.get("RAFT_BENCH_CORR", "reg")
+    # Default to the Pallas lookup kernel — the north-star config and the
+    # fastest measured path (BASELINE.md measured table).
+    corr = os.environ.get("RAFT_BENCH_CORR", "reg_tpu")
     mixed = os.environ.get("RAFT_BENCH_MP", "1").strip().lower() not in (
         "0", "false", "no", "off")
 

@@ -14,24 +14,33 @@ addressing):
   lane axis of a single vreg — the index and operand must both be
   ``(sublanes, 128)``. The ``2r+2`` taps of one pixel are *contiguous*
   integers, so the whole tap window fits in one 128-lane vreg.
-- Per pixel: (1) **coarse align** — select the 64-aligned 128-lane window
-  of the volume row that contains ``[i0-r, i0+r+1]`` (a 10-wide window
-  can never straddle a 64-aligned 128-window). This is an unrolled
-  select-scan over ``W2/64`` candidates: ~2 VPU ops per volume element,
-  versus ~3 ops *per tap* per element for the one-hot fallback — an
-  order of magnitude less VPU work. (2) **fine gather** — one
-  ``take_along_axis`` with ``idx = clip(i0 - r - start + lane, 0, 127)``
-  yields all taps at lanes ``0..2r+1``. (3) mask out-of-range taps to
-  zero (``grid_sample`` zero-padding semantics), lerp adjacent lanes.
+- Per pixel: (1) **coarse align** — select the two vreg-aligned 128-lane
+  slabs of the volume row that bracket the tap window ``[i0-r, i0+r+1]``
+  (the window may straddle a slab boundary, so both the slab containing
+  the first tap and its successor are selected). Each selection is an
+  unrolled select-scan over the row's ``W2p/128`` aligned slabs: ~2 VPU
+  ops per volume element per scan, versus ~3 ops *per tap* per element
+  for the one-hot fallback — an order of magnitude less VPU work.
+  (2) **fine gather** — one ``take_along_axis`` per slab with the
+  window-relative lane index, then a per-tap select by whether the tap
+  falls in the first or second slab, leaving tap ``t`` at lane ``t``.
+  (3) mask out-of-range taps to zero (``grid_sample`` zero-padding
+  semantics), lerp adjacent lanes.
 - Grid is over flattened pixel tiles ``(B*H*W1) / TILE``; pyramid levels
   stream HBM->VMEM via BlockSpec pipelining. Output rows are pixels, so
   partial boundary tiles are safe: garbage rows never contaminate real
   rows (the gather is row-local) and are sliced off at the end.
 
-Width padding: fmap2 is zero-padded to a 64-multiple >= 128 *before* the
+Width padding: fmap2 is zero-padded to a 128-multiple *before* the
 volume einsum, so no post-hoc volume copy is needed; per-level true
 widths (successive floor halving of the original W2) bound the tap mask,
 which also hides the pooled-boundary artifact when a level width is odd.
+
+Precision: the pyramid is stored in the feature-map dtype (bf16 under the
+mixed-precision policy — the analog of the reference's fp16-capable CUDA
+sampler, ``sampler_kernel.cu:126``) and upcast to fp32 inside the kernel,
+so lerp arithmetic is fp32 and volume HBM traffic — the lookup's cost —
+is halved. The fp32 path stores fp32 and is exact.
 
 Backward (training): ``custom_vjp`` — gradient flows to the volume only,
 none to coords, exactly like the CUDA sampler (``core/corr.py:24-29``
@@ -71,11 +80,19 @@ def pad_width(w: int) -> int:
 def gather_lerp_taps(vol, cl, radius: int, w2: int):
     """Windowed-gather + lerp over one level's rows held in VMEM/registers.
 
-    vol: (P, W2p) fp32 rows; cl: (P, 1) fp32 level-scaled positions.
+    vol: (P, W2p) rows, any float dtype (upcast to fp32 here so the lerp
+    arithmetic is always fp32); cl: (P, 1) fp32 level-scaled positions.
     Returns (P, 2r+1) lerped taps with zero-pad semantics. Shared by the
     reg_tpu (volume-resident) and alt_tpu (fused on-the-fly) kernels.
     """
+    vol = vol.astype(jnp.float32)
     p, w2p = vol.shape
+    if w2p % LANE:
+        # Lane-pad to a vreg multiple in VMEM (callers with HBM-resident
+        # rows pre-pad instead; in-kernel pooled rows land here).
+        vol = jnp.concatenate(
+            [vol, jnp.zeros((p, LANE - w2p % LANE), vol.dtype)], axis=-1)
+        w2p = vol.shape[-1]
     k = 2 * radius + 1
     lane = jax.lax.broadcasted_iota(jnp.int32, (p, LANE), 1)
     i0 = jnp.floor(cl)
@@ -164,10 +181,11 @@ def _masked_lookup_xla(pyramid: Sequence[jax.Array], coords_flat: jax.Array,
         base = i0 - radius
         j = jnp.arange(w2p, dtype=jnp.float32)
         valid_j = j < w2
+        vol32 = vol.astype(jnp.float32)  # match the kernel's fp32 lerp
         taps = []
         for t in range(2 * radius + 2):
-            onehot = ((j == base + t) & valid_j).astype(vol.dtype)
-            taps.append(jnp.sum(vol * onehot, axis=-1))
+            onehot = ((j == base + t) & valid_j).astype(jnp.float32)
+            taps.append(jnp.sum(vol32 * onehot, axis=-1))
         g = jnp.stack(taps, axis=-1)  # (N, 2r+2)
         out.append(g[:, :-1] * (1.0 - frac) + g[:, 1:] * frac)
     return jnp.concatenate(out, axis=-1)
@@ -209,7 +227,10 @@ def make_reg_tpu_corr_fn(fmap1: jax.Array, fmap2: jax.Array, *,
     widths = level_widths(w2, num_levels)
     # Zero-pad fmap2's width before the einsum: the padded volume region is
     # exactly zero, so no post-hoc volume copy; deeper levels whose pooled
-    # width falls under one vreg get a (cheap) per-level re-pad.
+    # width falls under one vreg get a (cheap) per-level re-pad. The pyramid
+    # is stored in the fmap dtype (bf16 under mixed precision — halves the
+    # lookup's HBM traffic; the kernel upcasts rows to fp32 for the lerp).
+    store_dtype = fmap1.dtype
     f2p = jnp.pad(fmap2, ((0, 0), (0, 0), (0, pad_width(w2) - w2), (0, 0)))
     pyramid = build_pyramid(build_volume(fmap1, f2p), num_levels)
     flat = []
@@ -220,7 +241,7 @@ def make_reg_tpu_corr_fn(fmap1: jax.Array, fmap2: jax.Array, *,
             vol = jnp.pad(vol, ((0, 0), (0, 0), (0, 0), (0, want - wp)))
         elif wp > want:
             vol = vol[..., :want]
-        flat.append(vol.reshape(b * h * w1, -1))
+        flat.append(vol.reshape(b * h * w1, -1).astype(store_dtype))
 
     def corr_fn(coords_x: jax.Array) -> jax.Array:
         n = b * h * w1

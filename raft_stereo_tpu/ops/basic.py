@@ -30,11 +30,13 @@ def _pad_pair(padding: Padding) -> Tuple[Tuple[int, int], Tuple[int, int]]:
 
 def conv2d(x: jax.Array, w: jax.Array, b: jax.Array | None = None, *,
            stride: Union[int, Tuple[int, int]] = 1,
-           padding: Padding = 0) -> jax.Array:
+           padding: Padding = 0, out_dtype=None) -> jax.Array:
     """2D convolution, NHWC input, HWIO kernel, torch-style symmetric padding.
 
     The conv runs in the dtype of ``x`` (bf16 under the mixed-precision policy)
-    with fp32 accumulation on the MXU via ``preferred_element_type``.
+    with fp32 accumulation on the MXU via ``preferred_element_type``. The
+    result is cast back to ``x.dtype`` unless ``out_dtype`` keeps the fp32
+    accumulator (callers that sum several partial convs downcast once).
     """
     if isinstance(stride, int):
         stride = (stride, stride)
@@ -44,7 +46,7 @@ def conv2d(x: jax.Array, w: jax.Array, b: jax.Array | None = None, *,
         preferred_element_type=jnp.float32)
     if b is not None:
         out = out + b.astype(jnp.float32)
-    return out.astype(x.dtype)
+    return out.astype(x.dtype if out_dtype is None else out_dtype)
 
 
 def frozen_batch_norm(x: jax.Array, params: dict, *, eps: float = 1e-5) -> jax.Array:

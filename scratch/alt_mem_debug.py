@@ -1,0 +1,19 @@
+import sys; sys.path.insert(0, "/root/repo")
+import re
+import jax, jax.numpy as jnp
+from raft_stereo_tpu.corr import make_corr_fn
+
+b, h, w, d = 1, 504, 744, 256
+args = (jax.ShapeDtypeStruct((b, h, w, d), jnp.float32),
+        jax.ShapeDtypeStruct((b, h, w, d), jnp.float32),
+        jax.ShapeDtypeStruct((b, h, w), jnp.float32))
+compiled = jax.jit(lambda f1, f2, c: make_corr_fn("alt_tpu", f1, f2,
+                    num_levels=4, radius=4)(c)).lower(*args).compile()
+ma = compiled.memory_analysis()
+print("temp:", ma.temp_size_in_bytes/1e6, "MB  out:", ma.output_size_in_bytes/1e6)
+txt = compiled.as_text()
+# find big allocations in buffer assignment dump if present; else grep fusion shapes
+for m in sorted(set(re.findall(r"f32\[[\d,]+\]", txt)), key=lambda s: -eval(s[4:-1].replace(",", "*") or "0"))[:12]:
+    sz = eval(m[4:-1].replace(",", "*")) * 4 / 1e6
+    if sz > 20:
+        print(f"{sz:10.1f} MB  {m}  x{txt.count(m)}")

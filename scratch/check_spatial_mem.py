@@ -1,0 +1,25 @@
+import sys; sys.path.insert(0, "/root/repo")
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np, jax.numpy as jnp
+from raft_stereo_tpu.config import RAFTStereoConfig
+from raft_stereo_tpu.models import init_raft_stereo
+from raft_stereo_tpu.engine.steps import make_eval_step
+from raft_stereo_tpu.parallel.mesh import make_mesh, shard_batch
+
+cfg = RAFTStereoConfig(n_gru_layers=2)
+params = init_raft_stereo(jax.random.key(0), cfg)
+rng = np.random.default_rng(0)
+for (h, w) in [(64, 64), (256, 128)]:
+    i1 = jnp.asarray(rng.uniform(0, 255, (1, h, w, 3)), jnp.float32)
+    i2 = jnp.asarray(rng.uniform(0, 255, (1, h, w, 3)), jnp.float32)
+    mesh = make_mesh(n_data=1, n_space=8)
+    step_sp = make_eval_step(cfg, valid_iters=2, mesh=mesh)
+    args_sp = shard_batch([i1, i2], mesh, spatial=True)
+    sharded = step_sp.lower(params, *args_sp).compile().memory_analysis().temp_size_in_bytes
+    step_1 = make_eval_step(cfg, valid_iters=2)
+    single = step_1.lower(params, i1, i2).compile().memory_analysis().temp_size_in_bytes
+    print(f"{h}x{w}: sharded={sharded/1e6:.2f}MB single={single/1e6:.2f}MB ratio={sharded/single:.3f}", flush=True)

