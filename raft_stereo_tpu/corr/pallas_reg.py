@@ -61,7 +61,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from raft_stereo_tpu.corr.reg import build_pyramid, build_volume
+from raft_stereo_tpu.corr.reg import build_pyramid
 
 LANE = 128
 TILE = 256  # pixels per grid cell
@@ -232,7 +232,15 @@ def make_reg_tpu_corr_fn(fmap1: jax.Array, fmap2: jax.Array, *,
     # lookup's HBM traffic; the kernel upcasts rows to fp32 for the lerp).
     store_dtype = fmap1.dtype
     f2p = jnp.pad(fmap2, ((0, 0), (0, 0), (0, pad_width(w2) - w2), (0, 0)))
-    pyramid = build_pyramid(build_volume(fmap1, f2p), num_levels)
+    # The einsum runs in the fmap dtype with fp32 MXU accumulation and the
+    # convert to store_dtype fuses into the dot output — upcasting the
+    # inputs (build_volume) would materialize a full fp32 volume (2.1 GB
+    # at Middlebury-F) before the downcast. Identical when fmaps are fp32.
+    d = fmap1.shape[-1]
+    vol = jnp.einsum("bhid,bhjd->bhij", fmap1, f2p,
+                     preferred_element_type=jnp.float32)
+    vol = (vol * (1.0 / d ** 0.5)).astype(store_dtype)
+    pyramid = build_pyramid(vol, num_levels)
     flat = []
     for lvl, vol in enumerate(pyramid):
         wp = vol.shape[-1]
@@ -241,7 +249,7 @@ def make_reg_tpu_corr_fn(fmap1: jax.Array, fmap2: jax.Array, *,
             vol = jnp.pad(vol, ((0, 0), (0, 0), (0, 0), (0, want - wp)))
         elif wp > want:
             vol = vol[..., :want]
-        flat.append(vol.reshape(b * h * w1, -1).astype(store_dtype))
+        flat.append(vol.reshape(b * h * w1, -1))
 
     def corr_fn(coords_x: jax.Array) -> jax.Array:
         n = b * h * w1

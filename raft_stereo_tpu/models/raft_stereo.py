@@ -28,7 +28,8 @@ from raft_stereo_tpu.models.extractor import (
     init_basic_encoder, init_multi_basic_encoder)
 from raft_stereo_tpu.models.layers import (
     Params, apply_conv, apply_residual_block, init_conv, init_residual_block)
-from raft_stereo_tpu.models.update import apply_update_block, init_update_block
+from raft_stereo_tpu.models.update import (
+    apply_mask_head, apply_update_block, init_update_block)
 from raft_stereo_tpu.ops.coords import coords_grid
 from raft_stereo_tpu.ops.upsample import convex_upsample
 
@@ -75,10 +76,26 @@ def _context_and_features(params: Params, cfg: RAFTStereoConfig,
         cnet_list = apply_multi_basic_encoder(
             params["cnet"], image1, norm_fn="batch", downsample=cfg.n_downsample,
             num_layers=cfg.n_gru_layers)
-        fmaps = apply_basic_encoder(
-            params["fnet"], jnp.concatenate([image1, image2], axis=0),
-            norm_fn="instance", downsample=cfg.n_downsample)
-        fmap1, fmap2 = jnp.split(fmaps, 2, axis=0)
+        if image1.shape[1] * image1.shape[2] >= 1 << 21:
+            # Full-resolution inputs (>=2M px): run the two images through
+            # the feature net SEQUENTIALLY (lax.map reuses the stem buffers
+            # between steps). The reference's batch-concat (:83) is a GPU
+            # throughput trick; at Middlebury-F the stride-1 stem's
+            # space-to-depth intermediates are ~1.5 GB per image, and
+            # batching both doubles peak HBM for zero win on a
+            # latency-bound B=1 eval. Instance norm is per-sample, so the
+            # outputs are identical.
+            fmaps = lax.map(
+                lambda im: apply_basic_encoder(
+                    params["fnet"], im, norm_fn="instance",
+                    downsample=cfg.n_downsample),
+                jnp.stack([image1, image2]))
+            fmap1, fmap2 = fmaps[0], fmaps[1]
+        else:
+            fmaps = apply_basic_encoder(
+                params["fnet"], jnp.concatenate([image1, image2], axis=0),
+                norm_fn="instance", downsample=cfg.n_downsample)
+            fmap1, fmap2 = jnp.split(fmaps, 2, axis=0)
 
     net_list = [jnp.tanh(x[0]) for x in cnet_list]
     inp_list = [jax.nn.relu(x[1]) for x in cnet_list]
@@ -122,7 +139,7 @@ def raft_stereo_forward(params: Params, cfg: RAFTStereoConfig,
     inp = [tuple(c.astype(compute_dtype) for c in triple) for triple in inp_list]
     factor = cfg.downsample_factor
 
-    def one_iteration(net, coords1):
+    def one_iteration(net, coords1, compute_mask=True):
         coords1 = lax.stop_gradient(coords1)  # truncated BPTT (:109)
         corr = corr_fn(coords1[..., 0]).astype(compute_dtype)
         flow = (coords1 - coords0).astype(compute_dtype)
@@ -136,7 +153,8 @@ def raft_stereo_forward(params: Params, cfg: RAFTStereoConfig,
                                      iter08=False, update=False)
         net, up_mask, delta_flow = apply_update_block(
             params["update_block"], cfg, net, inp, corr, flow,
-            iter32=cfg.n_gru_layers == 3, iter16=cfg.n_gru_layers >= 2)
+            iter32=cfg.n_gru_layers == 3, iter16=cfg.n_gru_layers >= 2,
+            compute_mask=compute_mask)
         # Stereo: project the update onto the epipolar line (:120).
         delta_flow = delta_flow.astype(jnp.float32).at[..., 1].set(0.0)
         coords1 = coords1 + delta_flow
@@ -158,16 +176,18 @@ def raft_stereo_forward(params: Params, cfg: RAFTStereoConfig,
         return jnp.stack(flow_predictions)
 
     if test_mode:
-        mask_ch = factor * factor * 9
-        up_mask0 = jnp.zeros((b, h, w, mask_ch), compute_dtype)
-
+        # The mask feeds only the upsampler — and test mode upsamples only
+        # the final iteration (reference :126-127) — so the mask head runs
+        # ONCE after the scan instead of every iteration (the reference
+        # computes-and-discards it 31 times; identical outputs here).
         def step(carry, _):
-            net, coords1, _ = carry
-            net, coords1, up_mask = one_iteration(net, coords1)
-            return (net, coords1, up_mask), None
+            net, coords1 = carry
+            net, coords1, _ = one_iteration(net, coords1, compute_mask=False)
+            return (net, coords1), None
 
-        (net, coords1, up_mask), _ = lax.scan(
-            step, (net, coords1, up_mask0), None, length=iters)
+        (net, coords1), _ = lax.scan(
+            step, (net, coords1), None, length=iters)
+        up_mask = apply_mask_head(params["update_block"], net[0])
         return coords1 - coords0, upsampled(coords1, up_mask)
 
     def step(carry, _):

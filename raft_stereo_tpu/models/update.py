@@ -163,15 +163,30 @@ def init_update_block(key, cfg: RAFTStereoConfig) -> Params:
     return p
 
 
+def apply_mask_head(p: Params, net0: jax.Array) -> jax.Array:
+    """Convex-upsampling mask from the finest hidden state, scaled 0.25
+    "to balance gradients" (``core/update.py:136-137``)."""
+    return 0.25 * apply_conv(p["mask"]["conv2"],
+                             jax.nn.relu(apply_conv(p["mask"]["conv1"], net0,
+                                                    padding=1)))
+
+
 def apply_update_block(p: Params, cfg: RAFTStereoConfig,
                        net: Tuple[jax.Array, ...], inp: Sequence[Sequence[jax.Array]],
                        corr: jax.Array | None = None, flow: jax.Array | None = None,
                        iter08: bool = True, iter16: bool = True, iter32: bool = True,
-                       update: bool = True):
+                       update: bool = True, compute_mask: bool = True):
     """Reference ``BasicMultiUpdateBlock.forward`` (``core/update.py:115-138``).
 
     net: per-scale hidden states, finest first. inp: per-scale (cz, cr, cq).
     Returns the new net tuple, and ``(net, mask, delta_flow)`` when ``update``.
+
+    ``compute_mask=False`` skips the mask head and returns ``None`` for it:
+    the mask feeds only the upsampler, never the recurrent state, so
+    test-mode callers that upsample only the final iteration
+    (``raft_stereo.py:126-127`` semantics) can hoist the mask convs out of
+    the iteration loop — identical outputs, ~2/33 of the per-iteration conv
+    FLOPs saved (the reference computes-and-discards it every iteration).
     """
     net = list(net)
     n = cfg.n_gru_layers
@@ -195,8 +210,5 @@ def apply_update_block(p: Params, cfg: RAFTStereoConfig,
         return net
 
     delta_flow = apply_flow_head(p["flow_head"], net[0])
-    # Scale mask to balance gradients (core/update.py:136-137).
-    mask = 0.25 * apply_conv(p["mask"]["conv2"],
-                             jax.nn.relu(apply_conv(p["mask"]["conv1"], net[0],
-                                                    padding=1)))
+    mask = apply_mask_head(p, net[0]) if compute_mask else None
     return net, mask, delta_flow

@@ -33,20 +33,25 @@ def conv2d(x: jax.Array, w: jax.Array, b: jax.Array | None = None, *,
            padding: Padding = 0, out_dtype=None) -> jax.Array:
     """2D convolution, NHWC input, HWIO kernel, torch-style symmetric padding.
 
-    The conv runs in the dtype of ``x`` (bf16 under the mixed-precision policy)
-    with fp32 accumulation on the MXU via ``preferred_element_type``. The
-    result is cast back to ``x.dtype`` unless ``out_dtype`` keeps the fp32
-    accumulator (callers that sum several partial convs downcast once).
+    The conv runs in the dtype of ``x`` (bf16 under the mixed-precision
+    policy) and emits that dtype: the MXU accumulates fp32 within a pass
+    regardless, and requesting an fp32 *output type* forces XLA to
+    materialize full-precision activation buffers — measured 3-6 GB
+    space-to-depth stem intermediates at Middlebury-F that pushed the
+    program out of HBM. Callers that sum several partial convs (the split
+    gate convs) pass ``out_dtype=jnp.float32`` to keep the explicit fp32
+    accumulator across convs and downcast once.
     """
     if isinstance(stride, int):
         stride = (stride, stride)
+    pet = jnp.float32 if out_dtype == jnp.float32 else None
     out = lax.conv_general_dilated(
         x, w.astype(x.dtype), window_strides=stride, padding=_pad_pair(padding),
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        preferred_element_type=jnp.float32)
+        preferred_element_type=pet)
     if b is not None:
-        out = out + b.astype(jnp.float32)
-    return out.astype(x.dtype if out_dtype is None else out_dtype)
+        out = out + b.astype(out.dtype)
+    return out if out_dtype is None else out.astype(out_dtype)
 
 
 def frozen_batch_norm(x: jax.Array, params: dict, *, eps: float = 1e-5) -> jax.Array:
@@ -62,11 +67,19 @@ def frozen_batch_norm(x: jax.Array, params: dict, *, eps: float = 1e-5) -> jax.A
 
 def instance_norm(x: jax.Array, *, eps: float = 1e-5) -> jax.Array:
     """InstanceNorm2d with torch defaults: per-(sample, channel) over H, W,
-    biased variance, no affine parameters."""
-    x32 = x.astype(jnp.float32)
-    mean = jnp.mean(x32, axis=(1, 2), keepdims=True)
-    var = jnp.mean(jnp.square(x32 - mean), axis=(1, 2), keepdims=True)
-    return ((x32 - mean) * lax.rsqrt(var + eps)).astype(x.dtype)
+    biased variance, no affine parameters.
+
+    Statistics accumulate in fp32 but the map stays in the compute dtype:
+    an ``x.astype(f32)`` of the whole activation would materialize a
+    full-resolution fp32 copy (3 GB at Middlebury-F in the fnet stem) plus
+    layout copies either side; the fp32 converts here fuse into the
+    reductions instead. Identical arithmetic when x is fp32.
+    """
+    mean = jnp.mean(x, axis=(1, 2), keepdims=True, dtype=jnp.float32)
+    var = jnp.mean(jnp.square(x.astype(jnp.float32) - mean), axis=(1, 2),
+                   keepdims=True)
+    inv = lax.rsqrt(var + eps)
+    return ((x - mean.astype(x.dtype)) * inv.astype(x.dtype)).astype(x.dtype)
 
 
 def group_norm(x: jax.Array, params: dict, num_groups: int, *,

@@ -1,0 +1,25 @@
+import sys; sys.path.insert(0, "/root/repo")
+import os, re
+import jax, jax.numpy as jnp
+from raft_stereo_tpu.config import RAFTStereoConfig
+from raft_stereo_tpu.models import init_raft_stereo, raft_stereo_forward
+
+cfg = RAFTStereoConfig(corr_implementation="reg_tpu", mixed_precision=True)
+params = jax.eval_shape(lambda k: init_raft_stereo(k, cfg), jax.random.PRNGKey(0))
+params = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), params)
+h, w = 2016, 2976
+i1 = jnp.zeros((1, h, w, 3), jnp.float32)
+def fwd(p, a, b):
+    _, up = raft_stereo_forward(p, cfg, a, b, iters=32, test_mode=True)
+    return up
+txt = jax.jit(fwd).lower(params, i1, i1).compile().as_text()
+open("/tmp/hlo_full.txt", "w").write(txt)
+# big copies / pads / bitcast-converts outside fusions
+for m in re.finditer(r"^\s*(\S+) = (\S+\[[^\]]*\][^ ]*) (copy|pad|transpose|convert)\((.*?)\)", txt, re.M):
+    name, shp, op, args = m.groups()
+    nums = re.findall(r"\d+", shp.split("{")[0])
+    import math
+    n = math.prod(int(x) for x in nums) if nums else 0
+    bytes_ = n * (2 if shp.startswith("bf16") else 4)
+    if bytes_ > 50e6:
+        print(f"{bytes_/1e6:8.0f} MB  {op:9s} {name:14s} {shp[:60]}")

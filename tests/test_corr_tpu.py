@@ -61,3 +61,8 @@ def test_alt_tpu_memory_is_bounded():
     alt_temp = temp_bytes("alt_tpu")
     reg_temp = temp_bytes("reg_tpu")
     assert alt_temp < reg_temp / 2, (alt_temp, reg_temp)
+    # Absolute bound, linear in W (measured 2.03x at this shape): temps are
+    # the padded f2 copy + layout copies of O(H*W*D). Materializing even one
+    # bf16 W^2 volume level (~0.55 GB here) on top would breach it.
+    fmap_bytes = 4 * h * w * d
+    assert alt_temp < 2.5 * fmap_bytes, (alt_temp, fmap_bytes)
