@@ -63,7 +63,12 @@ def main() -> None:
 
     def run(img1, img2):
         _, checksum = forward(params, img1, img2)
-        return float(checksum)  # host fetch = completion barrier
+        checksum = float(checksum)  # host fetch = completion barrier
+        # A kernel that returns garbage fast must not produce a good fps
+        # number: the disparity-sum checksum has to be finite.
+        if not np.isfinite(checksum):
+            raise AssertionError(f"non-finite disparity checksum {checksum}")
+        return checksum
 
     # Warmup: compile + one steady-state frame (reference discards frames 1-50;
     # under jit a single post-compile frame reaches steady state).
@@ -77,12 +82,13 @@ def main() -> None:
             run(img1, img2)
 
     times = []
+    checksum = None
     for _ in range(n_frames):
         img1, img2 = frame()
         # Scalar fetches force both H2D transfers to finish pre-clock.
         float(img1[0, 0, 0, 0]); float(img2[0, 0, 0, 0])
         t0 = time.perf_counter()
-        run(img1, img2)
+        checksum = run(img1, img2)
         times.append(time.perf_counter() - t0)
 
     fps = 1.0 / (sum(times) / len(times))
@@ -110,6 +116,7 @@ def main() -> None:
         "value": round(fps, 4),
         "unit": "frames/s",
         "vs_baseline": round(fps / baseline, 4) if baseline else None,
+        "checksum": round(checksum, 2),
     }))
 
 

@@ -230,16 +230,15 @@ def make_reg_tpu_corr_fn(fmap1: jax.Array, fmap2: jax.Array, *,
     # width falls under one vreg get a (cheap) per-level re-pad. The pyramid
     # is stored in the fmap dtype (bf16 under mixed precision — halves the
     # lookup's HBM traffic; the kernel upcasts rows to fp32 for the lerp).
-    store_dtype = fmap1.dtype
     f2p = jnp.pad(fmap2, ((0, 0), (0, 0), (0, pad_width(w2) - w2), (0, 0)))
-    # The einsum runs in the fmap dtype with fp32 MXU accumulation and the
-    # convert to store_dtype fuses into the dot output — upcasting the
-    # inputs (build_volume) would materialize a full fp32 volume (2.1 GB
-    # at Middlebury-F) before the downcast. Identical when fmaps are fp32.
+    # The einsum runs — and emits — the fmap dtype (the MXU accumulates
+    # fp32 within the single K=256 pass regardless): upcasting the inputs
+    # (build_volume) would materialize a full fp32 volume (2.1 GB at
+    # Middlebury-F) before the downcast, and requesting an fp32 output
+    # type breaks the autodiff transpose for bf16 operands. Identical when
+    # fmaps are fp32.
     d = fmap1.shape[-1]
-    vol = jnp.einsum("bhid,bhjd->bhij", fmap1, f2p,
-                     preferred_element_type=jnp.float32)
-    vol = (vol * (1.0 / d ** 0.5)).astype(store_dtype)
+    vol = jnp.einsum("bhid,bhjd->bhij", fmap1, f2p) * (1.0 / d ** 0.5)
     pyramid = build_pyramid(vol, num_levels)
     flat = []
     for lvl, vol in enumerate(pyramid):

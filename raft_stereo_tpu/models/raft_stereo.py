@@ -34,6 +34,12 @@ from raft_stereo_tpu.ops.coords import coords_grid
 from raft_stereo_tpu.ops.upsample import convex_upsample
 
 
+# Above this many pixels, eval runs the two images through fnet sequentially
+# (lax.map) instead of batch-concatenated — see _context_and_features. Module
+# constant so tests can exercise the sequential path at small shapes.
+FNET_SEQUENTIAL_MIN_PIXELS = 1 << 21
+
+
 def init_raft_stereo(key: jax.Array, cfg: RAFTStereoConfig) -> Params:
     """Build the parameter pytree (reference ctor, ``core/raft_stereo.py:23-39``)."""
     ks = jax.random.split(key, 4 + cfg.n_gru_layers)
@@ -76,7 +82,7 @@ def _context_and_features(params: Params, cfg: RAFTStereoConfig,
         cnet_list = apply_multi_basic_encoder(
             params["cnet"], image1, norm_fn="batch", downsample=cfg.n_downsample,
             num_layers=cfg.n_gru_layers)
-        if image1.shape[1] * image1.shape[2] >= 1 << 21:
+        if image1.shape[1] * image1.shape[2] >= FNET_SEQUENTIAL_MIN_PIXELS:
             # Full-resolution inputs (>=2M px): run the two images through
             # the feature net SEQUENTIALLY (lax.map reuses the stem buffers
             # between steps). The reference's batch-concat (:83) is a GPU
@@ -195,6 +201,13 @@ def raft_stereo_forward(params: Params, cfg: RAFTStereoConfig,
         net, coords1, up_mask = one_iteration(net, coords1)
         return (net, coords1), upsampled(coords1, up_mask)
 
+    # Rematerialize each iteration's internals in the backward pass instead
+    # of storing them: without this the scan saves every iteration's GRU /
+    # corr / upsample intermediates (~8 GB over the reference's 22-iter
+    # batch-6 training config — past a v5e chip's HBM). The reference's
+    # truncated BPTT means each step's backward needs only that step's
+    # activations, so remat trades ~1/3 extra backward FLOPs for O(1-step)
+    # memory.
     (net, coords1), flow_predictions = lax.scan(
-        step, (net, coords1), None, length=iters)
+        jax.checkpoint(step), (net, coords1), None, length=iters)
     return flow_predictions

@@ -44,7 +44,7 @@ def init_conv_gru(key, hidden_dim: int, input_dim: int, kernel_size: int = 3) ->
 
 
 def _split_conv(w: jax.Array, b, parts: Sequence[jax.Array],
-                pad: int) -> jax.Array:
+                pad: int, out_dtype=None) -> jax.Array:
     """conv(concat(parts), w) as a sum of per-part convs.
 
     Algebraically identical (channel-blocked matmul), but never materializes
@@ -55,7 +55,9 @@ def _split_conv(w: jax.Array, b, parts: Sequence[jax.Array],
     The per-part results stay in the fp32 accumulator and are downcast ONCE
     at the end — summing bf16 partials would double the rounding error vs
     the single concat conv this replaces (measured 0.11 vs 0.05 max error
-    on gate pre-activations).
+    on gate pre-activations). ``out_dtype=jnp.float32`` hands the caller
+    the raw accumulator (for summing with other split-conv results before
+    the single downcast).
     """
     from raft_stereo_tpu.ops.basic import conv2d
     off = 0
@@ -68,7 +70,7 @@ def _split_conv(w: jax.Array, b, parts: Sequence[jax.Array],
         off += c
     if b is not None:
         out = out + b.astype(jnp.float32)
-    return out.astype(parts[0].dtype)
+    return out if out_dtype == jnp.float32 else out.astype(parts[0].dtype)
 
 
 def apply_conv_gru(p: Params, h: jax.Array, context: Sequence[jax.Array],
@@ -83,13 +85,24 @@ def apply_conv_gru(p: Params, h: jax.Array, context: Sequence[jax.Array],
     cz, cr, cq = context
     pad = p["convz"]["w"].shape[0] // 2
     ch = h.shape[-1]
-    wzr = jnp.concatenate([p["convz"]["w"], p["convr"]["w"]], axis=-1)
+    wz, wr, wq = p["convz"]["w"], p["convr"]["w"], p["convq"]["w"]
+    # Every gate conv splits into an h-part (first ch input channels) and an
+    # x-part. The x inputs are shared by all three gates, so their three
+    # convs fuse into ONE split-conv with 3*ch output channels — same
+    # FLOPs, one wide MXU pass over x instead of two narrower ones.
+    wx = jnp.concatenate([jax.lax.slice_in_dim(w, ch, w.shape[2], axis=2)
+                          for w in (wz, wr, wq)], axis=-1)
+    ax = _split_conv(wx, None, x_list, pad, out_dtype=jnp.float32)
+    wzr_h = jnp.concatenate(
+        [jax.lax.slice_in_dim(w, 0, ch, axis=2) for w in (wz, wr)], axis=-1)
     bzr = jnp.concatenate([p["convz"]["b"], p["convr"]["b"]])
-    a = _split_conv(wzr, bzr, (h, *x_list), pad)
-    z = jax.nn.sigmoid(a[..., :ch] + cz)
-    r = jax.nn.sigmoid(a[..., ch:] + cr)
-    q = jnp.tanh(_split_conv(p["convq"]["w"], p["convq"]["b"],
-                             (r * h, *x_list), pad) + cq)
+    ah = _split_conv(wzr_h, bzr, (h,), pad, out_dtype=jnp.float32)
+    zr = (ah + ax[..., :2 * ch]).astype(h.dtype)
+    z = jax.nn.sigmoid(zr[..., :ch] + cz)
+    r = jax.nn.sigmoid(zr[..., ch:] + cr)
+    aq = _split_conv(jax.lax.slice_in_dim(wq, 0, ch, axis=2), p["convq"]["b"],
+                     (r * h,), pad, out_dtype=jnp.float32)
+    q = jnp.tanh((aq + ax[..., 2 * ch:]).astype(h.dtype) + cq)
     return (1 - z) * h + z * q
 
 

@@ -12,6 +12,7 @@ Semantics match the reference's torch modules exactly:
 
 from __future__ import annotations
 
+import functools
 from typing import Sequence, Tuple, Union
 
 import jax
@@ -19,6 +20,40 @@ import jax.numpy as jnp
 from jax import lax
 
 Padding = Union[int, Tuple[int, int]]
+
+_DIMNUMS = ("NHWC", "HWIO", "NHWC")
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _conv_acc32(x: jax.Array, w: jax.Array, stride, padding) -> jax.Array:
+    """Conv emitting the fp32 accumulator from reduced-precision operands.
+
+    ``preferred_element_type=f32`` with bf16 operands is fine forward, but
+    its autodiff transpose builds a conv of the fp32 cotangent against the
+    bf16 operand — mixed dtypes, a trace-time error. This custom_vjp runs
+    the backward in the compute dtype (cotangent rounded once), the
+    standard mixed-precision training semantics.
+    """
+    return lax.conv_general_dilated(
+        x, w, window_strides=stride, padding=padding,
+        dimension_numbers=_DIMNUMS, preferred_element_type=jnp.float32)
+
+
+def _conv_acc32_fwd(x, w, stride, padding):
+    return _conv_acc32(x, w, stride, padding), (x, w)
+
+
+def _conv_acc32_bwd(stride, padding, residuals, g):
+    x, w = residuals
+    _, vjp = jax.vjp(
+        lambda a, b: lax.conv_general_dilated(
+            a, b, window_strides=stride, padding=padding,
+            dimension_numbers=_DIMNUMS),
+        x, w)
+    return vjp(g.astype(x.dtype))
+
+
+_conv_acc32.defvjp(_conv_acc32_fwd, _conv_acc32_bwd)
 
 
 def _pad_pair(padding: Padding) -> Tuple[Tuple[int, int], Tuple[int, int]]:
@@ -44,11 +79,13 @@ def conv2d(x: jax.Array, w: jax.Array, b: jax.Array | None = None, *,
     """
     if isinstance(stride, int):
         stride = (stride, stride)
-    pet = jnp.float32 if out_dtype == jnp.float32 else None
-    out = lax.conv_general_dilated(
-        x, w.astype(x.dtype), window_strides=stride, padding=_pad_pair(padding),
-        dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        preferred_element_type=pet)
+    w = w.astype(x.dtype)
+    if out_dtype == jnp.float32 and x.dtype != jnp.float32:
+        out = _conv_acc32(x, w, stride, _pad_pair(padding))
+    else:
+        out = lax.conv_general_dilated(
+            x, w, window_strides=stride, padding=_pad_pair(padding),
+            dimension_numbers=_DIMNUMS)
     if b is not None:
         out = out + b.astype(out.dtype)
     return out if out_dtype is None else out.astype(out_dtype)

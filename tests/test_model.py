@@ -104,3 +104,25 @@ def test_corr_impl_equivalence_end_to_end(rng, impl):
     # reg and alt associate the dot/pool differently; recurrence amplifies fp
     # noise slightly (see test_scan_matches_unroll).
     np.testing.assert_allclose(np.asarray(out_reg), np.asarray(out_imp), atol=1e-3)
+
+
+def test_sequential_fnet_matches_concat(rng, monkeypatch):
+    """The full-res lax.map fnet path must equal the batch-concat path.
+
+    Instance norm is per-sample, so running the two images sequentially is
+    semantically identical; this pins it (the threshold constant means the
+    sequential branch is otherwise only compiled at >=2M-pixel shapes).
+    """
+    from raft_stereo_tpu.models import raft_stereo as rs
+
+    cfg = RAFTStereoConfig()
+    params = init_raft_stereo(jax.random.key(2), cfg)
+    img1, img2 = make_inputs(rng)
+    _, up_concat = raft_stereo_forward(params, cfg, img1, img2, iters=2,
+                                       test_mode=True)
+    monkeypatch.setattr(rs, "FNET_SEQUENTIAL_MIN_PIXELS", 0)
+    _, up_seq = raft_stereo_forward(params, cfg, img1, img2, iters=2,
+                                    test_mode=True)
+    # Differently-fused compilations: fp reassociation only (rel ~2e-6).
+    np.testing.assert_allclose(np.asarray(up_seq), np.asarray(up_concat),
+                               rtol=1e-5, atol=1e-3)
