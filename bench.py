@@ -61,14 +61,16 @@ def main() -> None:
         img2 = jnp.asarray(rng.uniform(0, 255, (1, h, w, 3)), jnp.float32)
         return img1, img2
 
-    def run(img1, img2):
-        _, checksum = forward(params, img1, img2)
+    def fetch_and_check(checksum):
         checksum = float(checksum)  # host fetch = completion barrier
         # A kernel that returns garbage fast must not produce a good fps
         # number: the disparity-sum checksum has to be finite.
         if not np.isfinite(checksum):
             raise AssertionError(f"non-finite disparity checksum {checksum}")
         return checksum
+
+    def run(img1, img2):
+        return fetch_and_check(forward(params, img1, img2)[1])
 
     # Warmup: compile + one steady-state frame (reference discards frames 1-50;
     # under jit a single post-compile frame reaches steady state).
@@ -81,17 +83,28 @@ def main() -> None:
         with jax.profiler.trace(trace_dir):
             run(img1, img2)
 
-    times = []
-    checksum = None
+    # Pre-generate frames and land them on device (the reference also times
+    # only the forward: its timer starts after load + pad + .cuda(),
+    # evaluate_stereo.py:74-79).
+    frames = []
     for _ in range(n_frames):
         img1, img2 = frame()
-        # Scalar fetches force both H2D transfers to finish pre-clock.
         float(img1[0, 0, 0, 0]); float(img2[0, 0, 0, 0])
-        t0 = time.perf_counter()
-        checksum = run(img1, img2)
-        times.append(time.perf_counter() - t0)
+        frames.append((img1, img2))
 
-    fps = 1.0 / (sum(times) / len(times))
+    # Dispatch all timed frames, then one completion barrier: device
+    # execution is in-order, so fetching every checksum after the loop
+    # costs a single tunnel round-trip (~100 ms) amortized over the batch
+    # instead of per frame. The reference's own timing never synchronizes
+    # per frame at all (the loop's only sync is the metric .cpu() fetch).
+    t0 = time.perf_counter()
+    pending = [forward(params, i1, i2)[1] for i1, i2 in frames]
+    checksum = None
+    for c in pending:
+        checksum = fetch_and_check(c)
+    elapsed = time.perf_counter() - t0
+
+    fps = n_frames / elapsed
 
     # Baseline preference: a published reference fps (none exists — the repo
     # publishes no numbers, BASELINE.md), else our measured torch-reference

@@ -25,11 +25,13 @@ step = make_train_step(cfg, tx, train_iters=iters)
 
 rng = np.random.default_rng(0)
 base = rng.uniform(0, 255, (b, h, w + 16, 3)).astype(np.float32)
-disp = rng.uniform(2, 14, (b, 1, 1, 1)).astype(np.float32)
 batch = {
+    # Right image = left shifted 16 px: true disparity 16, flow-x = -16
+    # (flow = -disp convention, data/datasets.py). The smoke only checks
+    # that the loss drops on a FIXED batch (grads flow), not EPE.
     "image1": jnp.asarray(base[:, :, 16:, :]),
-    "image2": jnp.asarray(base[:, :, :-16, :]),  # constant-shift pair
-    "flow": jnp.full((b, h, w, 1), -8.0, jnp.float32),
+    "image2": jnp.asarray(base[:, :, :-16, :]),
+    "flow": jnp.full((b, h, w, 1), -16.0, jnp.float32),
     "valid": jnp.ones((b, h, w), jnp.float32),
 }
 losses = []

@@ -66,3 +66,28 @@ def test_alt_tpu_memory_is_bounded():
     # bf16 W^2 volume level (~0.55 GB here) on top would breach it.
     fmap_bytes = 4 * h * w * d
     assert alt_temp < 2.5 * fmap_bytes, (alt_temp, fmap_bytes)
+
+
+def test_compiled_kernel_grads_match_reg():
+    """custom_vjp backward vs XLA autodiff through reg, on hardware."""
+    rng = np.random.default_rng(1)
+    b, h, w, d = 1, 8, 200, 32
+    f1 = jnp.asarray(rng.standard_normal((b, h, w, d), dtype=np.float32))
+    f2 = jnp.asarray(rng.standard_normal((b, h, w, d), dtype=np.float32))
+    coords = jnp.asarray(
+        rng.uniform(0, w - 1, size=(b, h, w)).astype(np.float32))
+    cot = jnp.asarray(rng.standard_normal((b, h, w, 36), dtype=np.float32))
+
+    def loss(impl, a, bb):
+        out = make_corr_fn(impl, a, bb, num_levels=LEVELS, radius=RADIUS)(
+            coords)
+        return jnp.sum(out * cot)
+
+    g_reg = jax.jit(jax.grad(lambda a, bb: loss("reg", a, bb),
+                             argnums=(0, 1)))(f1, f2)
+    for impl in ("reg_tpu", "alt_tpu"):
+        g = jax.jit(jax.grad(lambda a, bb: loss(impl, a, bb),
+                             argnums=(0, 1)))(f1, f2)
+        for ga, gb in zip(g, g_reg):
+            np.testing.assert_allclose(np.asarray(ga), np.asarray(gb),
+                                       atol=5e-2)  # MXU matmul precision
