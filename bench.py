@@ -83,14 +83,14 @@ def main() -> None:
         with jax.profiler.trace(trace_dir):
             run(img1, img2)
 
-    # Pre-generate frames and land them on device (the reference also times
-    # only the forward: its timer starts after load + pad + .cuda(),
-    # evaluate_stereo.py:74-79).
-    frames = []
-    for _ in range(n_frames):
-        img1, img2 = frame()
-        float(img1[0, 0, 0, 0]); float(img2[0, 0, 0, 0])
-        frames.append((img1, img2))
+    # One device-resident pair, dispatched n_frames times (the reference
+    # also times only the forward: its timer starts after load + pad +
+    # .cuda(), evaluate_stereo.py:74-79). Runtime is content-independent —
+    # fixed iteration count, no data-dependent control flow — and keeping
+    # one pair makes bench memory O(1) in RAFT_BENCH_FRAMES instead of
+    # pinning ~144 MB per frame.
+    img1, img2 = frame()
+    float(img1[0, 0, 0, 0]); float(img2[0, 0, 0, 0])
 
     # Dispatch all timed frames, then one completion barrier: device
     # execution is in-order, so fetching every checksum after the loop
@@ -98,7 +98,7 @@ def main() -> None:
     # instead of per frame. The reference's own timing never synchronizes
     # per frame at all (the loop's only sync is the metric .cpu() fetch).
     t0 = time.perf_counter()
-    pending = [forward(params, i1, i2)[1] for i1, i2 in frames]
+    pending = [forward(params, img1, img2)[1] for _ in range(n_frames)]
     checksum = None
     for c in pending:
         checksum = fetch_and_check(c)

@@ -34,6 +34,11 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument('--bucket', type=int, default=None,
                         help="pad eval shapes up to multiples of this size "
                         "to share compilations (must be a multiple of 32)")
+    parser.add_argument('--spatial_shard', type=int, default=1,
+                        help="shard image height (and the correlation "
+                        "volume) over this many devices — full-resolution "
+                        "frames that exceed one chip's HBM evaluate across "
+                        "the pod")
     return parser
 
 
@@ -71,6 +76,18 @@ def main(argv=None) -> None:
 
     common = dict(iters=args.valid_iters, mixed_prec=use_mixed_precision,
                   root=args.dataset_root)
+    if args.spatial_shard > 1:
+        from raft_stereo_tpu.parallel import make_mesh
+        n_dev = len(jax.devices())
+        if args.spatial_shard > n_dev:
+            raise SystemExit(
+                f"--spatial_shard {args.spatial_shard} exceeds the "
+                f"{n_dev} available device(s)")
+        if 32 % args.spatial_shard:
+            raise SystemExit(
+                f"--spatial_shard {args.spatial_shard} must divide 32 so "
+                "every /32-padded image height shards evenly")
+        common["mesh"] = make_mesh(n_data=1, n_space=args.spatial_shard)
     if args.bucket is not None:
         # Otherwise keep each validator's own default (KITTI buckets to /64
         # so its timing protocol never times a recompile).

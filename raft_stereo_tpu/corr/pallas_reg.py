@@ -64,7 +64,7 @@ from jax.experimental.pallas import tpu as pltpu
 from raft_stereo_tpu.corr.reg import build_pyramid
 
 LANE = 128
-TILE = 256  # pixels per grid cell
+TILE = 512  # pixels per grid cell (swept 128-1024 on v5e: 512 best by ~1%)
 
 
 def _interpret() -> bool:

@@ -42,30 +42,60 @@ def count_parameters(params) -> int:
 
 
 def make_eval_forward(params, cfg: RAFTStereoConfig, iters: int,
-                      mixed_prec: bool = False):
+                      mixed_prec: bool = False, mesh=None):
     """Per-shape-cached jitted forward: (1,H,W,3)x2 -> (disparity map, checksum).
 
     ``mixed_prec`` mirrors the reference's autocast flag: bf16 compute for the
     whole network. The checksum is fetched first as the timing barrier.
+
+    ``mesh``: an optional ``(data, space)`` device mesh. With ``n_space > 1``
+    the image height — and with it the correlation volume, the memory hog —
+    is sharded across chips (SURVEY §5 long-context; XLA inserts the conv
+    halo exchanges), letting full-resolution frames that exceed one chip's
+    HBM evaluate across the pod.
     """
-    run_cfg = (cfg if cfg.mixed_precision == mixed_prec else
-               RAFTStereoConfig(**{**cfg.__dict__, "mixed_precision": mixed_prec}))
+    overrides = {}
+    if cfg.mixed_precision != mixed_prec:
+        overrides["mixed_precision"] = mixed_prec
+    if mesh is not None:
+        from raft_stereo_tpu.parallel.mesh import data_sharding, replicated
+        in_sh, repl = data_sharding(mesh), replicated(mesh)
+        # Compiled Mosaic kernels have no SPMD partitioning rule, so a jit
+        # sharded over a real multi-chip mesh cannot split a pallas_call;
+        # the XLA twins are row-parallel and partition fine. (Wrapping the
+        # kernels in shard_map is the future path.)
+        swap = {"reg_tpu": "reg", "alt_tpu": "alt",
+                "reg_cuda": "reg", "alt_cuda": "alt"}
+        if (mesh.shape.get("space", 1) > 1
+                and cfg.corr_implementation in swap):
+            xla_impl = swap[cfg.corr_implementation]
+            logger.warning(
+                "spatial sharding cannot partition the %s Pallas kernel; "
+                "falling back to the XLA '%s' implementation",
+                cfg.corr_implementation, xla_impl)
+            overrides["corr_implementation"] = xla_impl
+    run_cfg = (cfg if not overrides else
+               RAFTStereoConfig(**{**cfg.__dict__, **overrides}))
 
     @functools.lru_cache(maxsize=None)
     def compiled(h: int, w: int):
-        @jax.jit
         def fwd(p, image1, image2):
             _, flow_up = raft_stereo_forward(p, run_cfg, image1, image2,
                                              iters=iters, test_mode=True)
             return flow_up, jnp.sum(flow_up.astype(jnp.float32))
-        return fwd
+        if mesh is None:
+            return jax.jit(fwd)
+        return jax.jit(fwd, in_shardings=(repl, in_sh, in_sh),
+                       out_shardings=(in_sh, repl))
 
     def forward(image1: np.ndarray, image2: np.ndarray):
         """Returns (flow_up (1,H,W,1) np, seconds) for one padded pair."""
         _, h, w, _ = image1.shape  # pair always matches; read one shape only
         fwd = compiled(h, w)
-        d1 = jax.device_put(jnp.asarray(image1))
-        d2 = jax.device_put(jnp.asarray(image2))
+        put = (functools.partial(jax.device_put, device=in_sh)
+               if mesh is not None else jax.device_put)
+        d1 = put(jnp.asarray(image1))
+        d2 = put(jnp.asarray(image2))
         float(jnp.sum(d1)) , float(jnp.sum(d2))  # H2D barrier, outside timing
         t0 = time.perf_counter()
         flow_up, checksum = fwd(params, d1, d2)
@@ -94,7 +124,7 @@ def _run_pair(forward, sample, bucket: Optional[int]):
 
 
 def validate_eth3d(params, cfg, iters: int = 32, mixed_prec: bool = False,
-                   root: Optional[str] = None,
+                   root: Optional[str] = None, mesh=None,
                    bucket: Optional[int] = None) -> Dict[str, float]:
     """ETH3D train split: EPE + D1(>1px), per-image averaging.
 
@@ -103,7 +133,7 @@ def validate_eth3d(params, cfg, iters: int = 32, mixed_prec: bool = False,
     """
     kw = {"root": f"{root}/ETH3D"} if root else {}
     val_dataset = datasets.ETH3D(aug_params=None, **kw)
-    forward = make_eval_forward(params, cfg, iters, mixed_prec)
+    forward = make_eval_forward(params, cfg, iters, mixed_prec, mesh=mesh)
 
     out_list, epe_list = [], []
     for val_id in range(len(val_dataset)):
@@ -125,7 +155,7 @@ def validate_eth3d(params, cfg, iters: int = 32, mixed_prec: bool = False,
 
 
 def validate_kitti(params, cfg, iters: int = 32, mixed_prec: bool = False,
-                   root: Optional[str] = None,
+                   root: Optional[str] = None, mesh=None,
                    bucket: Optional[int] = 64) -> Dict[str, float]:
     """KITTI-2015 train split: EPE + D1(>3px, per-pixel), FPS protocol.
 
@@ -137,7 +167,7 @@ def validate_kitti(params, cfg, iters: int = 32, mixed_prec: bool = False,
     """
     kw = {"root": f"{root}/KITTI"} if root else {}
     val_dataset = datasets.KITTI(aug_params=None, image_set="training", **kw)
-    forward = make_eval_forward(params, cfg, iters, mixed_prec)
+    forward = make_eval_forward(params, cfg, iters, mixed_prec, mesh=mesh)
 
     out_list, epe_list, elapsed_list = [], [], []
     for val_id in range(len(val_dataset)):
@@ -166,13 +196,13 @@ def validate_kitti(params, cfg, iters: int = 32, mixed_prec: bool = False,
 
 
 def validate_things(params, cfg, iters: int = 32, mixed_prec: bool = False,
-                    root: Optional[str] = None,
+                    root: Optional[str] = None, mesh=None,
                     bucket: Optional[int] = None) -> Dict[str, float]:
     """FlyingThings3D finalpass TEST subset: EPE + D1(>1px, |gt|<192)."""
     kw = {"root": root} if root else {}
     val_dataset = datasets.SceneFlowDatasets(
         aug_params=None, dstype="frames_finalpass", things_test=True, **kw)
-    forward = make_eval_forward(params, cfg, iters, mixed_prec)
+    forward = make_eval_forward(params, cfg, iters, mixed_prec, mesh=mesh)
 
     out_list, epe_list = [], []
     for val_id in range(len(val_dataset)):
@@ -193,11 +223,12 @@ def validate_things(params, cfg, iters: int = 32, mixed_prec: bool = False,
 
 def validate_middlebury(params, cfg, iters: int = 32, split: str = "F",
                         mixed_prec: bool = False, root: Optional[str] = None,
+                        mesh=None,
                         bucket: Optional[int] = None) -> Dict[str, float]:
     """Middlebury V3: EPE + D1(>2px), per-image averaging."""
     kw = {"root": f"{root}/Middlebury"} if root else {}
     val_dataset = datasets.Middlebury(aug_params=None, split=split, **kw)
-    forward = make_eval_forward(params, cfg, iters, mixed_prec)
+    forward = make_eval_forward(params, cfg, iters, mixed_prec, mesh=mesh)
 
     out_list, epe_list = [], []
     for val_id in range(len(val_dataset)):

@@ -26,11 +26,12 @@ step = make_train_step(cfg, tx, train_iters=iters)
 rng = np.random.default_rng(0)
 base = rng.uniform(0, 255, (b, h, w + 16, 3)).astype(np.float32)
 batch = {
-    # Right image = left shifted 16 px: true disparity 16, flow-x = -16
-    # (flow = -disp convention, data/datasets.py). The smoke only checks
-    # that the loss drops on a FIXED batch (grads flow), not EPE.
-    "image1": jnp.asarray(base[:, :, 16:, :]),
-    "image2": jnp.asarray(base[:, :, :-16, :]),
+    # image1[x] = base[x], image2[x] = base[x+16]: the right-image match
+    # for left pixel x sits at x-16 — 16 px to the LEFT, disparity +16,
+    # flow-x = -16 (flow = -disp convention, data/datasets.py:85). The
+    # smoke only asserts the loss drops on a FIXED batch (grads flow).
+    "image1": jnp.asarray(base[:, :, :-16, :]),
+    "image2": jnp.asarray(base[:, :, 16:, :]),
     "flow": jnp.full((b, h, w, 1), -16.0, jnp.float32),
     "valid": jnp.ones((b, h, w), jnp.float32),
 }

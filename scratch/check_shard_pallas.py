@@ -1,0 +1,25 @@
+import sys; sys.path.insert(0, "/root/repo")
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from raft_stereo_tpu.config import RAFTStereoConfig
+from raft_stereo_tpu.engine.evaluate import make_eval_forward
+from raft_stereo_tpu.models import init_raft_stereo
+from raft_stereo_tpu.parallel import make_mesh
+
+rng = np.random.default_rng(0)
+img1 = rng.uniform(0, 255, (1, 64, 64, 3)).astype(np.float32)
+img2 = rng.uniform(0, 255, (1, 64, 64, 3)).astype(np.float32)
+mesh = make_mesh(n_data=1, n_space=8)
+for impl in ("reg_tpu", "alt_tpu"):
+    cfg = RAFTStereoConfig(n_gru_layers=1, corr_implementation=impl)
+    params = init_raft_stereo(jax.random.key(0), cfg)
+    try:
+        fwd = make_eval_forward(params, cfg, iters=2, mesh=mesh)
+        out, _ = fwd(img1, img2)
+        print(impl, "OK", out.shape, flush=True)
+    except Exception as e:
+        print(impl, "FAILED:", str(e)[:200].replace("\n", " "), flush=True)

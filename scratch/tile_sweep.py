@@ -1,0 +1,26 @@
+import sys; sys.path.insert(0, "/root/repo")
+import time
+import numpy as np, jax, jax.numpy as jnp
+import raft_stereo_tpu.corr.pallas_reg as pr
+from raft_stereo_tpu.corr import make_corr_fn
+
+B, H, W, D, iters = 1, 504, 744, 256, 16
+rng = np.random.default_rng(0)
+f1 = jnp.asarray(rng.standard_normal((B, H, W, D)), jnp.bfloat16)
+f2 = jnp.asarray(rng.standard_normal((B, H, W, D)), jnp.bfloat16)
+c0 = jnp.asarray(rng.uniform(0, W - 1, size=(B, H, W)), jnp.float32)
+
+for tile in (128, 256, 512, 1024):
+    pr.TILE = tile
+    @jax.jit
+    def run(c):
+        fn = make_corr_fn("reg_tpu", f1, f2, num_levels=4, radius=4)
+        def step(c, _):
+            return c + 0.07, jnp.mean(fn(c))
+        _, ys = jax.lax.scan(step, c, None, length=iters)
+        return jnp.sum(ys)
+    float(run(c0))
+    t0 = time.perf_counter()
+    float(run(c0))
+    dt = time.perf_counter() - t0
+    print(f"TILE={tile}: {dt*1000/iters:.2f} ms/lookup (wall, incl ~6ms tunnel/16)", flush=True)

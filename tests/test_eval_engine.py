@@ -28,7 +28,7 @@ from raft_stereo_tpu.models import init_raft_stereo
 TINY = RAFTStereoConfig(hidden_dims=(32, 32, 32), corr_levels=2, corr_radius=2)
 
 
-def _zero_forward(params, cfg, iters, mixed_prec=False):
+def _zero_forward(params, cfg, iters, mixed_prec=False, mesh=None):
     def forward(image1, image2):
         return np.zeros(image1.shape[:3] + (1,), np.float32), 0.01
     return forward
@@ -223,3 +223,22 @@ def test_train_loop_checkpoints_and_resume(tmp_path, monkeypatch):
         init_raft_stereo(jax.random.PRNGKey(0), cfg),
         None)
     assert step == 4
+
+
+def test_make_eval_forward_spatial_mesh_matches(rng):
+    """H-sharded eval forward (the --spatial_shard path) equals unsharded."""
+    from raft_stereo_tpu.engine.evaluate import make_eval_forward
+    from raft_stereo_tpu.models import init_raft_stereo
+    from raft_stereo_tpu.parallel import make_mesh
+
+    cfg = RAFTStereoConfig(n_gru_layers=1)
+    params = init_raft_stereo(jax.random.key(0), cfg)
+    img1 = rng.uniform(0, 255, (1, 64, 64, 3)).astype(np.float32)
+    img2 = rng.uniform(0, 255, (1, 64, 64, 3)).astype(np.float32)
+
+    plain = make_eval_forward(params, cfg, iters=2)
+    mesh = make_mesh(n_data=1, n_space=8)
+    sharded = make_eval_forward(params, cfg, iters=2, mesh=mesh)
+    out_p, _ = plain(img1, img2)
+    out_s, _ = sharded(img1, img2)
+    np.testing.assert_allclose(out_s, out_p, atol=2e-3)
