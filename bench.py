@@ -38,10 +38,22 @@ def main() -> None:
     # Default to the Pallas lookup kernel — the north-star config and the
     # fastest measured path (BASELINE.md measured table).
     corr = os.environ.get("RAFT_BENCH_CORR", "reg_tpu")
-    mixed = os.environ.get("RAFT_BENCH_MP", "1").strip().lower() not in (
-        "0", "false", "no", "off")
 
-    cfg = RAFTStereoConfig(corr_implementation=corr, mixed_precision=mixed)
+    def env_flag(name: str, default: str) -> bool:
+        return os.environ.get(name, default).strip().lower() not in (
+            "0", "false", "no", "off")
+
+    mixed = env_flag("RAFT_BENCH_MP", "1")
+
+    # Architecture overrides, e.g. the reference's realtime configuration
+    # (README.md:96-104): RAFT_BENCH_SHARED=1 RAFT_BENCH_DOWNSAMPLE=3
+    # RAFT_BENCH_GRU_LAYERS=2 RAFT_BENCH_SLOW_FAST=1 RAFT_BENCH_ITERS=7.
+    cfg = RAFTStereoConfig(
+        corr_implementation=corr, mixed_precision=mixed,
+        shared_backbone=env_flag("RAFT_BENCH_SHARED", "0"),
+        n_downsample=int(os.environ.get("RAFT_BENCH_DOWNSAMPLE", "2")),
+        n_gru_layers=int(os.environ.get("RAFT_BENCH_GRU_LAYERS", "3")),
+        slow_fast_gru=env_flag("RAFT_BENCH_SLOW_FAST", "0"))
     params = init_raft_stereo(jax.random.PRNGKey(0), cfg)
 
     @jax.jit

@@ -58,8 +58,13 @@ def make_eval_forward(params, cfg: RAFTStereoConfig, iters: int,
     if cfg.mixed_precision != mixed_prec:
         overrides["mixed_precision"] = mixed_prec
     if mesh is not None:
-        from raft_stereo_tpu.parallel.mesh import data_sharding, replicated
+        from raft_stereo_tpu.parallel.mesh import (
+            data_sharding, replicated, shard_batch)
         in_sh, repl = data_sharding(mesh), replicated(mesh)
+        # Replicate params onto the mesh ONCE — passing host-resident params
+        # per call would reshard the whole pytree every frame, inside the
+        # timed region.
+        params = jax.device_put(params, repl)
         # Compiled Mosaic kernels have no SPMD partitioning rule, so a jit
         # sharded over a real multi-chip mesh cannot split a pallas_call;
         # the XLA twins are row-parallel and partition fine. (Wrapping the
@@ -92,10 +97,12 @@ def make_eval_forward(params, cfg: RAFTStereoConfig, iters: int,
         """Returns (flow_up (1,H,W,1) np, seconds) for one padded pair."""
         _, h, w, _ = image1.shape  # pair always matches; read one shape only
         fwd = compiled(h, w)
-        put = (functools.partial(jax.device_put, device=in_sh)
-               if mesh is not None else jax.device_put)
-        d1 = put(jnp.asarray(image1))
-        d2 = put(jnp.asarray(image2))
+        if mesh is not None:
+            d1, d2 = shard_batch([jnp.asarray(image1), jnp.asarray(image2)],
+                                 mesh)
+        else:
+            d1 = jax.device_put(jnp.asarray(image1))
+            d2 = jax.device_put(jnp.asarray(image2))
         float(jnp.sum(d1)) , float(jnp.sum(d2))  # H2D barrier, outside timing
         t0 = time.perf_counter()
         flow_up, checksum = fwd(params, d1, d2)

@@ -71,6 +71,10 @@ def _context_and_features(params: Params, cfg: RAFTStereoConfig,
     image2 = (2 * (image2 / 255.0) - 1.0).astype(compute_dtype)
 
     if cfg.shared_backbone:
+        # dual_inp runs both images through one stem by construction, so
+        # the sequential-fnet memory treatment below does not apply here;
+        # the shared backbone is the realtime (n_downsample=3) config,
+        # which never runs at the full-resolution sizes where it matters.
         *cnet_list, x = apply_multi_basic_encoder(
             params["cnet"], jnp.concatenate([image1, image2], axis=0),
             norm_fn="batch", downsample=cfg.n_downsample,
