@@ -56,6 +56,12 @@ def main(argv=None) -> None:
     from raft_stereo_tpu.engine.checkpoint import load_params
     from raft_stereo_tpu.models import init_raft_stereo
 
+    if args.spatial_shard > 1:
+        # Multi-host: must run before ANY jax computation initializes the
+        # backend (jax.distributed.initialize refuses afterwards).
+        from raft_stereo_tpu.parallel.mesh import maybe_distributed_init
+        maybe_distributed_init()
+
     cfg = RAFTStereoConfig.from_namespace(args)
 
     if args.restore_ckpt is not None:

@@ -80,12 +80,13 @@ def pad_width(w: int) -> int:
 def gather_lerp_taps(vol, cl, radius: int, w2: int):
     """Windowed-gather + lerp over one level's rows held in VMEM/registers.
 
-    vol: (P, W2p) rows, any float dtype (upcast to fp32 here so the lerp
-    arithmetic is always fp32); cl: (P, 1) fp32 level-scaled positions.
-    Returns (P, 2r+1) lerped taps with zero-pad semantics. Shared by the
-    reg_tpu (volume-resident) and alt_tpu (fused on-the-fly) kernels.
+    vol: (P, W2p) rows, any float dtype (the selects/gathers run in the
+    storage dtype — half the vreg traffic for bf16 rows — and the gathered
+    taps are upcast so the lerp arithmetic is always fp32); cl: (P, 1)
+    fp32 level-scaled positions. Returns (P, 2r+1) fp32 lerped taps with
+    zero-pad semantics. Shared by the reg_tpu (volume-resident) and
+    alt_tpu (fused on-the-fly) kernels.
     """
-    vol = vol.astype(jnp.float32)
     p, w2p = vol.shape
     if w2p % LANE:
         # Lane-pad to a vreg multiple in VMEM (callers with HBM-resident
@@ -116,16 +117,21 @@ def gather_lerp_taps(vol, cl, radius: int, w2: int):
                               win_b)
         # Fine: Mosaic's take_along_axis works on exactly one 128-lane vreg;
         # the 2r+2-tap window may straddle the slab boundary, so gather both
-        # slabs and select per tap. Lane t then holds tap t.
+        # slabs and select per tap. Lane t then holds tap t. The gather
+        # operands upcast to fp32 HERE — Mosaic's dynamic_gather requires
+        # the index and result bitwidths to match (i32 indices), so only
+        # the two selected slabs pay the conversion, not the whole row.
         rel = base - slab * LANE + lane  # [0, 128+2r+1] when in range
-        g_a = jnp.take_along_axis(win_a, jnp.clip(rel, 0, LANE - 1), axis=-1)
-        g_b = jnp.take_along_axis(win_b, jnp.clip(rel - LANE, 0, LANE - 1),
-                                  axis=-1)
+        g_a = jnp.take_along_axis(win_a.astype(jnp.float32),
+                                  jnp.clip(rel, 0, LANE - 1), axis=-1)
+        g_b = jnp.take_along_axis(win_b.astype(jnp.float32),
+                                  jnp.clip(rel - LANE, 0, LANE - 1), axis=-1)
         g = jnp.where(rel < LANE, g_a, g_b)
         # rel >= 128 with slab_b == slab reads the wrong slab, but then
         # xpos >= w2p >= w2, so the bounds mask below zeroes it.
     else:
-        g = jnp.take_along_axis(vol, jnp.clip(xpos, 0, LANE - 1), axis=-1)
+        g = jnp.take_along_axis(vol.astype(jnp.float32),
+                                jnp.clip(xpos, 0, LANE - 1), axis=-1)
     g = jnp.where((xpos >= 0) & (xpos < w2), g, 0.0)
     return g[:, :k] * (1.0 - frac) + g[:, 1:k + 1] * frac
 

@@ -31,7 +31,7 @@ from raft_stereo_tpu.engine.logger import Logger
 from raft_stereo_tpu.engine.optimizer import make_optimizer
 from raft_stereo_tpu.engine.steps import make_train_step
 from raft_stereo_tpu.models import init_raft_stereo
-from raft_stereo_tpu.parallel.mesh import make_mesh
+from raft_stereo_tpu.parallel.mesh import make_mesh, maybe_distributed_init
 
 logger = logging.getLogger(__name__)
 
@@ -40,6 +40,10 @@ def train(cfg: RAFTStereoConfig, tcfg: TrainConfig,
           mesh=None, data_root: Optional[str] = None,
           validate: bool = True) -> Dict[str, float]:
     """Run the full training loop; returns the last validation results."""
+    # Multi-host launch (COORDINATOR_ADDRESS set): initialize the JAX
+    # distributed runtime BEFORE any device query, so jax.devices() sees
+    # the whole pod and the data mesh spans hosts over DCN. No-op otherwise.
+    maybe_distributed_init()
     if mesh is None and len(jax.devices()) > 1:
         # Batch must divide evenly over the data axis: use the largest device
         # count that divides the global batch (all devices in the common case).

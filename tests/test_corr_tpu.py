@@ -91,3 +91,26 @@ def test_compiled_kernel_grads_match_reg():
         for ga, gb in zip(g, g_reg):
             np.testing.assert_allclose(np.asarray(ga), np.asarray(gb),
                                        atol=5e-2)  # MXU matmul precision
+
+
+def test_compiled_kernels_bf16_inputs():
+    """bf16 fmaps (the mixed-precision path) through compiled Mosaic.
+
+    The fp32 tests above cannot catch bf16-only Mosaic limitations (e.g.
+    dynamic_gather's bitwidth-match requirement); this pins the exact
+    dtype combination the bench/mixed-precision eval runs.
+    """
+    rng = np.random.default_rng(2)
+    b, h, w, d = 1, 8, 376, 32
+    f1 = jnp.asarray(rng.standard_normal((b, h, w, d)), jnp.bfloat16)
+    f2 = jnp.asarray(rng.standard_normal((b, h, w, d)), jnp.bfloat16)
+    coords = jnp.asarray(
+        rng.uniform(-8, w + 6, size=(b, h, w)).astype(np.float32))
+    reg = make_corr_fn("reg", f1.astype(jnp.float32), f2.astype(jnp.float32),
+                       num_levels=LEVELS, radius=RADIUS)(coords)
+    for impl in ("reg_tpu", "alt_tpu"):
+        out = make_corr_fn(impl, f1, f2, num_levels=LEVELS, radius=RADIUS)(
+            coords)
+        assert out.dtype == jnp.float32
+        np.testing.assert_allclose(np.asarray(out), np.asarray(reg),
+                                   atol=0.15)  # bf16 input quantization

@@ -242,3 +242,31 @@ def test_make_eval_forward_spatial_mesh_matches(rng):
     out_p, _ = plain(img1, img2)
     out_s, _ = sharded(img1, img2)
     np.testing.assert_allclose(out_s, out_p, atol=2e-3)
+
+
+@pytest.mark.slow
+def test_train_cli_end_to_end(tmp_path, monkeypatch):
+    """train_stereo.py argparse -> config -> engine wiring, 2 steps."""
+    import train_stereo
+
+    root = str(tmp_path / "data")
+    rng = np.random.default_rng(1)
+    for dstype in ("frames_cleanpass", "frames_finalpass"):
+        base = osp.join(root, "FlyingThings3D", dstype, "TRAIN", "A", "0000")
+        for side in ("left", "right"):
+            _write_png(osp.join(base, side, "0006.png"),
+                       rng.integers(0, 256, (48, 64, 3), dtype=np.uint8))
+    ddir = osp.join(root, "FlyingThings3D", "disparity", "TRAIN", "A", "0000",
+                    "left")
+    os.makedirs(ddir, exist_ok=True)
+    frame_utils.write_pfm(osp.join(ddir, "0006.pfm"),
+                          rng.uniform(1, 10, (48, 64)).astype(np.float32))
+
+    monkeypatch.chdir(tmp_path)
+    train_stereo.main([
+        "--name", "clismoke", "--batch_size", "1", "--num_steps", "2",
+        "--train_iters", "2", "--image_size", "32", "48",
+        "--hidden_dims", "32", "32", "32", "--corr_levels", "2",
+        "--corr_radius", "2", "--num_workers", "1",
+        "--dataset_root", root])
+    assert osp.exists("checkpoints/clismoke.msgpack")
