@@ -133,7 +133,16 @@ def fetch_dataloader(train_cfg, root: Optional[str] = None) -> StereoLoader:
 
 
 def device_prefetch(loader, mesh=None, size: int = 2):
-    """Double-buffer batches onto device (sharded over the mesh's data axis)."""
+    """Double-buffer batches onto device (sharded over the mesh's data axis).
+
+    Multi-host note: every process iterates the SAME deterministic loader
+    (same seed, same file listing) and device_puts the full global batch
+    onto the pod-wide sharding — correct, but each host decodes/augments
+    the whole global batch. Pods that become input-bound should shard the
+    dataset by ``jax.process_index()`` and assemble with
+    ``jax.make_array_from_process_local_data`` instead; single-host (this
+    image, and the reference's scale) is unaffected.
+    """
     import jax
 
     if mesh is not None:

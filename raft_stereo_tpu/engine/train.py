@@ -36,6 +36,25 @@ from raft_stereo_tpu.parallel.mesh import make_mesh, maybe_distributed_init
 logger = logging.getLogger(__name__)
 
 
+class _NullLogger:
+    """Logger stand-in for non-lead pod processes: accepts every call,
+    writes nothing (TensorBoard/JSONL output comes from the lead only)."""
+
+    total_steps = 0
+
+    def push(self, *args, **kwargs):
+        pass
+
+    def write_scalar(self, *args, **kwargs):
+        pass
+
+    def write_dict(self, *args, **kwargs):
+        pass
+
+    def close(self):
+        pass
+
+
 def train(cfg: RAFTStereoConfig, tcfg: TrainConfig,
           mesh=None, data_root: Optional[str] = None,
           validate: bool = True) -> Dict[str, float]:
@@ -44,14 +63,25 @@ def train(cfg: RAFTStereoConfig, tcfg: TrainConfig,
     # distributed runtime BEFORE any device query, so jax.devices() sees
     # the whole pod and the data mesh spans hosts over DCN. No-op otherwise.
     maybe_distributed_init()
+    is_lead = jax.process_index() == 0
     if mesh is None and len(jax.devices()) > 1:
-        # Batch must divide evenly over the data axis: use the largest device
-        # count that divides the global batch (all devices in the common case).
-        n_data = max(d for d in range(1, len(jax.devices()) + 1)
-                     if tcfg.batch_size % d == 0)
-        if n_data > 1:
-            mesh = make_mesh(n_data=n_data,
-                             devices=jax.devices()[:n_data])
+        if jax.process_count() > 1:
+            # Multi-host: every process's devices MUST be in the mesh (a
+            # process whose chips are excluded would deadlock at the first
+            # collective), so the batch has to divide the full pod.
+            if tcfg.batch_size % len(jax.devices()):
+                raise ValueError(
+                    f"batch_size {tcfg.batch_size} must divide evenly over "
+                    f"all {len(jax.devices())} devices of the pod")
+            mesh = make_mesh(n_data=len(jax.devices()))
+        else:
+            # Single host: use the largest device count that divides the
+            # batch (all devices in the common case).
+            n_data = max(d for d in range(1, len(jax.devices()) + 1)
+                         if tcfg.batch_size % d == 0)
+            if n_data > 1:
+                mesh = make_mesh(n_data=n_data,
+                                 devices=jax.devices()[:n_data])
 
     key = jax.random.PRNGKey(tcfg.seed)
     params = jax.jit(lambda k: init_raft_stereo(k, cfg))(key)
@@ -74,7 +104,7 @@ def train(cfg: RAFTStereoConfig, tcfg: TrainConfig,
     logger.info("Parameter Count: %d", count_parameters(params))
     train_loader = fetch_dataloader(tcfg, root=data_root)
     train_step = make_train_step(cfg, tx, tcfg.train_iters, mesh=mesh)
-    log = Logger(scheduler=schedule)
+    log = Logger(scheduler=schedule) if is_lead else _NullLogger()
     log.total_steps = start_step
 
     os.makedirs("checkpoints", exist_ok=True)
@@ -93,27 +123,40 @@ def train(cfg: RAFTStereoConfig, tcfg: TrainConfig,
                              total_steps)
             total_steps += 1
 
-            if total_steps % tcfg.ckpt_every == 0:
+            # Writes (checkpoints, validation, TensorBoard) happen on the
+            # lead process only: on a pod, every process executes the loop
+            # and holds the same replicated state, and concurrent writers
+            # to a shared filesystem would corrupt the checkpoint.
+            if total_steps % tcfg.ckpt_every == 0 and is_lead:
                 save_path = f"checkpoints/{total_steps}_{tcfg.name}{ckpt.CKPT_SUFFIX}"
                 ckpt.save_checkpoint(save_path, params, opt_state, total_steps)
                 logger.info("Saved %s", save_path)
                 if validate:
+                    # Pull params to host first: a lead-only jit on arrays
+                    # still committed to the pod-wide sharding would be a
+                    # multi-controller computation the other processes
+                    # never join (deadlock). From host numpy the eval jit
+                    # is process-local on the lead's devices.
+                    eval_params = (jax.device_get(params)
+                                   if jax.process_count() > 1 else params)
                     last_results = validate_things(
-                        params, cfg, iters=tcfg.valid_iters, root=data_root)
+                        eval_params, cfg, iters=tcfg.valid_iters,
+                        root=data_root)
                     log.write_dict(last_results)
 
             if total_steps >= tcfg.num_steps:
                 should_keep_training = False
                 break
 
-        if len(train_loader) >= 10000:
+        if len(train_loader) >= 10000 and is_lead:
             save_path = (f"checkpoints/{total_steps}_epoch_{tcfg.name}"
                          f"{ckpt.CKPT_SUFFIX}")
             ckpt.save_checkpoint(save_path, params, opt_state, total_steps)
             logger.info("Saved epoch checkpoint %s", save_path)
 
     final = f"checkpoints/{tcfg.name}{ckpt.CKPT_SUFFIX}"
-    ckpt.save_checkpoint(final, params, opt_state, total_steps)
-    logger.info("Saved final checkpoint %s", final)
+    if is_lead:
+        ckpt.save_checkpoint(final, params, opt_state, total_steps)
+        logger.info("Saved final checkpoint %s", final)
     log.close()
     return last_results

@@ -1,0 +1,45 @@
+"""Quantify bf16 (mixed-precision, reg_tpu) vs fp32 (reg) disparity drift
+on the real chip with transplanted reference weights — the precision cost
+of the kernel/bf16 trades, measured end-to-end at 32 iters.
+"""
+import sys; sys.path.insert(0, "/root/repo")
+import numpy as np
+import torch, argparse
+sys.path.insert(0, "/root/reference")
+from core.raft_stereo import RAFTStereo
+import jax.numpy as jnp
+from raft_stereo_tpu.config import RAFTStereoConfig
+from raft_stereo_tpu.models import raft_stereo_forward
+from raft_stereo_tpu.transplant import transplant_state_dict
+
+defaults = dict(corr_implementation="reg", shared_backbone=False,
+                corr_levels=4, corr_radius=4, n_downsample=2,
+                slow_fast_gru=False, n_gru_layers=3,
+                hidden_dims=[128, 128, 128], mixed_precision=False)
+torch.manual_seed(1234)
+model = RAFTStereo(argparse.Namespace(**defaults))
+params = transplant_state_dict(model.state_dict(), RAFTStereoConfig())
+
+rng = np.random.default_rng(11)
+H, W = 384, 512
+deltas = []
+for i in range(2):
+    base = rng.uniform(0, 255, (1, H, W + 32, 3)).astype(np.float32)
+    shift = int(rng.integers(4, 24))
+    j1 = jnp.asarray(base[:, :, 32:, :])
+    j2 = jnp.asarray(base[:, :, 32 - shift:-shift, :])
+    outs = {}
+    for label, cfg in (
+            ("fp32_reg", RAFTStereoConfig()),
+            ("bf16_reg_tpu", RAFTStereoConfig(corr_implementation="reg_tpu",
+                                              mixed_precision=True)),
+            ("bf16_alt_tpu", RAFTStereoConfig(corr_implementation="alt_tpu",
+                                              mixed_precision=True))):
+        _, up = raft_stereo_forward(params, cfg, j1, j2, iters=32,
+                                    test_mode=True)
+        outs[label] = np.asarray(up)[0, :, :, 0]
+    for label in ("bf16_reg_tpu", "bf16_alt_tpu"):
+        d = np.abs(outs[label] - outs["fp32_reg"])
+        print(f"pair {i} shift={shift:2d} {label}: mean|dd|={d.mean():.4f} "
+              f"p99|dd|={np.quantile(d, 0.99):.4f} max|dd|={d.max():.4f} "
+              f"(mean disp {np.abs(outs['fp32_reg']).mean():.2f})", flush=True)
