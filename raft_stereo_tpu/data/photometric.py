@@ -22,6 +22,8 @@ import numpy as np
 
 import cv2
 
+from raft_stereo_tpu import native
+
 cv2.setNumThreads(0)
 cv2.ocl.setUseOpenCL(False)
 
@@ -92,6 +94,11 @@ class ColorJitter:
         c = rng.uniform(*self.contrast)
         s = rng.uniform(*self.saturation)
         h = rng.uniform(-self.hue, self.hue)
+        gamma_gain_draw = (rng.uniform(*self.gamma_range),
+                           rng.uniform(*self.gain_range))
+        if native.available():
+            self._apply_native(out, ops, b, c, s, h, *gamma_gain_draw)
+            return out.astype(np.uint8)
         for op in ops:
             if op == 0:
                 out = adjust_brightness(out, b)
@@ -101,8 +108,29 @@ class ColorJitter:
                 out = adjust_saturation(out, s)
             elif op == 3 and self.hue > 0:
                 out = adjust_hue(out, h)
-        gamma = rng.uniform(*self.gamma_range)
-        gain = rng.uniform(*self.gain_range)
+        gamma, gain = gamma_gain_draw
         if gamma != 1.0 or gain != 1.0:
             out = adjust_gamma(out, gamma, gain)
         return out.astype(np.uint8)
+
+    def _apply_native(self, out: np.ndarray, ops, b: float, c: float,
+                      s: float, h: float, gamma: float, gain: float) -> None:
+        """In-place jitter via the C++ kernels (``native/photometric.cpp``).
+
+        Same op order and per-pixel float32 maths as the numpy path; runs of
+        hue-free ops go through one ``native.jitter_ops`` call, the hue op
+        (cv2 uint8 HSV fixed-point — already native) splits the sequence. The
+        foreign calls release the GIL, so loader worker threads overlap.
+        """
+        pending: list = []
+        for op in ops:
+            if op == 3:
+                if self.hue > 0:
+                    native.jitter_ops(out, pending, b, c, s)
+                    pending = []
+                    out[...] = adjust_hue(out, h)
+            else:
+                pending.append(int(op))
+        native.jitter_ops(out, pending, b, c, s)
+        if gamma != 1.0 or gain != 1.0:
+            native.gamma(out, gamma, gain)

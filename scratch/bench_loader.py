@@ -1,0 +1,88 @@
+"""Data-pipeline throughput: samples/s through decode + augment + collate.
+
+Fabricates a SceneFlow-shaped tree at real FlyingThings resolution (540x960),
+then times StereoLoader with the reference train config (crop 320x720,
+batch 6). The number to beat: the train step consumes 4.0 samples/s/chip
+(BASELINE.md), so an 8-chip pod needs ~32 samples/s from the host pipeline.
+"""
+
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from raft_stereo_tpu.data.datasets import SceneFlowDatasets
+from raft_stereo_tpu.data.frame_utils import write_pfm
+from raft_stereo_tpu.data.loader import StereoLoader
+
+try:
+    import cv2
+    cv2.setNumThreads(0)
+except ImportError:
+    cv2 = None
+from PIL import Image
+
+
+def make_tree(root, n=24, h=540, w=960):
+    rng = np.random.default_rng(0)
+    base = os.path.join(root, "FlyingThings3D", "frames_cleanpass", "TRAIN",
+                        "A", "0000")
+    dbase = os.path.join(root, "FlyingThings3D", "disparity", "TRAIN", "A",
+                         "0000")
+    for cam in ("left", "right"):
+        os.makedirs(os.path.join(base, cam), exist_ok=True)
+        os.makedirs(os.path.join(dbase, cam), exist_ok=True)
+    for i in range(n):
+        for cam in ("left", "right"):
+            img = rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+            Image.fromarray(img).save(
+                os.path.join(base, cam, f"{i:07d}.png"))
+        disp = rng.uniform(1, 60, (h, w)).astype(np.float32)
+        write_pfm(os.path.join(dbase, "left", f"{i:07d}.pfm"), disp)
+
+
+def main():
+    aug_params = {"crop_size": [320, 720], "min_scale": -0.2, "max_scale": 0.4,
+                  "do_flip": False, "yjitter": True}
+    batch = int(os.environ.get("LOADER_BATCH", 6))
+    workers = int(os.environ.get("LOADER_WORKERS", 4))
+    n = int(os.environ.get("LOADER_N", 48))
+    with tempfile.TemporaryDirectory() as root:
+        make_tree(root, n=n)
+        ds = SceneFlowDatasets(aug_params, root=os.path.join(
+            root, "FlyingThings3D", ".."), dstype="frames_cleanpass",
+            things_test=False)
+        print(f"dataset: {len(ds)} samples")
+        loader = StereoLoader(ds, batch_size=batch, num_workers=workers,
+                              shuffle=True, drop_last=True)
+        # warm epoch (page cache, lazy imports)
+        for b in loader:
+            pass
+        t0 = time.perf_counter()
+        nb = 0
+        for b in loader:
+            nb += 1
+        dt = time.perf_counter() - t0
+        sps = nb * batch / dt
+        print(f"{nb} batches of {batch} in {dt:.2f}s -> "
+              f"{sps:.1f} samples/s ({workers} workers)")
+        # single-sample decomposition: decode vs augment
+        t0 = time.perf_counter()
+        for i in range(8):
+            ds[i]
+        print(f"per-sample (decode+augment): {(time.perf_counter()-t0)/8*1e3:.1f} ms")
+        ds_noaug = SceneFlowDatasets(None, root=os.path.join(
+            root, "FlyingThings3D", ".."), dstype="frames_cleanpass",
+            things_test=False)
+        t0 = time.perf_counter()
+        for i in range(8):
+            ds_noaug[i]
+        print(f"per-sample (decode only):    {(time.perf_counter()-t0)/8*1e3:.1f} ms")
+
+
+if __name__ == "__main__":
+    main()

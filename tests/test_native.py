@@ -1,0 +1,101 @@
+"""Native (C++) photometric kernels: parity vs the numpy path.
+
+The native path must be (a) available in this image (g++ is in the
+toolchain), (b) deterministic, and (c) numerically equivalent to the numpy
+implementation — same op order, same float32 per-pixel maths. The only
+tolerated divergences are the contrast mean (double vs pairwise-float32
+accumulation — a scalar ~1e-5 off) and the gamma LUT lerp, both bounded to
+at most 1 uint8 count here.
+"""
+
+from unittest import mock
+
+import numpy as np
+import pytest
+
+from raft_stereo_tpu import native
+from raft_stereo_tpu.data import photometric
+from raft_stereo_tpu.data.photometric import ColorJitter
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="no C++ compiler / native build failed")
+
+
+def _img(rng, h=64, w=96):
+    return rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+
+
+REF_KW = dict(brightness=0.4, contrast=0.4, saturation=(0.6, 1.4),
+              hue=0.5 / 3.14, gamma=(0.8, 1.2, 0.9, 1.1))
+
+
+def _both_paths(img, seed, **kw):
+    cj = ColorJitter(**kw)
+    out_native = cj(img, np.random.default_rng(seed))
+    with mock.patch.object(photometric.native, "lib", lambda: None):
+        out_numpy = cj(img, np.random.default_rng(seed))
+    return out_native, out_numpy
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_jitter_parity_with_numpy(rng, seed):
+    img = _img(rng)
+    a, b = _both_paths(img, seed, **REF_KW)
+    assert a.shape == b.shape and a.dtype == b.dtype == np.uint8
+    diff = np.abs(a.astype(np.int32) - b.astype(np.int32))
+    assert diff.max() <= 1, f"native/numpy diverge by {diff.max()} counts"
+    # knife-edge rounding may flip a pixel by 1 count, but only rarely
+    assert (diff > 0).mean() < 0.01
+
+
+def test_jitter_parity_no_hue_no_gamma(rng):
+    img = _img(rng)
+    a, b = _both_paths(img, 7, brightness=0.4, contrast=0.4,
+                       saturation=(0.6, 1.4), hue=0.0)
+    assert np.abs(a.astype(np.int32) - b.astype(np.int32)).max() <= 1
+
+
+def test_native_deterministic(rng):
+    img = _img(rng)
+    cj = ColorJitter(**REF_KW)
+    a = cj(img, np.random.default_rng(5))
+    b = cj(img, np.random.default_rng(5))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_native_kernels_match_ops_exactly(rng):
+    """The per-op kernels vs their numpy counterparts on float32 buffers."""
+    import ctypes
+    lib = native.lib()
+    f32p = ctypes.POINTER(ctypes.c_float)
+    img = rng.uniform(0, 255, (48, 64, 3)).astype(np.float32)
+    npix = img.shape[0] * img.shape[1]
+
+    for name, ref_fn, factor in (
+            ("rst_brightness", photometric.adjust_brightness, 1.3),
+            ("rst_contrast", photometric.adjust_contrast, 0.7),
+            ("rst_saturation", photometric.adjust_saturation, 1.2)):
+        buf = np.ascontiguousarray(img.copy())
+        getattr(lib, name)(buf.ctypes.data_as(f32p), npix, factor)
+        np.testing.assert_allclose(buf, ref_fn(img, factor), atol=2e-3,
+                                   err_msg=name)
+
+    buf = np.ascontiguousarray(img.copy())
+    lib.rst_gamma(buf.ctypes.data_as(f32p), npix, 1.3, 1.05)
+    # LUT-lerp gamma: within a fraction of a count of the exact power curve
+    np.testing.assert_allclose(buf, photometric.adjust_gamma(img, 1.3, 1.05),
+                               atol=0.01)
+
+
+def test_identity_factors_are_noops(rng):
+    img = _img(rng)
+    out = ColorJitter()(img, np.random.default_rng(0))
+    np.testing.assert_array_equal(out, img)
+
+
+def test_numpy_fallback_forced_by_env(rng, monkeypatch):
+    """RAFT_NATIVE=0 must disable the native path (fresh module state)."""
+    monkeypatch.setenv("RAFT_NATIVE", "0")
+    monkeypatch.setattr(native, "_tried", False)
+    monkeypatch.setattr(native, "_lib", None)
+    assert native.lib() is None
