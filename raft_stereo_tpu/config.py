@@ -93,6 +93,9 @@ class TrainConfig:
     num_workers: Optional[int] = None
     seed: int = 1234
     ckpt_every: int = 10000  # reference validation/ckpt cadence, train_stereo.py:153
+    # Profile one steady-state step into this directory (jax.profiler trace,
+    # SURVEY §5 tracing; same hook bench.py exposes as RAFT_BENCH_TRACE).
+    trace_dir: Optional[str] = None
 
     def __post_init__(self):
         self.train_datasets = tuple(self.train_datasets)

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import logging
 import os
+import signal
 from typing import Dict, Optional
 
 import jax
@@ -34,6 +35,45 @@ from raft_stereo_tpu.models import init_raft_stereo
 from raft_stereo_tpu.parallel.mesh import make_mesh, maybe_distributed_init
 
 logger = logging.getLogger(__name__)
+
+
+class PreemptGuard:
+    """Preemption-safe shutdown: SIGTERM requests a checkpoint-and-exit.
+
+    TPU-pod maintenance/preemption delivers SIGTERM with a grace window; the
+    reference's loop would lose up to 10k steps (SURVEY §5 failure-recovery
+    row). The handler only sets a flag — the training loop polls it at step
+    boundaries, where params/opt_state are consistent, saves, and returns.
+
+    On a multi-host pod every process polls ``stop()`` which ORs the local
+    flags across processes (one tiny allgather per step, ~µs over ICI), so
+    all processes leave the collective region at the SAME step — a host-local
+    check would deadlock the survivors at the next psum.
+    """
+
+    def __init__(self):
+        self.requested = False
+        self._prev = None
+        try:
+            self._prev = signal.signal(signal.SIGTERM, self._on_signal)
+        except ValueError:  # not the main thread: polling still works
+            pass
+
+    def _on_signal(self, signum, frame):
+        self.requested = True
+        logger.warning("SIGTERM received: checkpointing at next step boundary")
+
+    def stop(self) -> bool:
+        if jax.process_count() == 1:
+            return self.requested
+        from jax.experimental import multihost_utils
+        flags = multihost_utils.process_allgather(
+            np.asarray([self.requested]))
+        return bool(np.any(flags))
+
+    def restore(self) -> None:
+        if self._prev is not None:
+            signal.signal(signal.SIGTERM, self._prev)
 
 
 class _NullLogger:
@@ -110,53 +150,89 @@ def train(cfg: RAFTStereoConfig, tcfg: TrainConfig,
     os.makedirs("checkpoints", exist_ok=True)
     total_steps = start_step
     should_keep_training = True
+    preempted = False
     last_results: Dict[str, float] = {}
+    guard = PreemptGuard()
 
-    while should_keep_training:
-        for batch in device_prefetch(train_loader, mesh=mesh):
-            params, opt_state, metrics = train_step(params, opt_state, batch)
-            host = {k: float(v) for k, v in metrics.items()}
-            log.push({k: host[k] for k in
-                      ("epe", "1px", "3px", "5px", "loss") if k in host})
-            log.write_scalar("live_loss", host["loss"], total_steps)
-            log.write_scalar("learning_rate", float(schedule(total_steps)),
-                             total_steps)
-            total_steps += 1
+    def run_step(params, opt_state, batch):
+        params, opt_state, metrics = train_step(params, opt_state, batch)
+        # Host fetch doubles as the completion barrier (required for the
+        # profiler trace below to cover the device work).
+        host = {k: float(v) for k, v in metrics.items()}
+        return params, opt_state, host
 
-            # Writes (checkpoints, validation, TensorBoard) happen on the
-            # lead process only: on a pod, every process executes the loop
-            # and holds the same replicated state, and concurrent writers
-            # to a shared filesystem would corrupt the checkpoint.
-            if total_steps % tcfg.ckpt_every == 0 and is_lead:
-                save_path = f"checkpoints/{total_steps}_{tcfg.name}{ckpt.CKPT_SUFFIX}"
+    try:
+        while should_keep_training:
+            for batch in device_prefetch(train_loader, mesh=mesh):
+                if (tcfg.trace_dir is not None and is_lead
+                        and total_steps == start_step + 2):  # post-compile
+                    with jax.profiler.trace(tcfg.trace_dir):
+                        params, opt_state, host = run_step(params, opt_state,
+                                                           batch)
+                else:
+                    params, opt_state, host = run_step(params, opt_state,
+                                                       batch)
+                log.push({k: host[k] for k in
+                          ("epe", "1px", "3px", "5px", "loss") if k in host})
+                log.write_scalar("live_loss", host["loss"], total_steps)
+                log.write_scalar("learning_rate", float(schedule(total_steps)),
+                                 total_steps)
+                total_steps += 1
+
+                # Writes (checkpoints, validation, TensorBoard) happen on the
+                # lead process only: on a pod, every process executes the loop
+                # and holds the same replicated state, and concurrent writers
+                # to a shared filesystem would corrupt the checkpoint.
+                if total_steps % tcfg.ckpt_every == 0 and is_lead:
+                    save_path = (f"checkpoints/{total_steps}_{tcfg.name}"
+                                 f"{ckpt.CKPT_SUFFIX}")
+                    ckpt.save_checkpoint(save_path, params, opt_state,
+                                         total_steps)
+                    logger.info("Saved %s", save_path)
+                    if validate:
+                        # Pull params to host first: a lead-only jit on
+                        # arrays still committed to the pod-wide sharding
+                        # would be a multi-controller computation the other
+                        # processes never join (deadlock). From host numpy
+                        # the eval jit is process-local on the lead's devices.
+                        eval_params = (jax.device_get(params)
+                                       if jax.process_count() > 1 else params)
+                        last_results = validate_things(
+                            eval_params, cfg, iters=tcfg.valid_iters,
+                            root=data_root)
+                        log.write_dict(last_results)
+
+                if total_steps >= tcfg.num_steps:
+                    should_keep_training = False
+                    break
+                if guard.stop():
+                    preempted = True
+                    if is_lead:
+                        save_path = (f"checkpoints/{total_steps}_preempt_"
+                                     f"{tcfg.name}{ckpt.CKPT_SUFFIX}")
+                        ckpt.save_checkpoint(save_path, params, opt_state,
+                                             total_steps)
+                        logger.warning(
+                            "Preempted: saved %s; resume with "
+                            "--restore_ckpt to continue the schedule",
+                            save_path)
+                    should_keep_training = False
+                    break
+
+            if len(train_loader) >= 10000 and is_lead:
+                save_path = (f"checkpoints/{total_steps}_epoch_{tcfg.name}"
+                             f"{ckpt.CKPT_SUFFIX}")
                 ckpt.save_checkpoint(save_path, params, opt_state, total_steps)
-                logger.info("Saved %s", save_path)
-                if validate:
-                    # Pull params to host first: a lead-only jit on arrays
-                    # still committed to the pod-wide sharding would be a
-                    # multi-controller computation the other processes
-                    # never join (deadlock). From host numpy the eval jit
-                    # is process-local on the lead's devices.
-                    eval_params = (jax.device_get(params)
-                                   if jax.process_count() > 1 else params)
-                    last_results = validate_things(
-                        eval_params, cfg, iters=tcfg.valid_iters,
-                        root=data_root)
-                    log.write_dict(last_results)
+                logger.info("Saved epoch checkpoint %s", save_path)
 
-            if total_steps >= tcfg.num_steps:
-                should_keep_training = False
-                break
-
-        if len(train_loader) >= 10000 and is_lead:
-            save_path = (f"checkpoints/{total_steps}_epoch_{tcfg.name}"
-                         f"{ckpt.CKPT_SUFFIX}")
-            ckpt.save_checkpoint(save_path, params, opt_state, total_steps)
-            logger.info("Saved epoch checkpoint %s", save_path)
-
-    final = f"checkpoints/{tcfg.name}{ckpt.CKPT_SUFFIX}"
-    if is_lead:
-        ckpt.save_checkpoint(final, params, opt_state, total_steps)
-        logger.info("Saved final checkpoint %s", final)
-    log.close()
+        # A preempted run must NOT write the final checkpoint: that name
+        # means "finished training" to downstream eval/demo, and the preempt
+        # file above already holds the resumable state.
+        if is_lead and not preempted:
+            final = f"checkpoints/{tcfg.name}{ckpt.CKPT_SUFFIX}"
+            ckpt.save_checkpoint(final, params, opt_state, total_steps)
+            logger.info("Saved final checkpoint %s", final)
+    finally:
+        log.close()
+        guard.restore()
     return last_results

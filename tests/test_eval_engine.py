@@ -186,10 +186,7 @@ def test_logger_running_mean_flush(tmp_path):
 # Train loop smoke (real model, tiny shapes)
 # ---------------------------------------------------------------------------
 
-@pytest.mark.slow
-def test_train_loop_checkpoints_and_resume(tmp_path, monkeypatch):
-    from raft_stereo_tpu.engine.train import train
-
+def _tiny_things_tree(tmp_path) -> str:
     root = str(tmp_path / "data")
     rng = np.random.default_rng(0)
     for dstype in ("frames_cleanpass", "frames_finalpass"):
@@ -202,7 +199,14 @@ def test_train_loop_checkpoints_and_resume(tmp_path, monkeypatch):
     os.makedirs(ddir, exist_ok=True)
     frame_utils.write_pfm(osp.join(ddir, "0006.pfm"),
                           rng.uniform(1, 10, (48, 64)).astype(np.float32))
+    return root
 
+
+@pytest.mark.slow
+def test_train_loop_checkpoints_and_resume(tmp_path, monkeypatch):
+    from raft_stereo_tpu.engine.train import train
+
+    root = _tiny_things_tree(tmp_path)
     monkeypatch.chdir(tmp_path)
     cfg = TINY
     tcfg = TrainConfig(name="smoke", batch_size=1, image_size=(32, 48),
@@ -223,6 +227,56 @@ def test_train_loop_checkpoints_and_resume(tmp_path, monkeypatch):
         init_raft_stereo(jax.random.PRNGKey(0), cfg),
         None)
     assert step == 4
+
+
+def test_preempt_guard_catches_sigterm():
+    import signal
+    import time
+
+    from raft_stereo_tpu.engine.train import PreemptGuard
+
+    guard = PreemptGuard()
+    try:
+        os.kill(os.getpid(), signal.SIGTERM)
+        time.sleep(0.01)  # handler runs at the next bytecode boundary
+        assert guard.requested
+        assert guard.stop()  # single-process: no collective involved
+    finally:
+        guard.restore()
+
+
+@pytest.mark.slow
+def test_train_preemption_checkpoint_and_trace(tmp_path, monkeypatch):
+    """SIGTERM-equivalent stop mid-run: a preempt checkpoint with the step
+    count appears and the loop exits cleanly; --trace_dir captures a
+    steady-state step profile."""
+    from raft_stereo_tpu.engine import train as train_mod
+
+    root = _tiny_things_tree(tmp_path)
+    monkeypatch.chdir(tmp_path)
+
+    calls = {"n": 0}
+
+    def fake_stop(self):
+        calls["n"] += 1
+        return calls["n"] >= 4 or self.requested
+
+    monkeypatch.setattr(train_mod.PreemptGuard, "stop", fake_stop)
+    tcfg = TrainConfig(name="pre", batch_size=1, image_size=(32, 48),
+                       num_steps=50, train_iters=2, ckpt_every=100,
+                       num_workers=1, spatial_scale=(-0.2, 0.4),
+                       trace_dir=str(tmp_path / "trace"))
+    train_mod.train(TINY, tcfg, data_root=root, validate=False)
+
+    assert osp.exists("checkpoints/4_preempt_pre.msgpack")
+    # a preempted run must not masquerade as a finished one
+    assert not osp.exists("checkpoints/pre.msgpack")
+    _, _, step = ckpt.load_checkpoint(
+        "checkpoints/4_preempt_pre.msgpack",
+        init_raft_stereo(jax.random.PRNGKey(0), TINY), None)
+    assert step == 4  # resume continues the schedule from here
+    trace_files = [f for _, _, fs in os.walk(tmp_path / "trace") for f in fs]
+    assert trace_files, "profiler trace was not written"
 
 
 def test_make_eval_forward_spatial_mesh_matches(rng):

@@ -62,6 +62,9 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument('--num_workers', type=int, default=None,
                         help="loader worker threads (default: SLURM sizing)")
     parser.add_argument('--seed', type=int, default=1234)
+    parser.add_argument('--trace_dir', default=None,
+                        help="profile one steady-state train step into this "
+                             "directory (jax.profiler trace)")
     return parser
 
 
