@@ -1,0 +1,21 @@
+"""raft_stereo_tpu — a TPU-native (JAX / XLA / Pallas / pjit) stereo-matching framework.
+
+Provides the full capability surface of RAFT-Stereo (iterative disparity refinement
+over a multi-scale 1D correlation pyramid with a hierarchy of convolutional GRUs),
+re-designed TPU-first:
+
+- functional core: the model is a pure function ``(params, img1, img2) -> predictions``
+  over a params pytree; the GRU refinement loop is a ``jax.lax.scan``;
+- NHWC activations / HWIO kernels (TPU conv native layout);
+- four interchangeable correlation implementations behind one protocol:
+  ``reg`` / ``alt`` (pure XLA) and ``reg_tpu`` / ``alt_tpu`` (Pallas kernels);
+- bf16-compute / fp32-param mixed precision (no grad scaler needed);
+- data parallelism via ``jax.sharding`` over a device ``Mesh`` (XLA collectives),
+  with optional width-axis sharding of the correlation volume for full-resolution
+  inputs;
+- a weight-transplant shim that loads the published PyTorch ``.pth`` checkpoints.
+"""
+
+__version__ = "0.1.0"
+
+from raft_stereo_tpu.config import RAFTStereoConfig  # noqa: F401

@@ -1,0 +1,132 @@
+"""Configuration for the TPU RAFT-Stereo framework.
+
+One dataclass shared by all entry points (the reference passes a raw argparse
+namespace straight into the model — ``train_stereo.py:214-248`` /
+``core/raft_stereo.py:25-39``; here the config is typed and validated once).
+Flag names are kept identical to the reference CLIs so scripts run unmodified,
+plus the TPU-native correlation choices ``reg_tpu`` / ``alt_tpu``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+CORR_IMPLEMENTATIONS = ("reg", "alt", "reg_tpu", "alt_tpu", "reg_cuda", "alt_cuda")
+
+
+@dataclasses.dataclass
+class RAFTStereoConfig:
+    """Architecture + precision configuration (reference: the `args` namespace)."""
+
+    # Architecture choices (reference train_stereo.py:231-239)
+    corr_implementation: str = "reg"
+    shared_backbone: bool = False
+    corr_levels: int = 4
+    corr_radius: int = 4
+    n_downsample: int = 2
+    slow_fast_gru: bool = False
+    n_gru_layers: int = 3
+    hidden_dims: Tuple[int, ...] = (128, 128, 128)
+    # Precision. The reference uses torch.cuda.amp autocast (fp16); on TPU the
+    # native fast dtype is bfloat16, whose fp32-range exponent removes the need
+    # for loss scaling entirely. Correlation math stays fp32 (the reference
+    # casts fmaps .float() for non-CUDA corr, core/raft_stereo.py:92-95).
+    mixed_precision: bool = False
+
+    def __post_init__(self):
+        self.hidden_dims = tuple(self.hidden_dims)
+        if self.corr_implementation not in CORR_IMPLEMENTATIONS:
+            raise ValueError(
+                f"corr_implementation must be one of {CORR_IMPLEMENTATIONS}, "
+                f"got {self.corr_implementation!r}")
+        if self.n_gru_layers not in (1, 2, 3):
+            raise ValueError(f"n_gru_layers must be 1, 2 or 3, got {self.n_gru_layers}")
+        if len(self.hidden_dims) != 3:
+            raise ValueError(f"hidden_dims must have 3 entries, got {self.hidden_dims}")
+        if self.n_downsample not in (2, 3):
+            raise ValueError(f"n_downsample must be 2 or 3, got {self.n_downsample}")
+
+    @property
+    def context_dims(self) -> Tuple[int, ...]:
+        # Reference: context_dims = args.hidden_dims (core/raft_stereo.py:27)
+        return self.hidden_dims
+
+    @property
+    def downsample_factor(self) -> int:
+        return 2 ** self.n_downsample
+
+    @property
+    def cor_planes(self) -> int:
+        # core/update.py:69
+        return self.corr_levels * (2 * self.corr_radius + 1)
+
+    @classmethod
+    def from_namespace(cls, ns: argparse.Namespace) -> "RAFTStereoConfig":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in vars(ns).items() if k in fields})
+
+
+@dataclasses.dataclass
+class TrainConfig:
+    """Training parameters (reference train_stereo.py:215-229, 241-246)."""
+
+    name: str = "raft-stereo"
+    restore_ckpt: Optional[str] = None
+    batch_size: int = 6
+    train_datasets: Tuple[str, ...] = ("sceneflow",)
+    lr: float = 0.0002
+    num_steps: int = 100000
+    image_size: Tuple[int, int] = (320, 720)
+    train_iters: int = 16
+    valid_iters: int = 32
+    wdecay: float = 1e-5
+    # Data augmentation
+    img_gamma: Optional[Tuple[float, float]] = None
+    saturation_range: Optional[Tuple[float, float]] = None
+    do_flip: Optional[str] = None  # False/'h'/'v' in the reference CLI
+    spatial_scale: Tuple[float, float] = (0.0, 0.0)
+    noyjitter: bool = False
+    # TPU-framework extensions (not in the reference CLI). num_workers=None
+    # means "size from SLURM_CPUS_PER_TASK - 2" like the reference loader.
+    num_workers: Optional[int] = None
+    seed: int = 1234
+    ckpt_every: int = 10000  # reference validation/ckpt cadence, train_stereo.py:153
+    # Profile one steady-state step into this directory (jax.profiler trace,
+    # SURVEY §5 tracing; same hook bench.py exposes as RAFT_BENCH_TRACE).
+    trace_dir: Optional[str] = None
+
+    def __post_init__(self):
+        self.train_datasets = tuple(self.train_datasets)
+        self.image_size = tuple(self.image_size)
+        self.spatial_scale = tuple(self.spatial_scale)
+        if self.do_flip is False:
+            self.do_flip = None
+
+    @classmethod
+    def from_namespace(cls, ns: argparse.Namespace) -> "TrainConfig":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in vars(ns).items() if k in fields})
+
+
+def add_model_args(parser: argparse.ArgumentParser) -> None:
+    """Architecture flags, identical to the reference CLIs plus TPU corr choices."""
+    parser.add_argument('--corr_implementation', choices=list(CORR_IMPLEMENTATIONS),
+                        default="reg", help="correlation volume implementation")
+    parser.add_argument('--shared_backbone', action='store_true',
+                        help="use a single backbone for the context and feature encoders")
+    parser.add_argument('--corr_levels', type=int, default=4,
+                        help="number of levels in the correlation pyramid")
+    parser.add_argument('--corr_radius', type=int, default=4,
+                        help="width of the correlation pyramid")
+    parser.add_argument('--n_downsample', type=int, default=2,
+                        help="resolution of the disparity field (1/2^K)")
+    parser.add_argument('--slow_fast_gru', action='store_true',
+                        help="iterate the low-res GRUs more frequently")
+    parser.add_argument('--n_gru_layers', type=int, default=3,
+                        help="number of hidden GRU levels")
+    parser.add_argument('--hidden_dims', nargs='+', type=int, default=[128] * 3,
+                        help="hidden state and context dimensions")
+    parser.add_argument('--mixed_precision', action='store_true',
+                        help='use mixed precision (bfloat16 compute on TPU)')

@@ -1,0 +1,60 @@
+"""Correlation-volume implementations behind one protocol.
+
+``make_corr_fn(impl, fmap1, fmap2, num_levels, radius)`` returns a traceable
+closure ``corr_fn(coords_x) -> (B, H, W1, num_levels * (2r+1))`` where
+``coords_x`` is the x-channel of the current matching coordinates, shape
+``(B, H, W1)``. The closure is pure, so it can be captured by the GRU
+refinement ``lax.scan``; the pyramid (if any) is traced once outside the loop.
+
+Implementations (reference ``core/corr.py`` / ``core/raft_stereo.py:90-100``):
+
+- ``reg``      — precomputed all-pairs volume + pyramid, XLA gather-lerp lookup
+                 (CorrBlock1D, ``core/corr.py:110-156``).
+- ``alt``      — on-the-fly: no W^2 volume, samples pooled fmap2 rows per lookup
+                 (PytorchAlternateCorrBlock1D, ``core/corr.py:64-107``); the
+                 memory-efficient path for full-resolution inputs.
+- ``reg_tpu``  — ``reg`` with the lookup as a Pallas TPU kernel
+                 (``pallas_reg.py``; the analog of the reference's CUDA
+                 ``corr_sampler`` extension, ``sampler/``).
+- ``alt_tpu``  — blockwise fused build+sample Pallas kernel, no W^2 volume in
+                 HBM (``pallas_alt.py``; fills the hole the reference left:
+                 its ``alt_cuda`` choice crashes, ``core/corr.py:159-161``).
+- ``reg_cuda`` / ``alt_cuda`` — accepted for CLI compatibility, aliased to the
+                 TPU-native kernels.
+
+All four implementations produce identical outputs on one protocol
+(property-tested in ``tests/test_corr.py``, gradients included); channel order
+is level-major, then offset ``-r..r`` — the order the motion encoder's weights
+expect.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+
+from raft_stereo_tpu.corr.reg import make_reg_corr_fn
+from raft_stereo_tpu.corr.alt import make_alt_corr_fn
+
+CorrFn = Callable[[jax.Array], jax.Array]
+
+_ALIASES = {"reg_cuda": "reg_tpu", "alt_cuda": "alt_tpu"}
+
+
+def make_corr_fn(impl: str, fmap1: jax.Array, fmap2: jax.Array, *,
+                 num_levels: int = 4, radius: int = 4) -> CorrFn:
+    """Build a correlation lookup closure. fmaps are NHWC ``(B, H, W, D)``."""
+    impl = _ALIASES.get(impl, impl)
+    if impl == "reg":
+        return make_reg_corr_fn(fmap1, fmap2, num_levels=num_levels, radius=radius)
+    if impl == "alt":
+        return make_alt_corr_fn(fmap1, fmap2, num_levels=num_levels, radius=radius)
+    if impl == "reg_tpu":
+        from raft_stereo_tpu.corr.pallas_reg import make_reg_tpu_corr_fn
+        return make_reg_tpu_corr_fn(fmap1, fmap2, num_levels=num_levels, radius=radius)
+    if impl == "alt_tpu":
+        from raft_stereo_tpu.corr.pallas_alt import make_alt_tpu_corr_fn
+        return make_alt_tpu_corr_fn(fmap1, fmap2, num_levels=num_levels, radius=radius)
+    raise ValueError(f"unknown corr implementation {impl!r}")

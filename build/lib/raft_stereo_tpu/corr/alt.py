@@ -1,0 +1,57 @@
+"""``alt`` correlation: on-the-fly lookup, no materialized W^2 volume.
+
+Reference ``PytorchAlternateCorrBlock1D`` (``core/corr.py:64-107``): per level,
+sample ``2r+1`` feature vectors from (width-pooled) fmap2 around the current
+coordinate and dot them with fmap1. This is the memory-efficient path for
+full-resolution inputs — the reference's "long-context" strategy (recompute
+instead of materialize, ``README.md:121``).
+
+Equivalence note: pooling fmap2 then dotting equals pooling the precomputed
+volume (the dot is linear), so ``alt`` matches ``reg`` bit-for-bit up to
+floating-point association — property-tested in ``tests/test_corr.py``.
+
+Memory per lookup: O(B * H * W * (2r+1) * D) — linear in W.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from raft_stereo_tpu.ops.chunked import map_chunked
+from raft_stereo_tpu.ops.pooling import avg_pool_w2
+from raft_stereo_tpu.ops.sampler import sample_rows_zeros
+
+
+def make_alt_corr_fn(fmap1: jax.Array, fmap2: jax.Array, *,
+                     num_levels: int, radius: int):
+    f1 = fmap1.astype(jnp.float32)
+    pyramid2 = [fmap2.astype(jnp.float32)]
+    for _ in range(num_levels - 1):
+        pyramid2.append(avg_pool_w2(pyramid2[-1]))
+    d = fmap1.shape[-1]
+    scale = 1.0 / math.sqrt(d)
+    dx = jnp.arange(-radius, radius + 1, dtype=jnp.float32)
+    k = 2 * radius + 1
+
+    def row_lookup(args):
+        """Per-H-chunk lookup; keeps the one-hot weight tensors bounded."""
+        f1_c, coords_c, *pyr_c = args
+        out = []
+        for i, f2 in enumerate(pyr_c):
+            xs = coords_c.astype(jnp.float32)[..., None] / (2 ** i) + dx
+            b, hc, w1 = coords_c.shape
+            sampled = sample_rows_zeros(f2, xs.reshape(b, hc, w1 * k))
+            sampled = sampled.reshape(b, hc, w1, k, d)
+            out.append(jnp.einsum("bhwkd,bhwd->bhwk", sampled, f1_c) * scale)
+        return jnp.concatenate(out, axis=-1)
+
+    def corr_fn(coords_x: jax.Array, h_chunk: int = 32) -> jax.Array:
+        # Map over H chunks: peak memory O(chunk * W1 * (2r+1) * W2) for the
+        # one-hot sampling weights instead of O(H * ...) — the point of `alt`.
+        return map_chunked(row_lookup, (f1, coords_x, *pyramid2),
+                           chunk=h_chunk, axis=1)
+
+    return corr_fn

@@ -1,0 +1,199 @@
+"""``alt_tpu``: blockwise fused build+sample correlation, no W^2 volume.
+
+Fills — properly — the hole the reference leaves: its ``alt_cuda`` choice
+crashes at construction (``core/corr.py:159-161`` raises
+NotImplementedError). This is the memory path for full-resolution work
+(Middlebury-F), the framework's "long-context" strategy: recompute the
+correlation on the fly instead of materializing the O(B*H*W^2) volume —
+the exact trade blockwise/flash attention makes.
+
+Kernel design: one grid cell per image row (b, h). The cell receives the
+f1 row and the level-0 f2 row (width-padded to a vreg multiple) and, per
+level,
+
+1. pools the f2 row in VMEM (pairwise width averaging — the whole
+   pyramid lives on-chip; nothing per-level ever reaches HBM);
+2. computes that row's correlation block on the MXU —
+   ``vol = f1_row @ f2_row^T / sqrt(D)`` with fp32 accumulation, shape
+   ``(W1, W2p_l)``, living only in VMEM;
+3. immediately runs the same windowed-gather + lerp as ``reg_tpu``
+   (``pallas_reg.gather_lerp_taps``) and writes the ``(W1, 2r+1)`` taps.
+
+Nothing W^2-sized and no pooled pyramid ever reaches HBM: peak footprint
+per cell is the f1/f2 rows plus one ``(W1, W2p)`` VMEM block (~2.3 MB at
+Middlebury-F 1/4-res). The MXU rebuilds the volume every lookup — FLOPs
+traded for HBM exactly as the reference's ``alt`` trades them for CUDA
+memory (``README.md:121``).
+
+Width padding: the single pre-kernel pad to a 128-multiple happens before
+pooling — pad zeros pool to zeros, and the one half-real boundary entry an
+odd true width produces lands outside ``widths[lvl]`` where the tap mask
+zeroes it, so this is identical to the reference's pad-free floor-halving
+pyramid. The feature maps keep their dtype (bf16 under mixed precision);
+the dot accumulates fp32 on the MXU, so only the inputs — not the
+correlation math — are reduced precision, mirroring the reference's
+fp16-capable CUDA path (``sampler_kernel.cu:126``).
+
+Math note: sampling fmap2 first and dotting (the reference's ``alt``,
+``core/corr.py:72-87``) equals lerping the on-the-fly volume row (the dot
+is linear), so this matches ``reg`` bit-for-bit up to fp association —
+property-tested against both.
+
+Backward: ``custom_vjp`` to the feature maps via the masked one-hot XLA
+formulation (H-chunked to bound the transient volume), no coord grad —
+the reference detaches coords each GRU iteration (``raft_stereo.py:109``).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from raft_stereo_tpu.corr.pallas_reg import (
+    _interpret, gather_lerp_taps, level_widths, pad_width)
+from raft_stereo_tpu.ops.chunked import map_chunked
+
+
+def _pool_rows(f2: jax.Array) -> jax.Array:
+    """Pairwise width pooling: (..., W, D) -> (..., W//2, D).
+
+    The single definition shared by the Pallas kernel and its custom_vjp
+    backward — the two must stay numerically identical (the backward IS
+    the gradient definition for the forward).
+    """
+    *lead, w, d = f2.shape
+    f2r = f2.reshape(*lead, w // 2, 2, d)
+    return (f2r[..., 0, :] + f2r[..., 1, :]) * 0.5
+
+
+def _alt_kernel(coords_ref, f1_ref, f2_ref, out_ref, *, radius: int,
+                num_levels: int, widths: Sequence[int], scale: float):
+    k = 2 * radius + 1
+    c = coords_ref[0]  # (W1, 1)
+    f1 = f1_ref[0]     # (W1, D)
+    f2 = f2_ref[0]     # (W2p, D) — level 0, width-padded
+    for lvl in range(num_levels):
+        if lvl:
+            f2 = _pool_rows(f2)
+        vol = jax.lax.dot_general(
+            f1, f2, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (W1, W2p_l)
+        cl = c * (1.0 / (1 << lvl))
+        out_ref[0, :, lvl * k:(lvl + 1) * k] = gather_lerp_taps(
+            vol, cl, radius, widths[lvl])
+
+
+def _pallas_alt(f1: jax.Array, f2: jax.Array, coords: jax.Array,
+                radius: int, num_levels: int,
+                widths: Tuple[int, ...], scale: float) -> jax.Array:
+    """f1: (BH, W1, D); f2: (BH, W2p, D) level-0 padded; coords: (BH, W1, 1)."""
+    bh, w1, d = f1.shape
+    w2p = f2.shape[1]
+    k = 2 * radius + 1
+    out_ch = num_levels * k
+    kernel = functools.partial(_alt_kernel, radius=radius,
+                               num_levels=num_levels, widths=widths,
+                               scale=scale)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((bh, w1, out_ch), jnp.float32),
+        grid=(bh,),
+        in_specs=[pl.BlockSpec((1, w1, 1), lambda i: (i, 0, 0),
+                               memory_space=pltpu.VMEM),
+                  pl.BlockSpec((1, w1, d), lambda i: (i, 0, 0),
+                               memory_space=pltpu.VMEM),
+                  pl.BlockSpec((1, w2p, d), lambda i: (i, 0, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((1, w1, out_ch), lambda i: (i, 0, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=_interpret(),
+    )(coords, f1, f2)
+
+
+def _masked_alt_xla(f1: jax.Array, f2: jax.Array, coords: jax.Array,
+                    radius: int, num_levels: int,
+                    widths: Tuple[int, ...], scale: float) -> jax.Array:
+    """On-the-fly masked one-hot reference — the custom_vjp backward.
+
+    Pools the padded f2 row per level exactly like the kernel, H-chunked
+    via lax.map so the transient (chunk, W1, W2p) volume stays bounded;
+    regular VPU/MXU work in both directions (scatters don't vectorize on
+    TPU).
+    """
+    def chunk(args):
+        f1_c, coords_c, f2_c = args
+        out = []
+        f2l = f2_c
+        for lvl in range(num_levels):
+            if lvl:
+                f2l = _pool_rows(f2l)
+            w2p = f2l.shape[-2]
+            vol = jnp.einsum("nwd,nvd->nwv", f1_c, f2l,
+                             preferred_element_type=jnp.float32) * scale
+            cl = coords_c * (1.0 / (1 << lvl))
+            i0 = jnp.floor(cl)
+            frac = cl - i0
+            base = i0 - radius
+            j = jnp.arange(w2p, dtype=jnp.float32)
+            valid_j = j < widths[lvl]
+            taps = []
+            for t in range(2 * radius + 2):
+                onehot = ((j == base + t) & valid_j).astype(jnp.float32)
+                taps.append(jnp.sum(vol * onehot, axis=-1))
+            g = jnp.stack(taps, axis=-1)
+            out.append(g[..., :-1] * (1.0 - frac) + g[..., 1:] * frac)
+        return jnp.concatenate(out, axis=-1)
+
+    return map_chunked(chunk, (f1, coords, f2), chunk=8, axis=0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _alt_lookup(f1, f2, coords, radius: int, num_levels: int,
+                widths: Tuple[int, ...], scale: float):
+    return _pallas_alt(f1, f2, coords, radius, num_levels, widths, scale)
+
+
+def _alt_fwd(f1, f2, coords, radius, num_levels, widths, scale):
+    out = _alt_lookup(f1, f2, coords, radius, num_levels, widths, scale)
+    return out, (f1, f2, coords)
+
+
+def _alt_bwd(radius, num_levels, widths, scale, residuals, g):
+    f1, f2, coords = residuals
+    _, vjp = jax.vjp(
+        lambda a, b: _masked_alt_xla(a, b, coords, radius, num_levels,
+                                     widths, scale),
+        f1, f2)
+    df1, df2 = vjp(g)
+    return df1, df2, jnp.zeros_like(coords)
+
+
+_alt_lookup.defvjp(_alt_fwd, _alt_bwd)
+
+
+def make_alt_tpu_corr_fn(fmap1: jax.Array, fmap2: jax.Array, *,
+                         num_levels: int, radius: int):
+    b, h, w1, d = fmap1.shape
+    w2 = fmap2.shape[2]
+    widths = level_widths(w2, num_levels)
+    scale = 1.0 / math.sqrt(d)
+    # One width pad to a 128-multiple divisible by 2^(num_levels-1) so the
+    # in-kernel pooling chain stays aligned (128 = 2^7 covers any level
+    # count the model uses).
+    f2p = jnp.pad(fmap2, ((0, 0), (0, 0), (0, pad_width(w2) - w2), (0, 0)))
+    f2_flat = f2p.reshape(b * h, -1, d)
+    f1_flat = fmap1.reshape(b * h, w1, d)
+
+    def corr_fn(coords_x: jax.Array) -> jax.Array:
+        coords_flat = coords_x.astype(jnp.float32).reshape(b * h, w1, 1)
+        out = _alt_lookup(f1_flat, f2_flat, coords_flat, radius, num_levels,
+                          widths, scale)
+        return out.reshape(b, h, w1, -1)
+
+    return corr_fn

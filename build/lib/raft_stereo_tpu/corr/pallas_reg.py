@@ -1,0 +1,265 @@
+"""``reg_tpu``: the reg correlation lookup as a Pallas TPU kernel.
+
+TPU-native analog of the reference's only native component, the CUDA
+``corr_sampler`` extension (``sampler/sampler_kernel.cu:20-105`` forward,
+``:63-105`` backward; pybind binding ``sampler/sampler.cpp:48-51``): per
+output pixel, read the pyramid row ``volume[b, h, w1, :]`` and linearly
+interpolate ``2r+2`` integer taps into ``2r+1`` outputs per level, with
+out-of-range taps contributing zero.
+
+Kernel design (how a gather maps onto a machine with no per-lane dynamic
+addressing):
+
+- Mosaic's one dynamic-gather primitive is ``take_along_axis`` along the
+  lane axis of a single vreg — the index and operand must both be
+  ``(sublanes, 128)``. The ``2r+2`` taps of one pixel are *contiguous*
+  integers, so the whole tap window fits in one 128-lane vreg.
+- Per pixel: (1) **coarse align** — select the two vreg-aligned 128-lane
+  slabs of the volume row that bracket the tap window ``[i0-r, i0+r+1]``
+  (the window may straddle a slab boundary, so both the slab containing
+  the first tap and its successor are selected). Each selection is an
+  unrolled select-scan over the row's ``W2p/128`` aligned slabs: ~2 VPU
+  ops per volume element per scan, versus ~3 ops *per tap* per element
+  for the one-hot fallback — an order of magnitude less VPU work.
+  (2) **fine gather** — one ``take_along_axis`` per slab with the
+  window-relative lane index, then a per-tap select by whether the tap
+  falls in the first or second slab, leaving tap ``t`` at lane ``t``.
+  (3) mask out-of-range taps to zero (``grid_sample`` zero-padding
+  semantics), lerp adjacent lanes.
+- Grid is over flattened pixel tiles ``(B*H*W1) / TILE``; pyramid levels
+  stream HBM->VMEM via BlockSpec pipelining. Output rows are pixels, so
+  partial boundary tiles are safe: garbage rows never contaminate real
+  rows (the gather is row-local) and are sliced off at the end.
+
+Width padding: fmap2 is zero-padded to a 128-multiple *before* the
+volume einsum, so no post-hoc volume copy is needed; per-level true
+widths (successive floor halving of the original W2) bound the tap mask,
+which also hides the pooled-boundary artifact when a level width is odd.
+
+Precision: the pyramid is stored in the feature-map dtype (bf16 under the
+mixed-precision policy — the analog of the reference's fp16-capable CUDA
+sampler, ``sampler_kernel.cu:126``) and upcast to fp32 inside the kernel,
+so lerp arithmetic is fp32 and volume HBM traffic — the lookup's cost —
+is halved. The fp32 path stores fp32 and is exact.
+
+Backward (training): ``custom_vjp`` — gradient flows to the volume only,
+none to coords, exactly like the CUDA sampler (``core/corr.py:24-29``
+returns ``None`` for the coords grad; coords are detached upstream each
+GRU iteration anyway). The volume-grad scatter is the transpose of a
+gather — irregular writes that do not map to TPU vector memory — so the
+backward runs the *masked one-hot* formulation in plain XLA (regular
+VPU/MXU work in both directions), numerically identical to the kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from raft_stereo_tpu.corr.reg import build_pyramid
+
+LANE = 128
+TILE = 512  # pixels per grid cell (swept 128-1024 on v5e: 512 best by ~1%)
+
+
+def _interpret() -> bool:
+    """Compiled Mosaic on TPU; interpreter everywhere else (CPU tests)."""
+    return jax.default_backend() not in ("tpu",)
+
+
+def pad_width(w: int) -> int:
+    """Smallest vreg-width (128) multiple >= w."""
+    return -(-w // LANE) * LANE
+
+
+def gather_lerp_taps(vol, cl, radius: int, w2: int):
+    """Windowed-gather + lerp over one level's rows held in VMEM/registers.
+
+    vol: (P, W2p) rows, any float dtype (the selects/gathers run in the
+    storage dtype — half the vreg traffic for bf16 rows — and the gathered
+    taps are upcast so the lerp arithmetic is always fp32); cl: (P, 1)
+    fp32 level-scaled positions. Returns (P, 2r+1) fp32 lerped taps with
+    zero-pad semantics. Shared by the reg_tpu (volume-resident) and
+    alt_tpu (fused on-the-fly) kernels.
+    """
+    p, w2p = vol.shape
+    if w2p % LANE:
+        # Lane-pad to a vreg multiple in VMEM (callers with HBM-resident
+        # rows pre-pad instead; in-kernel pooled rows land here).
+        vol = jnp.concatenate(
+            [vol, jnp.zeros((p, LANE - w2p % LANE), vol.dtype)], axis=-1)
+        w2p = vol.shape[-1]
+    k = 2 * radius + 1
+    lane = jax.lax.broadcasted_iota(jnp.int32, (p, LANE), 1)
+    i0 = jnp.floor(cl)
+    frac = cl - i0  # (P, 1)
+    base = i0.astype(jnp.int32) - radius  # first tap position
+    xpos = base + lane  # true tap position in the row
+    if w2p > LANE:
+        # Coarse: select the two vreg-aligned 128-lane slabs bracketing the
+        # tap window (select-scans over aligned slices only — no cross-vreg
+        # relayouts; ~2 VPU ops per element per scan, once per level).
+        nslab = w2p // LANE
+        slab = jnp.clip(base // LANE, 0, nslab - 1)
+        slab_b = jnp.minimum(slab + 1, nslab - 1)
+        win_a = vol[:, 0:LANE]
+        win_b = vol[:, (nslab - 1) * LANE:]
+        for s in range(1, nslab):
+            win_a = jnp.where(slab == s, vol[:, s * LANE:(s + 1) * LANE],
+                              win_a)
+        for s in range(1, nslab - 1):
+            win_b = jnp.where(slab_b == s, vol[:, s * LANE:(s + 1) * LANE],
+                              win_b)
+        # Fine: Mosaic's take_along_axis works on exactly one 128-lane vreg;
+        # the 2r+2-tap window may straddle the slab boundary, so gather both
+        # slabs and select per tap. Lane t then holds tap t. The gather
+        # operands upcast to fp32 HERE — Mosaic's dynamic_gather requires
+        # the index and result bitwidths to match (i32 indices), so only
+        # the two selected slabs pay the conversion, not the whole row.
+        rel = base - slab * LANE + lane  # [0, 128+2r+1] when in range
+        g_a = jnp.take_along_axis(win_a.astype(jnp.float32),
+                                  jnp.clip(rel, 0, LANE - 1), axis=-1)
+        g_b = jnp.take_along_axis(win_b.astype(jnp.float32),
+                                  jnp.clip(rel - LANE, 0, LANE - 1), axis=-1)
+        g = jnp.where(rel < LANE, g_a, g_b)
+        # rel >= 128 with slab_b == slab reads the wrong slab, but then
+        # xpos >= w2p >= w2, so the bounds mask below zeroes it.
+    else:
+        g = jnp.take_along_axis(vol.astype(jnp.float32),
+                                jnp.clip(xpos, 0, LANE - 1), axis=-1)
+    g = jnp.where((xpos >= 0) & (xpos < w2), g, 0.0)
+    return g[:, :k] * (1.0 - frac) + g[:, 1:k + 1] * frac
+
+
+def _lookup_kernel(coords_ref, *refs, radius: int, widths: Sequence[int]):
+    *vol_refs, out_ref = refs
+    k = 2 * radius + 1
+    c = coords_ref[:]  # (TILE, 1) fp32
+    for lvl, vol_ref in enumerate(vol_refs):
+        cl = c * (1.0 / (1 << lvl))
+        out_ref[:, lvl * k:(lvl + 1) * k] = gather_lerp_taps(
+            vol_ref[:], cl, radius, widths[lvl])
+
+
+def _pallas_lookup(pyramid: Sequence[jax.Array], coords_flat: jax.Array,
+                   radius: int, widths: Tuple[int, ...]) -> jax.Array:
+    """pyramid: list of (N, W2p_l) fp32; coords_flat: (N, 1) fp32."""
+    n = coords_flat.shape[0]
+    k = 2 * radius + 1
+    out_ch = len(pyramid) * k
+    grid = pl.cdiv(n, TILE)
+    kernel = functools.partial(_lookup_kernel, radius=radius, widths=widths)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((n, out_ch), jnp.float32),
+        grid=(grid,),
+        in_specs=[pl.BlockSpec((TILE, 1), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)] +
+                 [pl.BlockSpec((TILE, p.shape[-1]), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM) for p in pyramid],
+        out_specs=pl.BlockSpec((TILE, out_ch), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=_interpret(),
+    )(coords_flat, *pyramid)
+    return out
+
+
+def _masked_lookup_xla(pyramid: Sequence[jax.Array], coords_flat: jax.Array,
+                       radius: int, widths: Tuple[int, ...]) -> jax.Array:
+    """One-hot-reduce lookup over *padded* rows with true-width masking.
+
+    Matches the kernel bit-for-bit in exact arithmetic; exists as (a) the
+    custom_vjp backward (its VJP is regular VPU/MXU work — scatters don't
+    vectorize on TPU) and (b) an oracle for the kernel tests.
+    """
+    out = []
+    for lvl, vol in enumerate(pyramid):
+        w2p = vol.shape[-1]
+        w2 = widths[lvl]
+        cl = coords_flat * (1.0 / (1 << lvl))
+        i0 = jnp.floor(cl)
+        frac = cl - i0
+        base = i0 - radius
+        j = jnp.arange(w2p, dtype=jnp.float32)
+        valid_j = j < w2
+        vol32 = vol.astype(jnp.float32)  # match the kernel's fp32 lerp
+        taps = []
+        for t in range(2 * radius + 2):
+            onehot = ((j == base + t) & valid_j).astype(jnp.float32)
+            taps.append(jnp.sum(vol32 * onehot, axis=-1))
+        g = jnp.stack(taps, axis=-1)  # (N, 2r+2)
+        out.append(g[:, :-1] * (1.0 - frac) + g[:, 1:] * frac)
+    return jnp.concatenate(out, axis=-1)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _lookup(pyramid: List[jax.Array], coords_flat: jax.Array,
+            radius: int, widths: Tuple[int, ...]) -> jax.Array:
+    return _pallas_lookup(pyramid, coords_flat, radius, widths)
+
+
+def _lookup_fwd(pyramid, coords_flat, radius, widths):
+    return _lookup(pyramid, coords_flat, radius, widths), (pyramid, coords_flat)
+
+
+def _lookup_bwd(radius, widths, residuals, g):
+    pyramid, coords_flat = residuals
+    _, vjp = jax.vjp(
+        lambda p: _masked_lookup_xla(p, coords_flat, radius, widths), pyramid)
+    (d_pyramid,) = vjp(g)
+    return d_pyramid, jnp.zeros_like(coords_flat)
+
+
+_lookup.defvjp(_lookup_fwd, _lookup_bwd)
+
+
+def level_widths(w2: int, num_levels: int) -> Tuple[int, ...]:
+    """True (unpadded) per-level widths: successive floor halving."""
+    ws = [w2]
+    for _ in range(num_levels - 1):
+        ws.append(ws[-1] // 2)
+    return tuple(ws)
+
+
+def make_reg_tpu_corr_fn(fmap1: jax.Array, fmap2: jax.Array, *,
+                         num_levels: int, radius: int):
+    b, h, w1, _ = fmap1.shape
+    w2 = fmap2.shape[2]
+    widths = level_widths(w2, num_levels)
+    # Zero-pad fmap2's width before the einsum: the padded volume region is
+    # exactly zero, so no post-hoc volume copy; deeper levels whose pooled
+    # width falls under one vreg get a (cheap) per-level re-pad. The pyramid
+    # is stored in the fmap dtype (bf16 under mixed precision — halves the
+    # lookup's HBM traffic; the kernel upcasts rows to fp32 for the lerp).
+    f2p = jnp.pad(fmap2, ((0, 0), (0, 0), (0, pad_width(w2) - w2), (0, 0)))
+    # The einsum runs — and emits — the fmap dtype (the MXU accumulates
+    # fp32 within the single K=256 pass regardless): upcasting the inputs
+    # (build_volume) would materialize a full fp32 volume (2.1 GB at
+    # Middlebury-F) before the downcast, and requesting an fp32 output
+    # type breaks the autodiff transpose for bf16 operands. Identical when
+    # fmaps are fp32.
+    d = fmap1.shape[-1]
+    vol = jnp.einsum("bhid,bhjd->bhij", fmap1, f2p) * (1.0 / d ** 0.5)
+    pyramid = build_pyramid(vol, num_levels)
+    flat = []
+    for lvl, vol in enumerate(pyramid):
+        wp = vol.shape[-1]
+        want = pad_width(widths[lvl])
+        if wp < want:
+            vol = jnp.pad(vol, ((0, 0), (0, 0), (0, 0), (0, want - wp)))
+        elif wp > want:
+            vol = vol[..., :want]
+        flat.append(vol.reshape(b * h * w1, -1))
+
+    def corr_fn(coords_x: jax.Array) -> jax.Array:
+        n = b * h * w1
+        coords_flat = coords_x.astype(jnp.float32).reshape(n, 1)
+        out = _lookup(flat, coords_flat, radius, widths)
+        return out.reshape(b, h, w1, -1)
+
+    return corr_fn

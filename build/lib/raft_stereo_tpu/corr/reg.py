@@ -1,0 +1,87 @@
+"""``reg`` correlation: precomputed all-pairs 1D volume + pyramid, XLA lookup.
+
+Reference ``CorrBlock1D`` (``core/corr.py:110-156``): the volume is one big
+batched matmul over the feature dim — ideal MXU work — followed by width
+halving via 1x2 average pooling. The lookup gathers ``2r+1`` taps per pixel per
+level with zero-padded linear interpolation.
+
+Reference quirk reproduced *in effect only*: the torch code appends the base
+level plus ``num_levels`` pooled levels (``corr.py:122-125``) but indexes only
+the first ``num_levels`` (``corr.py:133``); building the unused last level is
+wasted work, so only levels ``0..num_levels-1`` are materialized here (outputs
+are identical).
+
+Memory: O(B * H * W^2) fp32 — for full-resolution work use ``alt``/``alt_tpu``
+(the reference documents the same guidance, ``README.md:121``).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from raft_stereo_tpu.ops.pooling import avg_pool_last
+
+
+def build_volume(fmap1: jax.Array, fmap2: jax.Array) -> jax.Array:
+    """All-pairs 1D correlation along epipolar rows: (B, H, W1, W2), fp32.
+
+    Matches ``CorrBlock1D.corr`` (``core/corr.py:148-156``): dot over the
+    feature dim, normalized by sqrt(D).
+    """
+    d = fmap1.shape[-1]
+    vol = jnp.einsum("bhid,bhjd->bhij",
+                     fmap1.astype(jnp.float32), fmap2.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
+    return vol / math.sqrt(d)
+
+
+def build_pyramid(volume: jax.Array, num_levels: int) -> List[jax.Array]:
+    """Width-halving pyramid: level i has shape (B, H, W1, W2 // 2^i)."""
+    pyramid = [volume]
+    for _ in range(num_levels - 1):
+        pyramid.append(avg_pool_last(pyramid[-1]))
+    return pyramid
+
+
+def lookup_pyramid(pyramid: List[jax.Array], coords_x: jax.Array,
+                   radius: int) -> jax.Array:
+    """Sample ``2r+1`` lerped taps around ``coords_x / 2^i`` at every level.
+
+    coords_x: (B, H, W1) fractional x positions at full (1/4-res) width.
+    Returns (B, H, W1, num_levels * (2r+1)), level-major then offset -r..r
+    (the concat order of ``core/corr.py:132-145``).
+
+    TPU formulation: the taps sit at consecutive integer offsets from one
+    fractional base, so the ``2r+1`` samples share ``2r+2`` integer taps and
+    one lerp fraction. Each integer tap is a one-hot reduce over the volume
+    row (regular VPU work; per-pixel gathers lower to serial loops on TPU and
+    measured ~45x slower — see ``ops/sampler.py``).
+    """
+    out = []
+    for i, vol in enumerate(pyramid):
+        w2 = vol.shape[-1]
+        cl = coords_x.astype(jnp.float32) / (2 ** i)
+        i0 = jnp.floor(cl)
+        frac = (cl - i0)[..., None]
+        j = jnp.arange(w2, dtype=jnp.float32)
+        taps = []
+        for d in range(-radius, radius + 2):  # 2r+2 integer taps
+            onehot = (j == (i0[..., None] + d)).astype(vol.dtype)
+            taps.append(jnp.sum(vol * onehot, axis=-1))
+        g = jnp.stack(taps, axis=-1)  # (B, H, W1, 2r+2)
+        out.append(g[..., :-1] * (1.0 - frac) + g[..., 1:] * frac)
+    return jnp.concatenate(out, axis=-1)
+
+
+def make_reg_corr_fn(fmap1: jax.Array, fmap2: jax.Array, *,
+                     num_levels: int, radius: int):
+    pyramid = build_pyramid(build_volume(fmap1, fmap2), num_levels)
+
+    def corr_fn(coords_x: jax.Array) -> jax.Array:
+        return lookup_pyramid(pyramid, coords_x, radius)
+
+    return corr_fn

@@ -1,0 +1,25 @@
+"""Data layer: format IO, augmentation, datasets, and a prefetching loader.
+
+Replaces the reference's torch ``Dataset``/``DataLoader`` stack
+(``core/stereo_datasets.py``, ``core/utils/{frame_utils,augmentor}.py``) with a
+numpy-native pipeline that feeds NHWC batches straight to the TPU:
+
+- ``frame_utils`` — PFM/PNG/flo format readers and writers;
+- ``photometric`` — numpy color jitter (torchvision-equivalent semantics);
+- ``augmentor`` — dense + sparse augmentors with explicit RNG;
+- ``datasets`` — the 7 dataset classes + mixing;
+- ``loader`` — threaded prefetch loader producing batched numpy arrays.
+"""
+
+from raft_stereo_tpu.data.augmentor import FlowAugmentor, SparseFlowAugmentor
+from raft_stereo_tpu.data.datasets import (
+    ETH3D, KITTI, FallingThings, Middlebury, SceneFlowDatasets, SintelStereo,
+    StereoDataset, TartanAir, fetch_dataset)
+from raft_stereo_tpu.data.loader import StereoLoader, fetch_dataloader
+
+__all__ = [
+    "FlowAugmentor", "SparseFlowAugmentor", "StereoDataset",
+    "SceneFlowDatasets", "ETH3D", "SintelStereo", "FallingThings",
+    "TartanAir", "KITTI", "Middlebury", "fetch_dataset",
+    "StereoLoader", "fetch_dataloader",
+]

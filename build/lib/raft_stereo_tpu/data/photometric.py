@@ -1,0 +1,136 @@
+"""Numpy photometric augmentation with torchvision-equivalent semantics.
+
+The reference composes ``torchvision.transforms.ColorJitter`` with a gamma
+adjustment (``core/utils/augmentor.py:78,200,47-58``). This module reproduces
+those semantics on uint8 numpy arrays with an explicit ``np.random.Generator``:
+
+- brightness/contrast/saturation factors ~ U[max(0, 1-a), 1+a] (or a given
+  [lo, hi] range), hue shift ~ U[-h, h] in "turns";
+- the four jitter ops are applied in a uniformly random order (torchvision
+  shuffles the op order per call);
+- blends follow torchvision: brightness scales, contrast blends with the mean
+  gray value, saturation blends with per-pixel grayscale (ITU-R 601 weights),
+  hue rotates the HSV hue channel;
+- gamma: ``out = 255 * gain * (in/255)**gamma``.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple, Union
+
+import numpy as np
+
+import cv2
+
+from raft_stereo_tpu import native
+
+cv2.setNumThreads(0)
+cv2.ocl.setUseOpenCL(False)
+
+_GRAY_WEIGHTS = np.asarray([0.299, 0.587, 0.114], np.float32)  # ITU-R 601
+
+Range = Union[float, Tuple[float, float], Sequence[float]]
+
+
+def _as_range(value: Range, center: float = 1.0) -> Tuple[float, float]:
+    if np.isscalar(value):
+        lo, hi = center - float(value), center + float(value)
+        return max(0.0, lo), hi
+    lo, hi = value
+    return float(lo), float(hi)
+
+
+def _blend(img: np.ndarray, other: np.ndarray, factor: float) -> np.ndarray:
+    out = factor * img + (1.0 - factor) * other
+    return np.clip(out, 0.0, 255.0)
+
+
+def adjust_brightness(img: np.ndarray, factor: float) -> np.ndarray:
+    return _blend(img, np.zeros_like(img), factor)
+
+
+def adjust_contrast(img: np.ndarray, factor: float) -> np.ndarray:
+    mean_gray = (img @ _GRAY_WEIGHTS).mean()
+    return _blend(img, np.full_like(img, mean_gray), factor)
+
+
+def adjust_saturation(img: np.ndarray, factor: float) -> np.ndarray:
+    gray = (img @ _GRAY_WEIGHTS)[..., None]
+    return _blend(img, np.broadcast_to(gray, img.shape), factor)
+
+
+def adjust_hue(img: np.ndarray, shift_turns: float) -> np.ndarray:
+    """Rotate hue by ``shift_turns`` of a full color wheel (torchvision units)."""
+    hsv = cv2.cvtColor(img.astype(np.uint8), cv2.COLOR_RGB2HSV)
+    h = hsv[..., 0].astype(np.int32)  # OpenCV hue is [0, 180)
+    hsv[..., 0] = ((h + int(round(shift_turns * 180.0))) % 180).astype(np.uint8)
+    return cv2.cvtColor(hsv, cv2.COLOR_HSV2RGB).astype(np.float32)
+
+
+def adjust_gamma(img: np.ndarray, gamma: float, gain: float = 1.0) -> np.ndarray:
+    out = 255.0 * gain * np.power(img / 255.0, gamma)
+    return np.clip(out, 0.0, 255.0)
+
+
+class ColorJitter:
+    """uint8 (H, W, 3) -> uint8, torchvision ``ColorJitter`` + ``AdjustGamma``."""
+
+    def __init__(self, brightness: Range = 0.0, contrast: Range = 0.0,
+                 saturation: Range = 0.0, hue: float = 0.0,
+                 gamma: Sequence[float] = (1.0, 1.0, 1.0, 1.0)):
+        self.brightness = _as_range(brightness)
+        self.contrast = _as_range(contrast)
+        self.saturation = _as_range(saturation)
+        self.hue = float(hue)
+        # gamma bounds are (gamma_min, gamma_max, gain_min, gain_max), with the
+        # gain pair defaulting to 1 (reference AdjustGamma, augmentor.py:47-58).
+        g = tuple(gamma) + (1.0, 1.0)[:max(0, 4 - len(tuple(gamma)))]
+        self.gamma_range, self.gain_range = (g[0], g[1]), (g[2], g[3])
+
+    def __call__(self, img: np.ndarray, rng: np.random.Generator) -> np.ndarray:
+        out = img.astype(np.float32)
+        ops = list(rng.permutation(4))
+        b = rng.uniform(*self.brightness)
+        c = rng.uniform(*self.contrast)
+        s = rng.uniform(*self.saturation)
+        h = rng.uniform(-self.hue, self.hue)
+        gamma_gain_draw = (rng.uniform(*self.gamma_range),
+                           rng.uniform(*self.gain_range))
+        if native.available():
+            self._apply_native(out, ops, b, c, s, h, *gamma_gain_draw)
+            return out.astype(np.uint8)
+        for op in ops:
+            if op == 0:
+                out = adjust_brightness(out, b)
+            elif op == 1:
+                out = adjust_contrast(out, c)
+            elif op == 2:
+                out = adjust_saturation(out, s)
+            elif op == 3 and self.hue > 0:
+                out = adjust_hue(out, h)
+        gamma, gain = gamma_gain_draw
+        if gamma != 1.0 or gain != 1.0:
+            out = adjust_gamma(out, gamma, gain)
+        return out.astype(np.uint8)
+
+    def _apply_native(self, out: np.ndarray, ops, b: float, c: float,
+                      s: float, h: float, gamma: float, gain: float) -> None:
+        """In-place jitter via the C++ kernels (``native/photometric.cpp``).
+
+        Same op order and per-pixel float32 maths as the numpy path; runs of
+        hue-free ops go through one ``native.jitter_ops`` call, the hue op
+        (cv2 uint8 HSV fixed-point — already native) splits the sequence. The
+        foreign calls release the GIL, so loader worker threads overlap.
+        """
+        pending: list = []
+        for op in ops:
+            if op == 3:
+                if self.hue > 0:
+                    native.jitter_ops(out, pending, b, c, s)
+                    pending = []
+                    out[...] = adjust_hue(out, h)
+            else:
+                pending.append(int(op))
+        native.jitter_ops(out, pending, b, c, s)
+        if gamma != 1.0 or gain != 1.0:
+            native.gamma(out, gamma, gain)

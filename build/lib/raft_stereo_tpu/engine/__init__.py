@@ -1,0 +1,10 @@
+"""Training and evaluation engines."""
+
+from raft_stereo_tpu.engine.checkpoint import (  # noqa: F401
+    load_checkpoint, load_params, save_checkpoint)
+from raft_stereo_tpu.engine.logger import Logger  # noqa: F401
+from raft_stereo_tpu.engine.loss import sequence_loss  # noqa: F401
+from raft_stereo_tpu.engine.optimizer import (  # noqa: F401
+    make_optimizer, onecycle_linear_schedule)
+from raft_stereo_tpu.engine.steps import (  # noqa: F401
+    make_eval_step, make_train_step)

@@ -1,0 +1,59 @@
+"""Full-state checkpointing: params + optimizer + step in one bundle.
+
+The reference saves model weights only (``torch.save(model.state_dict())``,
+``train_stereo.py:184``), so a resumed run restarts the OneCycle schedule from
+zero — flagged in SURVEY §5 as a deliberate improvement target. Here a
+checkpoint is the complete training state, serialized with flax msgpack
+(pytree-structure-preserving, works for optax named-tuple states), written
+atomically (tmp file + rename) so preemption mid-save never corrupts the
+latest checkpoint.
+
+``load_params`` additionally accepts the reference's ``.pth`` checkpoints via
+the transplant shim, so all published RAFT-Stereo weights load anywhere our
+checkpoints do.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+from flax import serialization
+
+CKPT_SUFFIX = ".msgpack"
+
+
+def save_checkpoint(path: str, params, opt_state=None, step: int = 0) -> str:
+    """Serialize (params, opt_state, step) atomically; returns the path."""
+    state = {"params": jax.device_get(params),
+             "opt_state": (jax.device_get(opt_state)
+                           if opt_state is not None else None),
+             "step": step}
+    blob = serialization.to_bytes(state)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+    os.replace(tmp, path)
+    return path
+
+
+def load_checkpoint(path: str, params_template, opt_state_template=None
+                    ) -> Tuple[Any, Any, int]:
+    """Restore (params, opt_state, step); templates define the pytree shape."""
+    with open(path, "rb") as f:
+        blob = f.read()
+    template = {"params": params_template,
+                "opt_state": opt_state_template, "step": 0}
+    state = serialization.from_bytes(template, blob)
+    return state["params"], state["opt_state"], int(state["step"])
+
+
+def load_params(path: str, cfg, params_template=None):
+    """Load model params from either a native bundle or a reference ``.pth``."""
+    if path.endswith(".pth"):
+        from raft_stereo_tpu.transplant import load_pth
+        return load_pth(path, cfg)
+    params, _, _ = load_checkpoint(path, params_template)
+    return params

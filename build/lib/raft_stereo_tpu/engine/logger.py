@@ -1,0 +1,67 @@
+"""Training logger: running means -> TensorBoard + logging (reference
+``train_stereo.py:82-129``).
+
+Same observable behavior: scalars flushed every ``SUM_FREQ=100`` steps from
+running means, per-batch ``live_loss`` and ``learning_rate`` entries, and
+``write_dict`` for validation results. The writer is tensorboardX (pure
+python), lazily constructed so headless / test runs pay nothing.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional
+
+SUM_FREQ = 100
+
+logger = logging.getLogger(__name__)
+
+
+class Logger:
+    def __init__(self, log_dir: str = "runs", scheduler=None):
+        self.log_dir = log_dir
+        self.scheduler = scheduler
+        self.total_steps = 0
+        self.running_loss: Dict[str, float] = {}
+        self.writer = None
+
+    def _ensure_writer(self):
+        if self.writer is None:
+            from tensorboardX import SummaryWriter
+            self.writer = SummaryWriter(log_dir=self.log_dir)
+        return self.writer
+
+    def _print_training_status(self):
+        metrics_data = [self.running_loss[k] / SUM_FREQ
+                        for k in sorted(self.running_loss.keys())]
+        lr = (float(self.scheduler(self.total_steps))
+              if self.scheduler is not None else float("nan"))
+        metrics_str = ("{:10.4f}, " * len(metrics_data)).format(*metrics_data)
+        logger.info("[%6d, %10.7f] %s", self.total_steps + 1, lr, metrics_str)
+
+        writer = self._ensure_writer()
+        for k in self.running_loss:
+            writer.add_scalar(k, self.running_loss[k] / SUM_FREQ,
+                              self.total_steps)
+            self.running_loss[k] = 0.0
+
+    def push(self, metrics: Dict[str, float]):
+        self.total_steps += 1
+        for key, value in metrics.items():
+            self.running_loss[key] = self.running_loss.get(key, 0.0) + float(value)
+        if self.total_steps % SUM_FREQ == SUM_FREQ - 1:
+            self._print_training_status()
+            self.running_loss = {}
+
+    def write_scalar(self, name: str, value: float, step: Optional[int] = None):
+        self._ensure_writer().add_scalar(
+            name, value, self.total_steps if step is None else step)
+
+    def write_dict(self, results: Dict[str, float]):
+        writer = self._ensure_writer()
+        for key, value in results.items():
+            writer.add_scalar(key, value, self.total_steps)
+
+    def close(self):
+        if self.writer is not None:
+            self.writer.close()

@@ -1,0 +1,72 @@
+"""Jitted train / eval steps with data-parallel shardings.
+
+The train step is the reference's inner loop (``train_stereo.py:162-179``) as
+one compiled program: forward (scan over GRU iterations) -> sequence loss ->
+grads -> global-norm clip -> AdamW+OneCycle update. Under a ``Mesh`` the batch
+is sharded over the ``data`` axis and params are replicated; XLA inserts the
+gradient all-reduce (the DataParallel equivalent). Donation of (params,
+opt_state) keeps HBM flat.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh
+
+from raft_stereo_tpu.config import RAFTStereoConfig
+from raft_stereo_tpu.engine.loss import sequence_loss
+from raft_stereo_tpu.models import raft_stereo_forward
+from raft_stereo_tpu.parallel.mesh import data_sharding, replicated
+
+
+def make_train_step(cfg: RAFTStereoConfig, tx: optax.GradientTransformation,
+                    train_iters: int, mesh: Optional[Mesh] = None):
+    """Returns ``train_step(params, opt_state, batch) -> (params, opt_state, metrics)``.
+
+    batch: dict with ``image1``, ``image2`` (B,H,W,3), ``flow`` (B,H,W,1),
+    ``valid`` (B,H,W).
+    """
+
+    def loss_fn(params, batch):
+        preds = raft_stereo_forward(params, cfg, batch["image1"], batch["image2"],
+                                    iters=train_iters)
+        return sequence_loss(preds, batch["flow"], batch["valid"])
+
+    def step(params, opt_state, batch):
+        (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, batch)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        metrics = dict(metrics, loss=loss,
+                       grad_norm=optax.global_norm(grads))
+        return params, opt_state, metrics
+
+    if mesh is None:
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    repl, bsh = replicated(mesh), data_sharding(mesh)
+    return jax.jit(
+        step,
+        in_shardings=(repl, repl, bsh),
+        out_shardings=(repl, repl, repl),
+        donate_argnums=(0, 1))
+
+
+def make_eval_step(cfg: RAFTStereoConfig, valid_iters: int,
+                   mesh: Optional[Mesh] = None):
+    """Returns ``eval_step(params, image1, image2) -> (flow_lr, flow_up)``."""
+
+    def step(params, image1, image2):
+        return raft_stereo_forward(params, cfg, image1, image2,
+                                   iters=valid_iters, test_mode=True)
+
+    if mesh is None:
+        return jax.jit(step)
+    repl, bsh = replicated(mesh), data_sharding(mesh)
+    return jax.jit(step, in_shardings=(repl, bsh, bsh),
+                   out_shardings=(bsh, bsh))

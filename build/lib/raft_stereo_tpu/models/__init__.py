@@ -1,0 +1,12 @@
+"""Model layer: functional modules over explicit param pytrees.
+
+Design stance (SURVEY.md §7): the model is a pure function
+``(params, image1, image2) -> predictions``; parameters live in a plain nested
+dict pytree (trivially shardable, checkpointable, and transplantable from the
+reference's torch state_dict); the GRU refinement loop is a ``jax.lax.scan``.
+"""
+
+from raft_stereo_tpu.models.raft_stereo import (  # noqa: F401
+    init_raft_stereo,
+    raft_stereo_forward,
+)

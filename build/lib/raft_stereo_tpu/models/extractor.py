@@ -1,0 +1,132 @@
+"""Feature and context encoders.
+
+Reference ``core/extractor.py``:
+- ``BasicEncoder`` (:122-197) — feature net: 7x7 stem (stride ``1 + (downsample
+  > 2)``) -> 3 stages of 2 ResidualBlocks at 64/96/128 channels (strides 1,
+  ``1+(downsample>1)``, ``1+(downsample>0)``) -> 1x1 conv to output_dim. For the
+  default ``n_downsample=2`` the output is 1/4 resolution.
+- ``MultiBasicEncoder`` (:199-300) — context net: same trunk plus ``layer4``/
+  ``layer5`` at stride 2 producing three scales, with per-scale output heads.
+  Index convention preserved from the reference: head ``outputs08`` (finest)
+  emits ``dim[2]`` channels, ``outputs32`` (coarsest) emits ``dim[0]``
+  (:231,240,247). ``dual_inp`` (shared-backbone mode) runs both images through
+  the trunk and also returns the full-batch trunk features (:283-285).
+
+Images are fed as a single batch (the reference concatenates the image list
+along batch to share one pass, :173-179); on TPU this keeps one big MXU-friendly
+conv stream.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_stereo_tpu.models.layers import (
+    Params, apply_conv, apply_residual_block, init_conv, init_residual_block)
+
+
+def _init_stage(key, in_planes: int, dim: int, norm_fn: str, stride: int):
+    k1, k2 = jax.random.split(key)
+    return [init_residual_block(k1, in_planes, dim, norm_fn, stride=stride),
+            init_residual_block(k2, dim, dim, norm_fn, stride=1)]
+
+
+def _apply_stage(stage: list, x: jax.Array, norm_fn: str, stride: int) -> jax.Array:
+    x = apply_residual_block(stage[0], x, norm_fn, stride=stride)
+    return apply_residual_block(stage[1], x, norm_fn, stride=1)
+
+
+def _trunk_strides(downsample: int) -> Tuple[int, int, int]:
+    return (1 + (downsample > 2), 1 + (downsample > 1), 1 + (downsample > 0))
+
+
+def init_basic_encoder(key: jax.Array, output_dim: int = 128,
+                       norm_fn: str = "instance", downsample: int = 3) -> Params:
+    from raft_stereo_tpu.models.layers import init_norm
+    ks = jax.random.split(key, 5)
+    return {
+        "conv1": init_conv(ks[0], 7, 7, 3, 64),
+        "norm1": init_norm(norm_fn, 64),
+        "layer1": _init_stage(ks[1], 64, 64, norm_fn, 1),
+        "layer2": _init_stage(ks[2], 64, 96, norm_fn, 1 + (downsample > 1)),
+        "layer3": _init_stage(ks[3], 96, 128, norm_fn, 1 + (downsample > 0)),
+        "conv2": init_conv(ks[4], 1, 1, 128, output_dim),
+    }
+
+
+def apply_basic_encoder(p: Params, x: jax.Array, *, norm_fn: str,
+                        downsample: int) -> jax.Array:
+    from raft_stereo_tpu.models.layers import apply_norm
+    s_stem, s2, s3 = _trunk_strides(downsample)
+    x = apply_conv(p["conv1"], x, stride=s_stem, padding=3)
+    # Stem GroupNorm uses 8 groups (extractor.py:129), unlike blocks (planes//8).
+    x = jax.nn.relu(apply_norm(norm_fn, p["norm1"], x, num_groups=8))
+    x = _apply_stage(p["layer1"], x, norm_fn, 1)
+    x = _apply_stage(p["layer2"], x, norm_fn, s2)
+    x = _apply_stage(p["layer3"], x, norm_fn, s3)
+    return apply_conv(p["conv2"], x)
+
+
+def init_multi_basic_encoder(key: jax.Array, output_dim: Sequence[Sequence[int]],
+                             norm_fn: str = "batch", downsample: int = 3) -> Params:
+    from raft_stereo_tpu.models.layers import init_norm
+    ks = jax.random.split(key, 6 + 3 * len(output_dim))
+    p = {
+        "conv1": init_conv(ks[0], 7, 7, 3, 64),
+        "norm1": init_norm(norm_fn, 64),
+        "layer1": _init_stage(ks[1], 64, 64, norm_fn, 1),
+        "layer2": _init_stage(ks[2], 64, 96, norm_fn, 1 + (downsample > 1)),
+        "layer3": _init_stage(ks[3], 96, 128, norm_fn, 1 + (downsample > 0)),
+        "layer4": _init_stage(ks[4], 128, 128, norm_fn, 2),
+        "layer5": _init_stage(ks[5], 128, 128, norm_fn, 2),
+    }
+    ki = iter(ks[6:])
+    outputs08, outputs16, outputs32 = [], [], []
+    for dim in output_dim:
+        k1, k2 = jax.random.split(next(ki))
+        outputs08.append({"res": init_residual_block(k1, 128, 128, norm_fn, 1),
+                          "conv": init_conv(k2, 3, 3, 128, dim[2])})
+    for dim in output_dim:
+        k1, k2 = jax.random.split(next(ki))
+        outputs16.append({"res": init_residual_block(k1, 128, 128, norm_fn, 1),
+                          "conv": init_conv(k2, 3, 3, 128, dim[1])})
+    for dim in output_dim:
+        outputs32.append({"conv": init_conv(next(ki), 3, 3, 128, dim[0])})
+    p["outputs08"], p["outputs16"], p["outputs32"] = outputs08, outputs16, outputs32
+    return p
+
+
+def apply_multi_basic_encoder(p: Params, x: jax.Array, *, norm_fn: str,
+                              downsample: int, num_layers: int = 3,
+                              dual_inp: bool = False):
+    """Returns a tuple of per-scale lists (finest first), plus the full-batch
+    trunk features when ``dual_inp``."""
+    from raft_stereo_tpu.models.layers import apply_norm
+    s_stem, s2, s3 = _trunk_strides(downsample)
+    x = apply_conv(p["conv1"], x, stride=s_stem, padding=3)
+    x = jax.nn.relu(apply_norm(norm_fn, p["norm1"], x, num_groups=8))
+    x = _apply_stage(p["layer1"], x, norm_fn, 1)
+    x = _apply_stage(p["layer2"], x, norm_fn, s2)
+    x = _apply_stage(p["layer3"], x, norm_fn, s3)
+    if dual_inp:
+        v = x
+        x = x[: x.shape[0] // 2]
+
+    def head(h, feat):
+        if "res" in h:
+            feat = apply_residual_block(h["res"], feat, norm_fn, stride=1)
+        return apply_conv(h["conv"], feat, padding=1)
+
+    outputs08 = [head(h, x) for h in p["outputs08"]]
+    if num_layers == 1:
+        return (outputs08, v) if dual_inp else (outputs08,)
+    y = _apply_stage(p["layer4"], x, norm_fn, 2)
+    outputs16 = [head(h, y) for h in p["outputs16"]]
+    if num_layers == 2:
+        return (outputs08, outputs16, v) if dual_inp else (outputs08, outputs16)
+    z = _apply_stage(p["layer5"], y, norm_fn, 2)
+    outputs32 = [head(h, z) for h in p["outputs32"]]
+    return (outputs08, outputs16, outputs32, v) if dual_inp else (outputs08, outputs16, outputs32)

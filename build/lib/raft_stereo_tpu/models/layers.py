@@ -1,0 +1,127 @@
+"""Parameterized layer primitives: conv + norms + residual blocks.
+
+Initialization matches the reference: Kaiming-normal (fan_out, relu) conv
+weights with torch-default uniform biases (``core/extractor.py:155-162`` — the
+reference overrides weights only, so biases keep ``nn.Conv2d``'s default
+U(-1/sqrt(fan_in), 1/sqrt(fan_in))); norm scales 1, biases 0.
+
+Params are nested dicts; convs are ``{"w": HWIO, "b": (C,)}``; norms carry
+state per ``norm_fn`` ('batch' is permanently frozen — see ops.basic).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from raft_stereo_tpu.ops.basic import (
+    conv2d, frozen_batch_norm, group_norm, instance_norm)
+
+Params = Dict
+
+
+def init_conv(key: jax.Array, kh: int, kw: int, cin: int, cout: int,
+              bias: bool = True) -> Params:
+    kw_key, b_key = jax.random.split(key)
+    fan_out = cout * kh * kw
+    std = math.sqrt(2.0 / fan_out)
+    p = {"w": std * jax.random.normal(kw_key, (kh, kw, cin, cout), jnp.float32)}
+    if bias:
+        bound = 1.0 / math.sqrt(cin * kh * kw)
+        p["b"] = jax.random.uniform(b_key, (cout,), jnp.float32, -bound, bound)
+    return p
+
+
+def apply_conv(p: Params, x: jax.Array, *, stride: Union[int, Tuple[int, int]] = 1,
+               padding: Union[int, Tuple[int, int]] = 0) -> jax.Array:
+    return conv2d(x, p["w"], p.get("b"), stride=stride, padding=padding)
+
+
+def init_norm(norm_fn: str, c: int) -> Params:
+    if norm_fn == "batch":
+        z, o = jnp.zeros((c,), jnp.float32), jnp.ones((c,), jnp.float32)
+        return {"scale": o, "bias": z, "mean": z, "var": o}
+    if norm_fn == "group":
+        return {"scale": jnp.ones((c,), jnp.float32),
+                "bias": jnp.zeros((c,), jnp.float32)}
+    # instance / none: stateless
+    return {}
+
+
+def apply_norm(norm_fn: str, p: Params, x: jax.Array, *,
+               num_groups: int | None = None) -> jax.Array:
+    if norm_fn == "batch":
+        return frozen_batch_norm(x, p)
+    if norm_fn == "group":
+        return group_norm(x, p, num_groups)
+    if norm_fn == "instance":
+        return instance_norm(x)
+    return x  # 'none'
+
+
+def init_residual_block(key: jax.Array, in_planes: int, planes: int,
+                        norm_fn: str, stride: int = 1) -> Params:
+    """Reference ``ResidualBlock`` (``core/extractor.py:6-60``)."""
+    k1, k2, k3 = jax.random.split(key, 3)
+    p = {
+        "conv1": init_conv(k1, 3, 3, in_planes, planes),
+        "conv2": init_conv(k2, 3, 3, planes, planes),
+        "norm1": init_norm(norm_fn, planes),
+        "norm2": init_norm(norm_fn, planes),
+    }
+    if not (stride == 1 and in_planes == planes):
+        p["downsample"] = {"conv": init_conv(k3, 1, 1, in_planes, planes),
+                           "norm": init_norm(norm_fn, planes)}
+    return p
+
+
+def apply_residual_block(p: Params, x: jax.Array, norm_fn: str,
+                         stride: int = 1) -> jax.Array:
+    planes = p["conv1"]["w"].shape[-1]
+    groups = planes // 8
+    y = apply_conv(p["conv1"], x, stride=stride, padding=1)
+    y = jax.nn.relu(apply_norm(norm_fn, p["norm1"], y, num_groups=groups))
+    y = apply_conv(p["conv2"], y, padding=1)
+    y = jax.nn.relu(apply_norm(norm_fn, p["norm2"], y, num_groups=groups))
+    if "downsample" in p:
+        x = apply_conv(p["downsample"]["conv"], x, stride=stride)
+        x = apply_norm(norm_fn, p["downsample"]["norm"], x, num_groups=groups)
+    return jax.nn.relu(x + y)
+
+
+def init_bottleneck_block(key: jax.Array, in_planes: int, planes: int,
+                          norm_fn: str, stride: int = 1) -> Params:
+    """Reference ``BottleneckBlock`` (``core/extractor.py:64-120``; unused by
+    the stereo configs but part of the reference API surface)."""
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    p = {
+        "conv1": init_conv(k1, 1, 1, in_planes, planes // 4),
+        "conv2": init_conv(k2, 3, 3, planes // 4, planes // 4),
+        "conv3": init_conv(k3, 1, 1, planes // 4, planes),
+        "norm1": init_norm(norm_fn, planes // 4),
+        "norm2": init_norm(norm_fn, planes // 4),
+        "norm3": init_norm(norm_fn, planes),
+    }
+    if stride != 1:
+        p["downsample"] = {"conv": init_conv(k4, 1, 1, in_planes, planes),
+                           "norm": init_norm(norm_fn, planes)}
+    return p
+
+
+def apply_bottleneck_block(p: Params, x: jax.Array, norm_fn: str,
+                           stride: int = 1) -> jax.Array:
+    planes = p["conv3"]["w"].shape[-1]
+    groups = planes // 8
+    y = apply_conv(p["conv1"], x)
+    y = jax.nn.relu(apply_norm(norm_fn, p["norm1"], y, num_groups=groups))
+    y = apply_conv(p["conv2"], y, stride=stride, padding=1)
+    y = jax.nn.relu(apply_norm(norm_fn, p["norm2"], y, num_groups=groups))
+    y = apply_conv(p["conv3"], y)
+    y = jax.nn.relu(apply_norm(norm_fn, p["norm3"], y, num_groups=groups))
+    if "downsample" in p:
+        x = apply_conv(p["downsample"]["conv"], x, stride=stride)
+        x = apply_norm(norm_fn, p["downsample"]["norm"], x, num_groups=groups)
+    return jax.nn.relu(x + y)

@@ -1,0 +1,217 @@
+"""RAFT-Stereo assembly: encoders -> correlation -> scanned GRU refinement.
+
+Reference ``core/raft_stereo.py:22-141``. TPU-first restructuring:
+
+- the iteration loop is a ``jax.lax.scan`` over a pure step function — one
+  compiled program regardless of ``iters`` (the reference re-traces a Python
+  loop; ``unroll=True`` reproduces that for debugging/parity);
+- truncated BPTT is ``lax.stop_gradient`` on the coordinates at the top of each
+  iteration (reference ``coords1.detach()``, :109);
+- the epipolar projection zeroes the y-component of every delta (:120);
+- mixed precision is bf16-compute / fp32-params (no grad scaler needed — bf16
+  keeps fp32's exponent range); correlation math stays fp32, mirroring the
+  reference's ``.float()`` casts for the non-CUDA paths (:92-95).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from raft_stereo_tpu.config import RAFTStereoConfig
+from raft_stereo_tpu.corr import make_corr_fn
+from raft_stereo_tpu.models.extractor import (
+    apply_basic_encoder, apply_multi_basic_encoder,
+    init_basic_encoder, init_multi_basic_encoder)
+from raft_stereo_tpu.models.layers import (
+    Params, apply_conv, apply_residual_block, init_conv, init_residual_block)
+from raft_stereo_tpu.models.update import (
+    apply_mask_head, apply_update_block, init_update_block)
+from raft_stereo_tpu.ops.coords import coords_grid
+from raft_stereo_tpu.ops.upsample import convex_upsample
+
+
+# Above this many pixels, eval runs the two images through fnet sequentially
+# (lax.map) instead of batch-concatenated — see _context_and_features. Module
+# constant so tests can exercise the sequential path at small shapes.
+FNET_SEQUENTIAL_MIN_PIXELS = 1 << 21
+
+
+def init_raft_stereo(key: jax.Array, cfg: RAFTStereoConfig) -> Params:
+    """Build the parameter pytree (reference ctor, ``core/raft_stereo.py:23-39``)."""
+    ks = jax.random.split(key, 4 + cfg.n_gru_layers)
+    params: Params = {
+        "cnet": init_multi_basic_encoder(
+            ks[0], output_dim=[list(cfg.hidden_dims), list(cfg.context_dims)],
+            norm_fn="batch", downsample=cfg.n_downsample),
+        "update_block": init_update_block(ks[1], cfg),
+        "context_zqr_convs": [
+            init_conv(ks[4 + i], 3, 3, cfg.context_dims[i], cfg.hidden_dims[i] * 3)
+            for i in range(cfg.n_gru_layers)],
+    }
+    if cfg.shared_backbone:
+        params["conv2"] = {
+            "res": init_residual_block(ks[2], 128, 128, "instance", stride=1),
+            "conv": init_conv(ks[3], 3, 3, 128, 256)}
+    else:
+        params["fnet"] = init_basic_encoder(ks[2], output_dim=256,
+                                            norm_fn="instance",
+                                            downsample=cfg.n_downsample)
+    return params
+
+
+def _context_and_features(params: Params, cfg: RAFTStereoConfig,
+                          image1: jax.Array, image2: jax.Array,
+                          compute_dtype) -> Tuple[list, list, jax.Array, jax.Array]:
+    """Run context + feature networks (reference forward :76-88)."""
+    image1 = (2 * (image1 / 255.0) - 1.0).astype(compute_dtype)
+    image2 = (2 * (image2 / 255.0) - 1.0).astype(compute_dtype)
+
+    if cfg.shared_backbone:
+        # dual_inp runs both images through one stem by construction, so
+        # the sequential-fnet memory treatment below does not apply here;
+        # the shared backbone is the realtime (n_downsample=3) config,
+        # which never runs at the full-resolution sizes where it matters.
+        *cnet_list, x = apply_multi_basic_encoder(
+            params["cnet"], jnp.concatenate([image1, image2], axis=0),
+            norm_fn="batch", downsample=cfg.n_downsample,
+            num_layers=cfg.n_gru_layers, dual_inp=True)
+        x = apply_residual_block(params["conv2"]["res"], x, "instance", stride=1)
+        x = apply_conv(params["conv2"]["conv"], x, padding=1)
+        fmap1, fmap2 = jnp.split(x, 2, axis=0)
+    else:
+        cnet_list = apply_multi_basic_encoder(
+            params["cnet"], image1, norm_fn="batch", downsample=cfg.n_downsample,
+            num_layers=cfg.n_gru_layers)
+        if image1.shape[1] * image1.shape[2] >= FNET_SEQUENTIAL_MIN_PIXELS:
+            # Full-resolution inputs (>=2M px): run the two images through
+            # the feature net SEQUENTIALLY (lax.map reuses the stem buffers
+            # between steps). The reference's batch-concat (:83) is a GPU
+            # throughput trick; at Middlebury-F the stride-1 stem's
+            # space-to-depth intermediates are ~1.5 GB per image, and
+            # batching both doubles peak HBM for zero win on a
+            # latency-bound B=1 eval. Instance norm is per-sample, so the
+            # outputs are identical.
+            fmaps = lax.map(
+                lambda im: apply_basic_encoder(
+                    params["fnet"], im, norm_fn="instance",
+                    downsample=cfg.n_downsample),
+                jnp.stack([image1, image2]))
+            fmap1, fmap2 = fmaps[0], fmaps[1]
+        else:
+            fmaps = apply_basic_encoder(
+                params["fnet"], jnp.concatenate([image1, image2], axis=0),
+                norm_fn="instance", downsample=cfg.n_downsample)
+            fmap1, fmap2 = jnp.split(fmaps, 2, axis=0)
+
+    net_list = [jnp.tanh(x[0]) for x in cnet_list]
+    inp_list = [jax.nn.relu(x[1]) for x in cnet_list]
+    # GRU gate biases from context, computed once outside the loop (:87-88).
+    inp_list = [
+        tuple(jnp.split(apply_conv(conv, i, padding=1), 3, axis=-1))
+        for i, conv in zip(inp_list, params["context_zqr_convs"])]
+    return net_list, inp_list, fmap1, fmap2
+
+
+def raft_stereo_forward(params: Params, cfg: RAFTStereoConfig,
+                        image1: jax.Array, image2: jax.Array, *,
+                        iters: int = 12,
+                        flow_init: Optional[jax.Array] = None,
+                        test_mode: bool = False,
+                        unroll: bool = False):
+    """Estimate disparity for a rectified stereo pair.
+
+    image1/image2: (B, H, W, 3) in [0, 255].
+    Train mode returns per-iteration upsampled predictions
+    ``(iters, B, H, W, 1)``; test mode returns ``(low_res_flow, final_up)``
+    (reference :126-141). Disparity is ``-flow[..., 0]``.
+    """
+    compute_dtype = jnp.bfloat16 if cfg.mixed_precision else jnp.float32
+    net_list, inp_list, fmap1, fmap2 = _context_and_features(
+        params, cfg, image1, image2, compute_dtype)
+
+    corr_fp32 = cfg.corr_implementation in ("reg", "alt")
+    corr_dtype = jnp.float32 if corr_fp32 else compute_dtype
+    corr_fn = make_corr_fn(cfg.corr_implementation,
+                           fmap1.astype(corr_dtype), fmap2.astype(corr_dtype),
+                           num_levels=cfg.corr_levels, radius=cfg.corr_radius)
+
+    b, h, w, _ = net_list[0].shape
+    coords0 = coords_grid(b, h, w)
+    coords1 = coords_grid(b, h, w)
+    if flow_init is not None:
+        coords1 = coords1 + flow_init
+
+    net = tuple(x.astype(compute_dtype) for x in net_list)
+    inp = [tuple(c.astype(compute_dtype) for c in triple) for triple in inp_list]
+    factor = cfg.downsample_factor
+
+    def one_iteration(net, coords1, compute_mask=True):
+        coords1 = lax.stop_gradient(coords1)  # truncated BPTT (:109)
+        corr = corr_fn(coords1[..., 0]).astype(compute_dtype)
+        flow = (coords1 - coords0).astype(compute_dtype)
+        if cfg.n_gru_layers == 3 and cfg.slow_fast_gru:  # low-res GRU only
+            net = apply_update_block(params["update_block"], cfg, net, inp,
+                                     iter32=True, iter16=False, iter08=False,
+                                     update=False)
+        if cfg.n_gru_layers >= 2 and cfg.slow_fast_gru:  # low+mid-res GRUs
+            net = apply_update_block(params["update_block"], cfg, net, inp,
+                                     iter32=cfg.n_gru_layers == 3, iter16=True,
+                                     iter08=False, update=False)
+        net, up_mask, delta_flow = apply_update_block(
+            params["update_block"], cfg, net, inp, corr, flow,
+            iter32=cfg.n_gru_layers == 3, iter16=cfg.n_gru_layers >= 2,
+            compute_mask=compute_mask)
+        # Stereo: project the update onto the epipolar line (:120).
+        delta_flow = delta_flow.astype(jnp.float32).at[..., 1].set(0.0)
+        coords1 = coords1 + delta_flow
+        return net, coords1, up_mask
+
+    def upsampled(coords1, up_mask):
+        flow_up = convex_upsample((coords1 - coords0).astype(jnp.float32),
+                                  up_mask.astype(jnp.float32), factor)
+        return flow_up[..., :1]  # only x (disparity) survives (:134)
+
+    if unroll:  # reference-style Python loop, for debugging and parity checks
+        flow_predictions = []
+        up_mask = None
+        for _ in range(iters):
+            net, coords1, up_mask = one_iteration(net, coords1)
+            flow_predictions.append(upsampled(coords1, up_mask))
+        if test_mode:
+            return coords1 - coords0, flow_predictions[-1]
+        return jnp.stack(flow_predictions)
+
+    if test_mode:
+        # The mask feeds only the upsampler — and test mode upsamples only
+        # the final iteration (reference :126-127) — so the mask head runs
+        # ONCE after the scan instead of every iteration (the reference
+        # computes-and-discards it 31 times; identical outputs here).
+        def step(carry, _):
+            net, coords1 = carry
+            net, coords1, _ = one_iteration(net, coords1, compute_mask=False)
+            return (net, coords1), None
+
+        (net, coords1), _ = lax.scan(
+            step, (net, coords1), None, length=iters)
+        up_mask = apply_mask_head(params["update_block"], net[0])
+        return coords1 - coords0, upsampled(coords1, up_mask)
+
+    def step(carry, _):
+        net, coords1 = carry
+        net, coords1, up_mask = one_iteration(net, coords1)
+        return (net, coords1), upsampled(coords1, up_mask)
+
+    # Rematerialize each iteration's internals in the backward pass instead
+    # of storing them: without this the scan saves every iteration's GRU /
+    # corr / upsample intermediates (~8 GB over the reference's 22-iter
+    # batch-6 training config — past a v5e chip's HBM). The reference's
+    # truncated BPTT means each step's backward needs only that step's
+    # activations, so remat trades ~1/3 extra backward FLOPs for O(1-step)
+    # memory.
+    (net, coords1), flow_predictions = lax.scan(
+        jax.checkpoint(step), (net, coords1), None, length=iters)
+    return flow_predictions

@@ -1,0 +1,159 @@
+"""Native (C++) data-pipeline kernels, loaded via ctypes.
+
+The reference's only native component is its CUDA correlation sampler; its
+TPU analog here is the Pallas kernel layer (``corr/pallas_*.py``). This
+package is the native piece of the *host* runtime: photometric augmentation
+kernels that the loader's worker threads call with the GIL released (ctypes
+drops the GIL for the duration of a foreign call), keeping the input
+pipeline fast enough to feed multi-chip training (scratch/bench_loader.py).
+
+Build model: compiled lazily with the system C++ compiler into a per-user
+cache keyed by source hash — no build step at install time, no binary in the
+tree, works from a read-only site-packages. Everything degrades gracefully:
+if no compiler exists or the build fails, ``lib()`` returns None and callers
+fall back to the numpy implementation (``data/photometric.py``).
+
+Set ``RAFT_NATIVE=0`` to force the numpy path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+import threading
+from pathlib import Path
+from typing import Optional, Sequence
+
+import numpy as np
+
+_SRC = Path(__file__).with_name("photometric.cpp")
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+_lock = threading.Lock()
+
+
+def _cache_dir() -> Path:
+    base = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache")
+    d = Path(base) / "raft_stereo_tpu"
+    d.mkdir(parents=True, exist_ok=True)
+    return d
+
+
+def _build() -> Optional[Path]:
+    src = _SRC.read_bytes()
+    tag = hashlib.sha256(src).hexdigest()[:16]
+    out = _cache_dir() / f"photometric-{tag}.so"
+    if out.exists():
+        return out
+    last_err = "no C++ compiler found"
+    for cxx in (os.environ.get("CXX"), "g++", "c++", "clang++"):
+        if not cxx:
+            continue
+        # Compile to a temp path and rename: atomic vs concurrent builders.
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=str(out.parent))
+        os.close(fd)
+        cmd = [cxx, "-O3", "-shared", "-fPIC", "-std=c++17",
+               str(_SRC), "-o", tmp]
+        try:
+            proc = subprocess.run(cmd, capture_output=True, timeout=120)
+            if proc.returncode == 0:
+                os.replace(tmp, out)
+                return out
+            last_err = proc.stderr.decode(errors="replace")[-500:]
+        except (OSError, subprocess.TimeoutExpired) as e:
+            last_err = str(e)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+    # A silent failure here would silently cost ~30% loader throughput
+    # (BASELINE.md); make the numpy fallback diagnosable.
+    import logging
+    logging.getLogger(__name__).warning(
+        "native photometric build failed, falling back to numpy: %s", last_err)
+    return None
+
+
+_F32P = ctypes.POINTER(ctypes.c_float)
+_I32P = ctypes.POINTER(ctypes.c_int32)
+
+
+def lib() -> Optional[ctypes.CDLL]:
+    """The loaded native library, building it on first use; None if unavailable.
+
+    Serialized by a lock: loader worker threads all hit the first call
+    together, and the probe (which may spend seconds in the compiler) must
+    run once — latecomers block and then share the result rather than
+    spawning duplicate builds or silently taking the numpy path mid-run.
+    """
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        if os.environ.get("RAFT_NATIVE", "1").strip().lower() in (
+                "0", "false", "no"):
+            _tried = True
+            return None
+        try:
+            path = _build()
+            if path is not None:
+                cdll = ctypes.CDLL(str(path))
+                cdll.rst_jitter_ops.argtypes = [_F32P, ctypes.c_int64, _I32P,
+                                                ctypes.c_int32, ctypes.c_float,
+                                                ctypes.c_float, ctypes.c_float]
+                cdll.rst_gamma.argtypes = [_F32P, ctypes.c_int64,
+                                           ctypes.c_float, ctypes.c_float]
+                for name in ("rst_brightness", "rst_contrast",
+                             "rst_saturation"):
+                    getattr(cdll, name).argtypes = [_F32P, ctypes.c_int64,
+                                                    ctypes.c_float]
+                _lib = cdll
+        except OSError:
+            _lib = None
+        _tried = True
+        return _lib
+
+
+def available() -> bool:
+    return lib() is not None
+
+
+def _buf(img: np.ndarray):
+    # Raise (not assert — must survive ``python -O``): the C kernels trust
+    # this layout and read/write H*W*3 floats with no further checks.
+    if (img.dtype != np.float32 or not img.flags.c_contiguous
+            or img.ndim != 3 or img.shape[2] != 3):
+        raise ValueError(
+            f"native kernels need a C-contiguous float32 (H, W, 3) array, "
+            f"got {img.dtype} {img.shape}")
+    return img.ctypes.data_as(_F32P)
+
+
+def jitter_ops(img: np.ndarray, ops: Sequence[int], brightness: float,
+               contrast: float, saturation: float) -> bool:
+    """Apply a hue-free run of jitter ops in place on a float32 RGB image.
+
+    Returns False (leaving ``img`` untouched) when the native library is
+    unavailable — callers keep their numpy fallback.
+    """
+    cdll = lib()
+    if cdll is None:
+        return False
+    if len(ops):
+        arr = np.asarray(ops, np.int32)
+        cdll.rst_jitter_ops(_buf(img), img.shape[0] * img.shape[1],
+                            arr.ctypes.data_as(_I32P), len(arr),
+                            brightness, contrast, saturation)
+    return True
+
+
+def gamma(img: np.ndarray, gamma_: float, gain: float) -> bool:
+    """In-place gamma adjustment; False if the native library is unavailable."""
+    cdll = lib()
+    if cdll is None:
+        return False
+    cdll.rst_gamma(_buf(img), img.shape[0] * img.shape[1], gamma_, gain)
+    return True

@@ -1,0 +1,109 @@
+// Native photometric-augmentation kernels for the data pipeline.
+//
+// The numpy implementation (../data/photometric.py) allocates several
+// full-frame float temporaries per jitter op (factor*img, (1-f)*other, clip
+// all materialize); at FlyingThings resolution that is ~70 ms per sample and
+// dominates loader throughput (scratch/bench_loader.py). These kernels apply
+// the same torchvision-semantics ops in place on one float32 buffer.
+//
+// Semantics mirror ../data/photometric.py exactly (same op maths, same
+// float32 arithmetic per pixel); the only intentional difference is the
+// contrast op's mean reduction, accumulated here in double instead of
+// numpy's pairwise float32 — a ~1e-5 relative difference on a blend
+// *scalar*, bounded by the parity test (tests/test_native.py).
+//
+// The hue op is NOT here: it goes through OpenCV's uint8 HSV fixed-point
+// conversion (photometric.py adjust_hue), which is already native; callers
+// split the op sequence around it.
+//
+// Exported C ABI (ctypes, see __init__.py): all buffers are contiguous
+// float32 RGB, npix = H*W pixels (3 floats each), modified in place.
+
+#include <cmath>
+#include <cstdint>
+
+namespace {
+
+constexpr float kGrayR = 0.299f, kGrayG = 0.587f, kGrayB = 0.114f;  // ITU-R 601
+
+inline float clip255(float v) {
+    return v < 0.0f ? 0.0f : (v > 255.0f ? 255.0f : v);
+}
+
+}  // namespace
+
+extern "C" {
+
+// out = clip(factor * img, 0, 255)  — torchvision brightness blend-with-zero.
+void rst_brightness(float* img, int64_t npix, float factor) {
+    int64_t n = npix * 3;
+    for (int64_t i = 0; i < n; ++i) img[i] = clip255(factor * img[i]);
+}
+
+// out = clip(factor * img + (1-factor) * mean_gray(img), 0, 255).
+void rst_contrast(float* img, int64_t npix, float factor) {
+    double acc = 0.0;
+    for (int64_t p = 0; p < npix; ++p) {
+        const float* px = img + 3 * p;
+        acc += kGrayR * px[0] + kGrayG * px[1] + kGrayB * px[2];
+    }
+    const float mean_gray = static_cast<float>(acc / static_cast<double>(npix));
+    const float rest = (1.0f - factor) * mean_gray;
+    int64_t n = npix * 3;
+    for (int64_t i = 0; i < n; ++i) img[i] = clip255(factor * img[i] + rest);
+}
+
+// out = clip(factor * img + (1-factor) * gray(px), 0, 255), per-pixel gray.
+void rst_saturation(float* img, int64_t npix, float factor) {
+    const float rest = 1.0f - factor;
+    for (int64_t p = 0; p < npix; ++p) {
+        float* px = img + 3 * p;
+        const float gray = kGrayR * px[0] + kGrayG * px[1] + kGrayB * px[2];
+        const float g = rest * gray;
+        px[0] = clip255(factor * px[0] + g);
+        px[1] = clip255(factor * px[1] + g);
+        px[2] = clip255(factor * px[2] + g);
+    }
+}
+
+// out = clip(255 * gain * (img/255)^gamma, 0, 255) via a 4096-entry LUT with
+// linear interpolation: img values are float but lie in [0, 255] (every
+// upstream op clips), and powf per pixel is ~10x the LUT cost. Lerp error on
+// the power curve at this resolution is < 2e-3 counts (pinned by
+// tests/test_native.py), vanishing under the final uint8 cast.
+void rst_gamma(float* img, int64_t npix, float gamma, float gain) {
+    constexpr int kN = 4096;
+    float lut[kN + 1];
+    for (int i = 0; i <= kN; ++i) {
+        float x = static_cast<float>(i) / static_cast<float>(kN);
+        lut[i] = clip255(255.0f * gain * powf(x, gamma));
+    }
+    const float scale = static_cast<float>(kN) / 255.0f;
+    int64_t n = npix * 3;
+    for (int64_t i = 0; i < n; ++i) {
+        const float pos = img[i] * scale;
+        // A clipped pixel at exactly 255.0 maps to pos == kN; clamp the cell
+        // so the lerp endpoints stay inside the kN+1-entry table (frac
+        // becomes 1.0 and the result is exactly lut[kN]).
+        const int idx = pos >= static_cast<float>(kN)
+                            ? kN - 1 : static_cast<int>(pos);
+        const float frac = pos - static_cast<float>(idx);
+        img[i] = lut[idx] + frac * (lut[idx + 1] - lut[idx]);
+    }
+}
+
+// Apply a sequence of {0: brightness, 1: contrast, 2: saturation} ops in
+// order — one boundary crossing for a whole hue-free run of jitter ops.
+void rst_jitter_ops(float* img, int64_t npix, const int32_t* ops, int32_t n_ops,
+                    float brightness, float contrast, float saturation) {
+    for (int32_t k = 0; k < n_ops; ++k) {
+        switch (ops[k]) {
+            case 0: rst_brightness(img, npix, brightness); break;
+            case 1: rst_contrast(img, npix, contrast); break;
+            case 2: rst_saturation(img, npix, saturation); break;
+            default: break;  // unknown op: no-op rather than UB
+        }
+    }
+}
+
+}  // extern "C"

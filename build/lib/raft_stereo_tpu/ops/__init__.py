@@ -1,0 +1,31 @@
+"""Numerics kernel layer: stateless ops with reference-exact semantics.
+
+All ops operate on NHWC activations (TPU conv-native layout). Each op documents
+the reference behavior it reproduces (file:line in /root/reference).
+"""
+
+from raft_stereo_tpu.ops.basic import (
+    conv2d,
+    frozen_batch_norm,
+    group_norm,
+    instance_norm,
+)
+from raft_stereo_tpu.ops.coords import coords_grid, upflow
+from raft_stereo_tpu.ops.sampler import (
+    sample_1d_zeros,
+    sample_rows_zeros,
+)
+from raft_stereo_tpu.ops.pooling import avg_pool_w2, pool2x, pool4x
+from raft_stereo_tpu.ops.resize import interp_align_corners
+from raft_stereo_tpu.ops.upsample import convex_upsample
+from raft_stereo_tpu.ops.padder import InputPadder
+
+__all__ = [
+    "conv2d", "frozen_batch_norm", "group_norm", "instance_norm",
+    "coords_grid", "upflow",
+    "sample_1d_zeros", "sample_rows_zeros",
+    "avg_pool_w2", "pool2x", "pool4x",
+    "interp_align_corners",
+    "convex_upsample",
+    "InputPadder",
+]

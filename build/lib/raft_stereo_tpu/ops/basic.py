@@ -1,0 +1,131 @@
+"""Convolution and normalization primitives (NHWC / HWIO).
+
+Semantics match the reference's torch modules exactly:
+- ``conv2d``: symmetric explicit padding like ``nn.Conv2d(padding=p)``;
+- ``frozen_batch_norm``: ``nn.BatchNorm2d`` in eval mode — the reference always
+  freezes BN (``train_stereo.py:151,193``; ``core/raft_stereo.py:41-44``), so BN
+  is a pure affine transform of stored running statistics;
+- ``instance_norm``: ``nn.InstanceNorm2d`` defaults — no affine, no running
+  stats, biased variance, eps 1e-5 (``core/extractor.py:29-32,135``);
+- ``group_norm``: ``nn.GroupNorm`` (``core/extractor.py:17-20,129``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Padding = Union[int, Tuple[int, int]]
+
+_DIMNUMS = ("NHWC", "HWIO", "NHWC")
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _conv_acc32(x: jax.Array, w: jax.Array, stride, padding) -> jax.Array:
+    """Conv emitting the fp32 accumulator from reduced-precision operands.
+
+    ``preferred_element_type=f32`` with bf16 operands is fine forward, but
+    its autodiff transpose builds a conv of the fp32 cotangent against the
+    bf16 operand — mixed dtypes, a trace-time error. This custom_vjp runs
+    the backward in the compute dtype (cotangent rounded once), the
+    standard mixed-precision training semantics.
+    """
+    return lax.conv_general_dilated(
+        x, w, window_strides=stride, padding=padding,
+        dimension_numbers=_DIMNUMS, preferred_element_type=jnp.float32)
+
+
+def _conv_acc32_fwd(x, w, stride, padding):
+    return _conv_acc32(x, w, stride, padding), (x, w)
+
+
+def _conv_acc32_bwd(stride, padding, residuals, g):
+    x, w = residuals
+    _, vjp = jax.vjp(
+        lambda a, b: lax.conv_general_dilated(
+            a, b, window_strides=stride, padding=padding,
+            dimension_numbers=_DIMNUMS),
+        x, w)
+    return vjp(g.astype(x.dtype))
+
+
+_conv_acc32.defvjp(_conv_acc32_fwd, _conv_acc32_bwd)
+
+
+def _pad_pair(padding: Padding) -> Tuple[Tuple[int, int], Tuple[int, int]]:
+    if isinstance(padding, int):
+        return ((padding, padding), (padding, padding))
+    ph, pw = padding
+    return ((ph, ph), (pw, pw))
+
+
+def conv2d(x: jax.Array, w: jax.Array, b: jax.Array | None = None, *,
+           stride: Union[int, Tuple[int, int]] = 1,
+           padding: Padding = 0, out_dtype=None) -> jax.Array:
+    """2D convolution, NHWC input, HWIO kernel, torch-style symmetric padding.
+
+    The conv runs in the dtype of ``x`` (bf16 under the mixed-precision
+    policy) and emits that dtype: the MXU accumulates fp32 within a pass
+    regardless, and requesting an fp32 *output type* forces XLA to
+    materialize full-precision activation buffers — measured 3-6 GB
+    space-to-depth stem intermediates at Middlebury-F that pushed the
+    program out of HBM. Callers that sum several partial convs (the split
+    gate convs) pass ``out_dtype=jnp.float32`` to keep the explicit fp32
+    accumulator across convs and downcast once.
+    """
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    w = w.astype(x.dtype)
+    if out_dtype == jnp.float32 and x.dtype != jnp.float32:
+        out = _conv_acc32(x, w, stride, _pad_pair(padding))
+    else:
+        out = lax.conv_general_dilated(
+            x, w, window_strides=stride, padding=_pad_pair(padding),
+            dimension_numbers=_DIMNUMS)
+    if b is not None:
+        out = out + b.astype(out.dtype)
+    return out if out_dtype is None else out.astype(out_dtype)
+
+
+def frozen_batch_norm(x: jax.Array, params: dict, *, eps: float = 1e-5) -> jax.Array:
+    """BatchNorm2d in (permanently) eval mode: affine over stored running stats.
+
+    params: {"scale", "bias", "mean", "var"} each shaped (C,).
+    """
+    # Fold stats into a single scale/shift (fp32), then apply in compute dtype.
+    inv = params["scale"] * lax.rsqrt(params["var"] + eps)
+    shift = params["bias"] - params["mean"] * inv
+    return (x * inv.astype(x.dtype) + shift.astype(x.dtype)).astype(x.dtype)
+
+
+def instance_norm(x: jax.Array, *, eps: float = 1e-5) -> jax.Array:
+    """InstanceNorm2d with torch defaults: per-(sample, channel) over H, W,
+    biased variance, no affine parameters.
+
+    Statistics accumulate in fp32 but the map stays in the compute dtype:
+    an ``x.astype(f32)`` of the whole activation would materialize a
+    full-resolution fp32 copy (3 GB at Middlebury-F in the fnet stem) plus
+    layout copies either side; the fp32 converts here fuse into the
+    reductions instead. Identical arithmetic when x is fp32.
+    """
+    mean = jnp.mean(x, axis=(1, 2), keepdims=True, dtype=jnp.float32)
+    var = jnp.mean(jnp.square(x.astype(jnp.float32) - mean), axis=(1, 2),
+                   keepdims=True)
+    inv = lax.rsqrt(var + eps)
+    return ((x - mean.astype(x.dtype)) * inv.astype(x.dtype)).astype(x.dtype)
+
+
+def group_norm(x: jax.Array, params: dict, num_groups: int, *,
+               eps: float = 1e-5) -> jax.Array:
+    """GroupNorm over (H, W, C//G) per group, affine. params: {"scale","bias"}."""
+    b, h, w, c = x.shape
+    xg = x.astype(jnp.float32).reshape(b, h, w, num_groups, c // num_groups)
+    mean = jnp.mean(xg, axis=(1, 2, 4), keepdims=True)
+    var = jnp.mean(jnp.square(xg - mean), axis=(1, 2, 4), keepdims=True)
+    xg = (xg - mean) * lax.rsqrt(var + eps)
+    out = xg.reshape(b, h, w, c) * params["scale"] + params["bias"]
+    return out.astype(x.dtype)

@@ -1,0 +1,51 @@
+"""Bounded-memory mapping over one axis in fixed-size chunks.
+
+The `alt` correlation paths bound their transient one-hot/volume tensors by
+processing a fixed number of rows at a time under ``lax.map``. The pad /
+reshape / map / reassemble dance is easy to get wrong (an extra moveaxis once
+scrambled batch/row order — see ``tests/test_corr.py``
+``test_alt_chunked_matches_reg``), so it lives here once.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def map_chunked(fn: Callable[[Tuple[jax.Array, ...]], jax.Array],
+                inputs: Sequence[jax.Array], chunk: int,
+                axis: int = 0) -> jax.Array:
+    """``lax.map`` ``fn`` over ``axis`` of every input, ``chunk`` rows at a time.
+
+    ``fn`` receives a tuple of slices with the original layout but ``axis``
+    reduced to ``chunk``, and must return an array with the chunked axis at
+    the same position. The axis is zero-padded up to a chunk multiple (so
+    peak memory is bounded for every length) and the padded rows are sliced
+    off the result — ``fn``'s output on zero rows is discarded, never mixed
+    into real rows.
+    """
+    inputs = tuple(inputs)
+    n = inputs[0].shape[axis]
+    if n <= chunk:
+        return fn(inputs)
+    pad = (-n) % chunk
+    if pad:
+        inputs = tuple(
+            jnp.pad(x, [(0, pad) if i == axis else (0, 0)
+                        for i in range(x.ndim)])
+            for x in inputs)
+    g = (n + pad) // chunk
+
+    def split(x):
+        x = x.reshape(*x.shape[:axis], g, chunk, *x.shape[axis + 1:])
+        return jnp.moveaxis(x, axis, 0)
+
+    out = jax.lax.map(fn, tuple(split(x) for x in inputs))
+    out = jnp.moveaxis(out, 0, axis)
+    out = out.reshape(*out.shape[:axis], g * chunk, *out.shape[axis + 2:])
+    if pad:
+        out = jax.lax.slice_in_dim(out, 0, n, axis=axis)
+    return out

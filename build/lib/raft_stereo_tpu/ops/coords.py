@@ -1,0 +1,32 @@
+"""Coordinate grids and fallback flow upsampling.
+
+Reference: ``core/utils/utils.py:76-84``. Channel-last layout: a flow/coords
+field is ``(B, H, W, 2)`` with ``[..., 0] = x`` and ``[..., 1] = y`` (the
+reference stacks x-first, ``utils.py:78``). The network regresses *negative*
+disparity in the x channel (``core/stereo_datasets.py:77``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from raft_stereo_tpu.ops.resize import interp_align_corners
+
+
+def coords_grid(batch: int, ht: int, wd: int, dtype=jnp.float32) -> jax.Array:
+    """(B, H, W, 2) pixel-coordinate grid, x-first."""
+    y, x = jnp.meshgrid(jnp.arange(ht, dtype=dtype), jnp.arange(wd, dtype=dtype),
+                        indexing="ij")
+    grid = jnp.stack([x, y], axis=-1)
+    return jnp.broadcast_to(grid[None], (batch, ht, wd, 2))
+
+
+def upflow(flow: jax.Array, factor: int = 8) -> jax.Array:
+    """``upflow8`` generalized: aligned-corners bilinear upsample and scale.
+
+    Reference ``core/utils/utils.py:82-84``. Only reached when no learned
+    upsampling mask exists (kept for API parity with RAFT).
+    """
+    b, h, w, c = flow.shape
+    return factor * interp_align_corners(flow, (factor * h, factor * w))

@@ -1,0 +1,66 @@
+"""Input padding to compilation-friendly sizes.
+
+Reference ``core/utils/utils.py:7-26``: pad H, W up to a multiple of
+``divis_by`` with replicate padding ('sintel' mode centers, default mode pads
+bottom/right-of-center on W only). The reference re-pads every image to its own
+size; on TPU every distinct padded shape is a fresh XLA compilation, so this
+padder adds an optional *bucketing* mode: round H, W up to the next multiple of
+``bucket`` (>= divis_by), collapsing the eval sets onto a handful of compiled
+shapes. ``unpad`` restores the original extent either way, so metrics are
+computed only over real pixels.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class InputPadder:
+    """Pads (B, H, W, C) arrays so H, W are divisible by ``divis_by``."""
+
+    def __init__(self, dims: Sequence[int], mode: str = "sintel",
+                 divis_by: int = 8, bucket: int | None = None):
+        # dims: an NHWC shape, an (H, W, C) shape, or a bare (H, W) pair.
+        if len(dims) >= 3:
+            self.ht, self.wd = int(dims[-3]), int(dims[-2])
+        else:
+            self.ht, self.wd = int(dims[0]), int(dims[1])
+        if bucket is not None:
+            if bucket % divis_by:
+                raise ValueError("bucket size must be a multiple of divis_by")
+            pad_ht = (-self.ht) % bucket
+            pad_wd = (-self.wd) % bucket
+        else:
+            # Reference formula (utils.py:11-12): pads to the *next* multiple,
+            # the trailing % keeps already-divisible sizes unpadded.
+            pad_ht = (((self.ht // divis_by) + 1) * divis_by - self.ht) % divis_by
+            pad_wd = (((self.wd // divis_by) + 1) * divis_by - self.wd) % divis_by
+        if mode == "sintel":
+            self._pad = (pad_wd // 2, pad_wd - pad_wd // 2,
+                         pad_ht // 2, pad_ht - pad_ht // 2)
+        else:
+            self._pad = (pad_wd // 2, pad_wd - pad_wd // 2, 0, pad_ht)
+
+    @property
+    def padded_shape(self) -> Tuple[int, int]:
+        l, r, t, b = self._pad
+        return self.ht + t + b, self.wd + l + r
+
+    def pad(self, *inputs: jax.Array) -> list:
+        l, r, t, b = self._pad
+        return [jnp.pad(x, ((0, 0), (t, b), (l, r), (0, 0)), mode="edge")
+                for x in inputs]
+
+    def pad_np(self, *inputs: np.ndarray) -> list:
+        l, r, t, b = self._pad
+        return [np.pad(x, ((0, 0), (t, b), (l, r), (0, 0)), mode="edge")
+                for x in inputs]
+
+    def unpad(self, x: jax.Array) -> jax.Array:
+        l, r, t, b = self._pad
+        ht, wd = x.shape[1], x.shape[2]
+        return x[:, t:ht - b, l:wd - r, :]

@@ -1,0 +1,50 @@
+"""Average pooling variants used by the reference.
+
+- ``avg_pool_w2``: kernel (1,2) stride (1,2), no padding — halves the width of
+  the correlation pyramid / fmap2 (``core/corr.py:123-124,104``). Odd trailing
+  column is dropped (torch floor semantics).
+- ``pool2x``: kernel 3 stride 2 padding 1 with ``count_include_pad=True``
+  (torch default) — cross-scale GRU state downsampling (``core/update.py:87-88``).
+- ``pool4x``: kernel 5 stride 4 padding 1 (``core/update.py:90-91``, unused by
+  the stereo configs but part of the API surface).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def avg_pool_w2(x: jax.Array) -> jax.Array:
+    """Halve the last-but-one (W) axis of (..., W, C) by averaging pairs."""
+    *lead, w, c = x.shape
+    w2 = (w // 2) * 2
+    x = x[..., :w2, :].reshape(*lead, w // 2, 2, c)
+    return jnp.mean(x, axis=-2)
+
+
+def avg_pool_last(x: jax.Array) -> jax.Array:
+    """Halve the last axis of (..., W) by averaging pairs (volume pyramid)."""
+    *lead, w = x.shape
+    w2 = (w // 2) * 2
+    x = x[..., :w2].reshape(*lead, w // 2, 2)
+    return jnp.mean(x, axis=-1)
+
+
+def _avg_pool_nhwc(x: jax.Array, window: int, stride: int, pad: int) -> jax.Array:
+    summed = lax.reduce_window(
+        x.astype(jnp.float32), 0.0, lax.add,
+        window_dimensions=(1, window, window, 1),
+        window_strides=(1, stride, stride, 1),
+        padding=((0, 0), (pad, pad), (pad, pad), (0, 0)))
+    # count_include_pad=True: divide by the full window size everywhere.
+    return (summed / (window * window)).astype(x.dtype)
+
+
+def pool2x(x: jax.Array) -> jax.Array:
+    return _avg_pool_nhwc(x, window=3, stride=2, pad=1)
+
+
+def pool4x(x: jax.Array) -> jax.Array:
+    return _avg_pool_nhwc(x, window=5, stride=4, pad=1)

@@ -1,0 +1,49 @@
+"""Aligned-corners bilinear resize.
+
+The reference resizes GRU hidden states across pyramid scales with
+``F.interpolate(mode='bilinear', align_corners=True)`` (``core/update.py:93-95``)
+and upsamples fallback flow the same way (``core/utils/utils.py:82-84``).
+``jax.image.resize`` uses half-pixel-center semantics, which differ, so the
+aligned-corners variant is built here from two 1D gather-lerps (each lowers to
+a pair of gathers + fused FMA — cheap on TPU, no conv needed).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _lerp_indices(in_size: int, out_size: int, dtype) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Source taps (lo, hi) and fractional weight for aligned-corners sampling."""
+    if out_size == 1:
+        src = jnp.zeros((1,), dtype)
+    else:
+        scale = (in_size - 1) / (out_size - 1)
+        src = jnp.arange(out_size, dtype=dtype) * scale
+    lo = jnp.clip(jnp.floor(src), 0, in_size - 1).astype(jnp.int32)
+    hi = jnp.clip(lo + 1, 0, in_size - 1)
+    w = (src - lo.astype(src.dtype))
+    return lo, hi, w
+
+
+def interp_align_corners(x: jax.Array, size: Tuple[int, int]) -> jax.Array:
+    """Bilinear resize of (B, H, W, C) to (B, size[0], size[1], C), align_corners=True."""
+    b, h, w, c = x.shape
+    oh, ow = size
+    if (oh, ow) == (h, w):
+        return x
+    compute = x.astype(jnp.float32)
+    if oh != h:
+        lo, hi, wt = _lerp_indices(h, oh, jnp.float32)
+        a = jnp.take(compute, lo, axis=1)
+        bb = jnp.take(compute, hi, axis=1)
+        compute = a + (bb - a) * wt[None, :, None, None]
+    if ow != w:
+        lo, hi, wt = _lerp_indices(w, ow, jnp.float32)
+        a = jnp.take(compute, lo, axis=2)
+        bb = jnp.take(compute, hi, axis=2)
+        compute = a + (bb - a) * wt[None, None, :, None]
+    return compute.astype(x.dtype)

@@ -1,0 +1,74 @@
+"""Bilinear sampling with grid_sample semantics (align_corners=True, zero pad).
+
+The reference samples the correlation volume through
+``bilinear_sampler`` (``core/utils/utils.py:59-73``), a pixel-coordinate wrapper
+over ``F.grid_sample(align_corners=True)`` that asserts the problem is 1D
+(H == 1). Out-of-range taps contribute zero (grid_sample ``padding_mode='zeros'``):
+a sample at x gets ``(1-frac)*v[floor(x)] + frac*v[floor(x)+1]`` with each tap
+zeroed when its index falls outside ``[0, W-1]``.
+
+TPU implementation note: these samplers are **one-hot reduces, not gathers**.
+``out = sum_j v[j] * w(x, j)`` with the interpolation weight built from an
+index comparison. XLA lowers per-pixel dynamic gathers to serial loops on TPU
+(measured 45x slower) and their VJP to scatters; the one-hot form is regular
+VPU/MXU work in both directions, and out-of-range zero padding falls out of
+the comparison for free. O(W) work per sample instead of O(1) — on TPU that
+trade wins by an order of magnitude.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _onehot_lerp_weights(x: jax.Array, width: int) -> jax.Array:
+    """Interpolation weight matrix w[..., j] for zero-padded linear sampling.
+
+    x: (...,) fractional positions -> returns (..., width) fp32 weights with
+    ``w[j] = (1-frac) * [j == floor(x)] + frac * [j == floor(x)+1]``.
+
+    Positions, iota, and weights are computed in float32 unconditionally:
+    integer positions above 256 are not representable in bfloat16, so a
+    bf16 equality comparison would silently drop or duplicate taps for
+    width > 256. Callers cast the final weight matrix to the value dtype.
+    """
+    x = x.astype(jnp.float32)
+    x0 = jnp.floor(x)
+    frac = (x - x0)[..., None]
+    j = jnp.arange(width, dtype=jnp.float32)
+    i0 = x0[..., None]
+    return jnp.where(j == i0, 1.0 - frac, 0.0) + jnp.where(j == i0 + 1.0,
+                                                           frac, 0.0)
+
+
+def sample_1d_zeros(values: jax.Array, x: jax.Array) -> jax.Array:
+    """Sample rows of scalars at fractional positions.
+
+    values: (..., W) — per-row 1D signals (e.g. a correlation-volume row).
+    x:      (..., K) — fractional sample positions, batch dims matching values.
+    Returns (..., K).
+    """
+    width = values.shape[-1]
+    # Per-tap loop keeps the peak intermediate at (..., W) instead of
+    # materializing the full (..., K, W) weight tensor.
+    taps = []
+    for k in range(x.shape[-1]):
+        w = _onehot_lerp_weights(x[..., k], width).astype(values.dtype)
+        taps.append(jnp.sum(values * w, axis=-1))
+    return jnp.stack(taps, axis=-1)
+
+
+def sample_rows_zeros(fmap: jax.Array, x: jax.Array) -> jax.Array:
+    """Sample feature rows at fractional x positions (vector-valued signal).
+
+    fmap: (..., W, D) — per-row features (e.g. fmap2 rows).
+    x:    (..., K)    — fractional sample positions.
+    Returns (..., K, D).
+
+    The one-hot weight turns the row gather into a (K, W) @ (W, D) matmul —
+    MXU work with the lerp folded into the weights.
+    """
+    width = fmap.shape[-2]
+    w = _onehot_lerp_weights(x, width).astype(fmap.dtype)  # (..., K, W)
+    return jnp.einsum("...kw,...wd->...kd", w, fmap)

@@ -1,0 +1,40 @@
+"""Learned convex-combination upsampling.
+
+Reference ``core/raft_stereo.py:55-67``: softmax over the 9-neighborhood mask,
+applied to 3x3 patches of ``factor * flow``. The reference uses ``F.unfold``;
+here the 9 shifted views are built by padding + static slicing (XLA fuses these
+into the downstream einsum — no materialized im2col) and combined with one
+einsum that maps straight onto the MXU.
+
+Channel-order contract (needed for weight transplant): the mask conv emits
+``factor**2 * 9`` channels viewed as ``(9, factor, factor)`` with the
+9-neighborhood index outermost (torch ``mask.view(N, 1, 9, factor, factor, H, W)``),
+and the neighborhood is enumerated row-major (dy, dx) like ``F.unfold``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _patches3x3(x: jax.Array) -> jax.Array:
+    """3x3 zero-padded patches of (B, H, W, C) -> (B, H, W, 9, C), row-major taps."""
+    b, h, w, c = x.shape
+    xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    views = [xp[:, dy:dy + h, dx:dx + w, :] for dy in range(3) for dx in range(3)]
+    return jnp.stack(views, axis=3)
+
+
+def convex_upsample(flow: jax.Array, mask: jax.Array, factor: int) -> jax.Array:
+    """Upsample (B, H, W, D) flow to (B, factor*H, factor*W, D).
+
+    mask: (B, H, W, factor**2 * 9) raw logits from the mask head.
+    """
+    b, h, w, d = flow.shape
+    mask = mask.astype(jnp.float32).reshape(b, h, w, 9, factor, factor)
+    mask = jax.nn.softmax(mask, axis=3)
+    patches = _patches3x3(flow.astype(jnp.float32) * factor)  # (B,H,W,9,D)
+    up = jnp.einsum("bhwkyx,bhwkd->bhwyxd", mask, patches)    # (B,H,W,fy,fx,D)
+    up = up.transpose(0, 1, 3, 2, 4, 5).reshape(b, h * factor, w * factor, d)
+    return up.astype(flow.dtype)

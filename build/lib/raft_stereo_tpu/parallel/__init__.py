@@ -1,0 +1,18 @@
+"""Parallel execution: device meshes, shardings, distributed init.
+
+The reference's only parallelism is single-process ``nn.DataParallel``
+(``train_stereo.py:134``) — replicate/scatter/gather over GPUs. The TPU-native
+equivalent is a sharding annotation, not a subsystem: batch-shard the data over
+a ``Mesh``, replicate params, and let XLA insert the gradient ``psum`` over
+ICI/DCN. A second, optional ``space`` axis shards image height — and with it
+the correlation volume — for full-resolution inputs (the 'long-context'
+analog; SURVEY.md §5), with XLA providing the conv halo exchanges.
+"""
+
+from raft_stereo_tpu.parallel.mesh import (  # noqa: F401
+    batch_sharding,
+    make_mesh,
+    replicated,
+    shard_batch,
+    spatial_sharding,
+)

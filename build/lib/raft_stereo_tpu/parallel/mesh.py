@@ -27,28 +27,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
 def maybe_distributed_init() -> None:
-    """Initialize jax.distributed when launched multi-host (no-op otherwise).
-
-    Opt-in via ``COORDINATOR_ADDRESS``. On cloud TPU pods the remaining
-    topology is auto-detected; manual launchers (including the 2-process CPU
-    distributed test, ``tests/test_multihost.py``) pass ``PROCESS_ID`` and
-    ``NUM_PROCESSES`` explicitly.
-    """
-    addr = os.environ.get("COORDINATOR_ADDRESS")
-    if not addr:
-        return
-    if os.environ.get("PROCESS_ID") is not None:
-        num = os.environ.get("NUM_PROCESSES")
-        if num is None:
-            raise RuntimeError(
-                "PROCESS_ID is set but NUM_PROCESSES is not: manual "
-                "multi-host launch needs COORDINATOR_ADDRESS, PROCESS_ID "
-                "and NUM_PROCESSES together")
-        jax.distributed.initialize(
-            coordinator_address=addr,
-            num_processes=int(num),
-            process_id=int(os.environ["PROCESS_ID"]))
-    else:
+    """Initialize jax.distributed when launched multi-host (no-op otherwise)."""
+    if os.environ.get("COORDINATOR_ADDRESS"):
         jax.distributed.initialize()
 
 

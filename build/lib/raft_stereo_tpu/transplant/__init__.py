@@ -1,0 +1,4 @@
+from raft_stereo_tpu.transplant.torch_loader import (  # noqa: F401
+    load_pth,
+    transplant_state_dict,
+)

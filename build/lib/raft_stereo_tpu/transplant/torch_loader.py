@@ -1,0 +1,143 @@
+"""Weight transplant: reference PyTorch checkpoints -> param pytree.
+
+Loads the published ``raftstereo-{middlebury,eth3d,realtime}.pth`` checkpoints
+(or any reference-architecture state_dict) into this framework's parameter
+pytree. The mapping is mechanical:
+
+- strip the ``module.`` prefix (the reference saves the DataParallel-wrapped
+  module, ``train_stereo.py:134,184``);
+- conv kernels OIHW -> HWIO;
+- BatchNorm running statistics become the frozen-BN affine state (the reference
+  never updates BN: ``freeze_bn``, ``core/raft_stereo.py:41-44``); the
+  ``num_batches_tracked`` counters are dropped;
+- InstanceNorm carries no parameters (torch ``affine=False`` default);
+- the reference registers the batch-norm residual shortcut's norm twice
+  (``norm3`` and ``downsample.1`` alias the same tensors,
+  ``core/extractor.py:44-45``); the ``downsample.1`` spelling is read.
+
+Only numpy/torch are needed; no reference code is imported.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Mapping
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from raft_stereo_tpu.config import RAFTStereoConfig
+
+
+def _np(t) -> np.ndarray:
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().numpy()
+    return np.asarray(t, dtype=np.float32)
+
+
+def _conv(sd: Mapping, name: str) -> Dict:
+    p = {"w": jnp.asarray(_np(sd[f"{name}.weight"]).transpose(2, 3, 1, 0))}
+    if f"{name}.bias" in sd:
+        p["b"] = jnp.asarray(_np(sd[f"{name}.bias"]))
+    return p
+
+
+def _bn(sd: Mapping, name: str) -> Dict:
+    return {"scale": jnp.asarray(_np(sd[f"{name}.weight"])),
+            "bias": jnp.asarray(_np(sd[f"{name}.bias"])),
+            "mean": jnp.asarray(_np(sd[f"{name}.running_mean"])),
+            "var": jnp.asarray(_np(sd[f"{name}.running_var"]))}
+
+
+def _norm(sd: Mapping, name: str, norm_fn: str) -> Dict:
+    if norm_fn == "batch":
+        return _bn(sd, name)
+    if norm_fn == "group":
+        return {"scale": jnp.asarray(_np(sd[f"{name}.weight"])),
+                "bias": jnp.asarray(_np(sd[f"{name}.bias"]))}
+    return {}  # instance / none: stateless
+
+
+def _residual_block(sd: Mapping, name: str, norm_fn: str) -> Dict:
+    p = {"conv1": _conv(sd, f"{name}.conv1"),
+         "conv2": _conv(sd, f"{name}.conv2"),
+         "norm1": _norm(sd, f"{name}.norm1", norm_fn),
+         "norm2": _norm(sd, f"{name}.norm2", norm_fn)}
+    if f"{name}.downsample.0.weight" in sd:
+        p["downsample"] = {"conv": _conv(sd, f"{name}.downsample.0"),
+                           "norm": _norm(sd, f"{name}.downsample.1", norm_fn)}
+    return p
+
+
+def _stage(sd: Mapping, name: str, norm_fn: str) -> list:
+    return [_residual_block(sd, f"{name}.0", norm_fn),
+            _residual_block(sd, f"{name}.1", norm_fn)]
+
+
+def _basic_encoder(sd: Mapping, prefix: str, norm_fn: str) -> Dict:
+    return {"conv1": _conv(sd, f"{prefix}.conv1"),
+            "norm1": _norm(sd, f"{prefix}.norm1", norm_fn),
+            "layer1": _stage(sd, f"{prefix}.layer1", norm_fn),
+            "layer2": _stage(sd, f"{prefix}.layer2", norm_fn),
+            "layer3": _stage(sd, f"{prefix}.layer3", norm_fn),
+            "conv2": _conv(sd, f"{prefix}.conv2")}
+
+
+def _multi_encoder(sd: Mapping, prefix: str, norm_fn: str, n_heads: int) -> Dict:
+    p = {"conv1": _conv(sd, f"{prefix}.conv1"),
+         "norm1": _norm(sd, f"{prefix}.norm1", norm_fn),
+         "layer1": _stage(sd, f"{prefix}.layer1", norm_fn),
+         "layer2": _stage(sd, f"{prefix}.layer2", norm_fn),
+         "layer3": _stage(sd, f"{prefix}.layer3", norm_fn),
+         "layer4": _stage(sd, f"{prefix}.layer4", norm_fn),
+         "layer5": _stage(sd, f"{prefix}.layer5", norm_fn)}
+    for scale in ("outputs08", "outputs16"):
+        p[scale] = [{"res": _residual_block(sd, f"{prefix}.{scale}.{j}.0", norm_fn),
+                     "conv": _conv(sd, f"{prefix}.{scale}.{j}.1")}
+                    for j in range(n_heads)]
+    p["outputs32"] = [{"conv": _conv(sd, f"{prefix}.outputs32.{j}")}
+                      for j in range(n_heads)]
+    return p
+
+
+def _gru(sd: Mapping, name: str) -> Dict:
+    return {g: _conv(sd, f"{name}.{g}") for g in ("convz", "convr", "convq")}
+
+
+def _update_block(sd: Mapping, prefix: str) -> Dict:
+    return {
+        "encoder": {c: _conv(sd, f"{prefix}.encoder.{c}")
+                    for c in ("convc1", "convc2", "convf1", "convf2", "conv")},
+        "gru08": _gru(sd, f"{prefix}.gru08"),
+        "gru16": _gru(sd, f"{prefix}.gru16"),
+        "gru32": _gru(sd, f"{prefix}.gru32"),
+        "flow_head": {"conv1": _conv(sd, f"{prefix}.flow_head.conv1"),
+                      "conv2": _conv(sd, f"{prefix}.flow_head.conv2")},
+        "mask": {"conv1": _conv(sd, f"{prefix}.mask.0"),
+                 "conv2": _conv(sd, f"{prefix}.mask.2")},
+    }
+
+
+def transplant_state_dict(state_dict: Mapping, cfg: RAFTStereoConfig) -> Dict:
+    """Convert a reference state_dict (torch tensors or numpy) to a param pytree."""
+    sd = {k[len("module."):] if k.startswith("module.") else k: v
+          for k, v in state_dict.items()}
+    params = {
+        "cnet": _multi_encoder(sd, "cnet", "batch", n_heads=2),
+        "update_block": _update_block(sd, "update_block"),
+        "context_zqr_convs": [_conv(sd, f"context_zqr_convs.{i}")
+                              for i in range(cfg.n_gru_layers)],
+    }
+    if cfg.shared_backbone:
+        params["conv2"] = {"res": _residual_block(sd, "conv2.0", "instance"),
+                           "conv": _conv(sd, "conv2.1")}
+    else:
+        params["fnet"] = _basic_encoder(sd, "fnet", "instance")
+    return params
+
+
+def load_pth(path: str, cfg: RAFTStereoConfig) -> Dict:
+    """Load a reference ``.pth`` checkpoint into a param pytree."""
+    import torch  # local import: torch is only needed for transplant
+    state_dict = torch.load(path, map_location="cpu", weights_only=True)
+    return transplant_state_dict(state_dict, cfg)

@@ -1,0 +1,138 @@
+"""Multi-host distributed training, actually executed.
+
+Spawns TWO OS processes that form a real jax.distributed pod over TCP
+(Gloo), 4 virtual CPU devices each (global mesh = 8). Both run the same
+``train()``; the deterministic per-sample loader RNG means each host
+materializes the same global batch and ``device_put`` keeps only its
+addressable shards. This executes the code paths the single-process suite
+can only reason about: ``maybe_distributed_init``'s explicit topology,
+pod-spanning mesh construction, lead-only checkpoint/log writes, and the
+pod-wide preemption agreement (SIGTERM lands on the NON-lead process; the
+per-step flag allgather must stop both at the same step).
+
+Slow: two fresh processes compile the tiny train step (~4 min each,
+serialized on a one-core host).
+"""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from tests.test_eval_engine import _tiny_things_tree
+
+pytestmark = pytest.mark.slow
+
+REPO = str(Path(__file__).resolve().parent.parent)
+
+CHILD = r"""
+import os, sys
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+from raft_stereo_tpu.config import RAFTStereoConfig, TrainConfig
+from raft_stereo_tpu.engine.train import train
+
+cfg = RAFTStereoConfig(hidden_dims=(32, 32, 32), corr_levels=2, corr_radius=2)
+tcfg = TrainConfig(name="mh", batch_size=8, image_size=(32, 48),
+                   num_steps={num_steps}, train_iters=2,
+                   ckpt_every={ckpt_every}, num_workers=1,
+                   spatial_scale=(-0.2, 0.4))
+os.chdir({workdir!r})
+train(cfg, tcfg, data_root={root!r}, validate=False)
+print("RAFT_MH_DONE", os.environ["PROCESS_ID"], jax.process_count(),
+      len(jax.devices()))
+"""
+
+
+def _spawn_pod(tmp_path, root, num_steps, ckpt_every):
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            "COORDINATOR_ADDRESS": f"localhost:{port}",
+            "NUM_PROCESSES": "2",
+            "PROCESS_ID": str(pid),
+        })
+        # NO persistent compilation cache here: XLA:CPU AOT cache entries
+        # record compile-machine features that can mismatch at load time in
+        # this image ("+prefer-no-scatter is not supported on the host
+        # machine ... SIGILL"), crashing one task mid-step and failing the
+        # pod's shutdown barrier. Fresh compiles are slower but correct.
+        env.pop("JAX_COMPILATION_CACHE_DIR", None)
+        wd = str(tmp_path / f"proc{pid}")
+        os.makedirs(wd, exist_ok=True)
+        code = CHILD.format(repo=REPO, root=root, workdir=wd,
+                            num_steps=num_steps, ckpt_every=ckpt_every)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", code], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    return procs
+
+
+def _finish(procs):
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=900)
+            outs.append(out)
+    finally:
+        for p in procs:  # never leak live training children on failure
+            if p.poll() is None:
+                p.kill()
+    assert all(p.returncode == 0 for p in procs), \
+        "\n".join(o[-2000:] for o in outs)
+    for i, out in enumerate(outs):
+        assert f"RAFT_MH_DONE {i} 2 8" in out, out[-2000:]
+    return outs
+
+
+def _ckpts(tmp_path, pid):
+    d = tmp_path / f"proc{pid}" / "checkpoints"
+    return sorted(os.listdir(d)) if d.is_dir() else []
+
+
+def test_two_process_pod_trains_and_lead_writes(tmp_path):
+    root = _tiny_things_tree(tmp_path)
+    procs = _spawn_pod(tmp_path, root, num_steps=3, ckpt_every=100)
+    _finish(procs)
+    assert "mh.msgpack" in _ckpts(tmp_path, 0)  # lead wrote the final state
+    assert _ckpts(tmp_path, 1) == []            # non-lead wrote nothing
+
+
+def test_preemption_of_one_process_stops_the_pod(tmp_path):
+    """SIGTERM only the NON-lead; the allgather must stop both processes at
+    the same step and the lead must save a preempt (not final) checkpoint."""
+    root = _tiny_things_tree(tmp_path)
+    procs = _spawn_pod(tmp_path, root, num_steps=500, ckpt_every=1)
+    try:
+        # Deterministic signal point: wait until the lead has checkpointed
+        # step 1 (training is provably past compile and the SIGTERM handler
+        # installed).
+        sentinel = tmp_path / "proc0" / "checkpoints" / "1_mh.msgpack"
+        deadline = time.time() + 600
+        while not sentinel.exists():
+            assert time.time() < deadline, "pod never reached step 1"
+            assert all(p.poll() is None for p in procs), \
+                "a process died early"
+            time.sleep(2)
+        procs[1].send_signal(signal.SIGTERM)
+    except BaseException:
+        for p in procs:
+            p.kill()
+        raise
+    _finish(procs)
+    lead = _ckpts(tmp_path, 0)
+    assert any("_preempt_" in f for f in lead), lead
+    assert "mh.msgpack" not in lead  # preempted ≠ finished
+    assert _ckpts(tmp_path, 1) == []
