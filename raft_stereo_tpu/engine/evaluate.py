@@ -120,6 +120,25 @@ def _epe_map(flow_pr: np.ndarray, flow_gt: np.ndarray) -> np.ndarray:
     return np.sqrt(np.sum((flow_pr - flow_gt) ** 2, axis=-1))
 
 
+def _prefetch_samples(dataset):
+    """Yield ``dataset[i]`` for all i, decoding sample i+1 on a background
+    thread while the caller runs the forward — image decode (PIL/cv2, GIL
+    released) overlaps device compute, so eval wall-clock approaches
+    max(decode, forward) per frame instead of their sum. Yield order and
+    contents are identical to direct indexing (eval datasets are
+    augmentation-free, so loading is deterministic)."""
+    from concurrent.futures import ThreadPoolExecutor
+    if len(dataset) == 0:
+        return
+    with ThreadPoolExecutor(max_workers=1) as ex:
+        fut = ex.submit(dataset.__getitem__, 0)
+        for i in range(1, len(dataset) + 1):
+            sample = fut.result()
+            if i < len(dataset):
+                fut = ex.submit(dataset.__getitem__, i)
+            yield sample
+
+
 def _run_pair(forward, sample, bucket: Optional[int]):
     image1 = sample["image1"][None]
     image2 = sample["image2"][None]
@@ -143,8 +162,7 @@ def validate_eth3d(params, cfg, iters: int = 32, mixed_prec: bool = False,
     forward = make_eval_forward(params, cfg, iters, mixed_prec, mesh=mesh)
 
     out_list, epe_list = [], []
-    for val_id in range(len(val_dataset)):
-        sample = val_dataset.__getitem__(val_id)
+    for val_id, sample in enumerate(_prefetch_samples(val_dataset)):
         flow_pr, _ = _run_pair(forward, sample, bucket)
         epe = _epe_map(flow_pr, sample["flow"]).flatten()
         val = sample["valid"].flatten() >= 0.5
@@ -177,8 +195,7 @@ def validate_kitti(params, cfg, iters: int = 32, mixed_prec: bool = False,
     forward = make_eval_forward(params, cfg, iters, mixed_prec, mesh=mesh)
 
     out_list, epe_list, elapsed_list = [], [], []
-    for val_id in range(len(val_dataset)):
-        sample = val_dataset.__getitem__(val_id)
+    for val_id, sample in enumerate(_prefetch_samples(val_dataset)):
         flow_pr, elapsed = _run_pair(forward, sample, bucket)
         if val_id > 50:  # warmup discard (reference :81)
             elapsed_list.append(elapsed)
@@ -212,8 +229,7 @@ def validate_things(params, cfg, iters: int = 32, mixed_prec: bool = False,
     forward = make_eval_forward(params, cfg, iters, mixed_prec, mesh=mesh)
 
     out_list, epe_list = [], []
-    for val_id in range(len(val_dataset)):
-        sample = val_dataset.__getitem__(val_id)
+    for val_id, sample in enumerate(_prefetch_samples(val_dataset)):
         flow_pr, _ = _run_pair(forward, sample, bucket)
         epe = _epe_map(flow_pr, sample["flow"]).flatten()
         val = ((sample["valid"].flatten() >= 0.5)
@@ -238,8 +254,7 @@ def validate_middlebury(params, cfg, iters: int = 32, split: str = "F",
     forward = make_eval_forward(params, cfg, iters, mixed_prec, mesh=mesh)
 
     out_list, epe_list = [], []
-    for val_id in range(len(val_dataset)):
-        sample = val_dataset.__getitem__(val_id)
+    for val_id, sample in enumerate(_prefetch_samples(val_dataset)):
         flow_pr, _ = _run_pair(forward, sample, bucket)
         epe = _epe_map(flow_pr, sample["flow"]).flatten()
         # Faithful to the reference: valid>=-0.5 is vacuously true for the 0/1

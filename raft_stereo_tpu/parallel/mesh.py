@@ -37,17 +37,16 @@ def maybe_distributed_init() -> None:
     addr = os.environ.get("COORDINATOR_ADDRESS")
     if not addr:
         return
-    if os.environ.get("PROCESS_ID") is not None:
-        num = os.environ.get("NUM_PROCESSES")
-        if num is None:
-            raise RuntimeError(
-                "PROCESS_ID is set but NUM_PROCESSES is not: manual "
-                "multi-host launch needs COORDINATOR_ADDRESS, PROCESS_ID "
-                "and NUM_PROCESSES together")
-        jax.distributed.initialize(
-            coordinator_address=addr,
-            num_processes=int(num),
-            process_id=int(os.environ["PROCESS_ID"]))
+    pid, num = os.environ.get("PROCESS_ID"), os.environ.get("NUM_PROCESSES")
+    if (pid is None) != (num is None):
+        raise RuntimeError(
+            "PROCESS_ID and NUM_PROCESSES must be set together (manual "
+            "multi-host launch needs COORDINATOR_ADDRESS, PROCESS_ID and "
+            f"NUM_PROCESSES); got PROCESS_ID={pid!r} NUM_PROCESSES={num!r}")
+    if pid is not None:
+        jax.distributed.initialize(coordinator_address=addr,
+                                   num_processes=int(num),
+                                   process_id=int(pid))
     else:
         jax.distributed.initialize()
 
