@@ -10,6 +10,8 @@ the ratio against ``BASELINE.json``'s ``published.fps`` when present, else null.
 
 Env overrides: RAFT_BENCH_H / RAFT_BENCH_W / RAFT_BENCH_ITERS /
 RAFT_BENCH_FRAMES / RAFT_BENCH_CORR (reg|alt|reg_tpu|alt_tpu) /
+RAFT_BENCH_BATCH (frames per dispatch — throughput mode for KITTI-size
+shapes; the Middlebury-F default stays batch 1, which is all that fits) /
 RAFT_BENCH_TRACE (directory: wrap one timed frame in ``jax.profiler.trace``
 for op-level attribution — the SURVEY §5 tracing hook).
 """
@@ -38,6 +40,7 @@ def main() -> None:
     # driver run short while amortizing the residual per-batch host
     # overhead (measured: 5 frames -> 0.719 fps, 10 -> 0.729).
     n_frames = int(os.environ.get("RAFT_BENCH_FRAMES", 8))
+    batch = int(os.environ.get("RAFT_BENCH_BATCH", 1))
     # Default to the Pallas lookup kernel — the north-star config and the
     # fastest measured path (BASELINE.md measured table).
     corr = os.environ.get("RAFT_BENCH_CORR", "reg_tpu")
@@ -72,8 +75,8 @@ def main() -> None:
     rng = np.random.default_rng(0)
 
     def frame():
-        img1 = jnp.asarray(rng.uniform(0, 255, (1, h, w, 3)), jnp.float32)
-        img2 = jnp.asarray(rng.uniform(0, 255, (1, h, w, 3)), jnp.float32)
+        img1 = jnp.asarray(rng.uniform(0, 255, (batch, h, w, 3)), jnp.float32)
+        img2 = jnp.asarray(rng.uniform(0, 255, (batch, h, w, 3)), jnp.float32)
         return img1, img2
 
     def fetch_and_check(checksum):
@@ -119,28 +122,33 @@ def main() -> None:
         checksum = fetch_and_check(c)
     elapsed = time.perf_counter() - t0
 
-    fps = n_frames / elapsed
+    fps = n_frames * batch / elapsed
 
     # Baseline preference: a published reference fps (none exists — the repo
     # publishes no numbers, BASELINE.md), else our measured torch-reference
     # datum at the same shape/protocol (CPU-labeled; no GPU in this image).
+    # Baselines were measured single-frame; a batched run's throughput is a
+    # different protocol, so the ratio is only reported at batch 1.
     baseline = None
     here = os.path.dirname(__file__)
-    try:
-        with open(os.path.join(here, "BASELINE.json")) as f:
-            baseline = json.load(f).get("published", {}).get("fps")
-    except (OSError, ValueError):
-        pass
-    if baseline is None:
+    if batch == 1:
         try:
-            with open(os.path.join(here, "baseline_measured.json")) as f:
-                baseline = json.load(f).get(f"torch_cpu_fps_{h}x{w}_{iters}iters")
+            with open(os.path.join(here, "BASELINE.json")) as f:
+                baseline = json.load(f).get("published", {}).get("fps")
         except (OSError, ValueError):
             pass
+        if baseline is None:
+            try:
+                with open(os.path.join(here, "baseline_measured.json")) as f:
+                    baseline = json.load(f).get(
+                        f"torch_cpu_fps_{h}x{w}_{iters}iters")
+            except (OSError, ValueError):
+                pass
 
     print(json.dumps({
         "metric": (f"middlebury_F_disparity_fps_per_chip_{iters}iters_"
-                   f"{h}x{w}_{corr}_{'bf16' if mixed else 'fp32'}"),
+                   f"{h}x{w}_{corr}_{'bf16' if mixed else 'fp32'}"
+                   + (f"_batch{batch}" if batch > 1 else "")),
         "value": round(fps, 4),
         "unit": "frames/s",
         "vs_baseline": round(fps / baseline, 4) if baseline else None,
