@@ -44,17 +44,26 @@ _ALIASES = {"reg_cuda": "reg_tpu", "alt_cuda": "alt_tpu"}
 
 
 def make_corr_fn(impl: str, fmap1: jax.Array, fmap2: jax.Array, *,
-                 num_levels: int = 4, radius: int = 4) -> CorrFn:
-    """Build a correlation lookup closure. fmaps are NHWC ``(B, H, W, D)``."""
+                 num_levels: int = 4, radius: int = 4,
+                 out_dtype=None) -> CorrFn:
+    """Build a correlation lookup closure. fmaps are NHWC ``(B, H, W, D)``.
+
+    ``out_dtype`` (default fp32) is the dtype of the returned taps. The
+    Pallas kernels downcast INSIDE the kernel — an external
+    ``astype`` on a custom-call output is a separate full-tensor XLA pass
+    (~8 ms/frame at Middlebury-F), while the XLA paths fuse it for free.
+    Lerp arithmetic is fp32 regardless.
+    """
     impl = _ALIASES.get(impl, impl)
+    kw = dict(num_levels=num_levels, radius=radius, out_dtype=out_dtype)
     if impl == "reg":
-        return make_reg_corr_fn(fmap1, fmap2, num_levels=num_levels, radius=radius)
+        return make_reg_corr_fn(fmap1, fmap2, **kw)
     if impl == "alt":
-        return make_alt_corr_fn(fmap1, fmap2, num_levels=num_levels, radius=radius)
+        return make_alt_corr_fn(fmap1, fmap2, **kw)
     if impl == "reg_tpu":
         from raft_stereo_tpu.corr.pallas_reg import make_reg_tpu_corr_fn
-        return make_reg_tpu_corr_fn(fmap1, fmap2, num_levels=num_levels, radius=radius)
+        return make_reg_tpu_corr_fn(fmap1, fmap2, **kw)
     if impl == "alt_tpu":
         from raft_stereo_tpu.corr.pallas_alt import make_alt_tpu_corr_fn
-        return make_alt_tpu_corr_fn(fmap1, fmap2, num_levels=num_levels, radius=radius)
+        return make_alt_tpu_corr_fn(fmap1, fmap2, **kw)
     raise ValueError(f"unknown corr implementation {impl!r}")

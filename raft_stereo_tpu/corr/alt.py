@@ -26,6 +26,7 @@ from raft_stereo_tpu.ops.sampler import sample_rows_zeros
 
 
 def make_alt_corr_fn(fmap1: jax.Array, fmap2: jax.Array, *,
+                     out_dtype=None,
                      num_levels: int, radius: int):
     f1 = fmap1.astype(jnp.float32)
     pyramid2 = [fmap2.astype(jnp.float32)]
@@ -51,7 +52,8 @@ def make_alt_corr_fn(fmap1: jax.Array, fmap2: jax.Array, *,
     def corr_fn(coords_x: jax.Array, h_chunk: int = 32) -> jax.Array:
         # Map over H chunks: peak memory O(chunk * W1 * (2r+1) * W2) for the
         # one-hot sampling weights instead of O(H * ...) — the point of `alt`.
-        return map_chunked(row_lookup, (f1, coords_x, *pyramid2),
-                           chunk=h_chunk, axis=1)
+        out = map_chunked(row_lookup, (f1, coords_x, *pyramid2),
+                          chunk=h_chunk, axis=1)
+        return out if out_dtype is None else out.astype(out_dtype)
 
     return corr_fn

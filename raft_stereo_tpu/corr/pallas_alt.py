@@ -74,6 +74,7 @@ def _pool_rows(f2: jax.Array) -> jax.Array:
 
 def _alt_kernel(coords_ref, f1_ref, f2_ref, out_ref, *, radius: int,
                 num_levels: int, widths: Sequence[int], scale: float):
+    # out_ref's dtype is the requested out_dtype; lerp arithmetic stays fp32.
     k = 2 * radius + 1
     c = coords_ref[0]  # (W1, 1)
     f1 = f1_ref[0]     # (W1, D)
@@ -86,12 +87,13 @@ def _alt_kernel(coords_ref, f1_ref, f2_ref, out_ref, *, radius: int,
             preferred_element_type=jnp.float32) * scale  # (W1, W2p_l)
         cl = c * (1.0 / (1 << lvl))
         out_ref[0, :, lvl * k:(lvl + 1) * k] = gather_lerp_taps(
-            vol, cl, radius, widths[lvl])
+            vol, cl, radius, widths[lvl]).astype(out_ref.dtype)
 
 
 def _pallas_alt(f1: jax.Array, f2: jax.Array, coords: jax.Array,
                 radius: int, num_levels: int,
-                widths: Tuple[int, ...], scale: float) -> jax.Array:
+                widths: Tuple[int, ...], scale: float,
+                out_dtype=jnp.float32) -> jax.Array:
     """f1: (BH, W1, D); f2: (BH, W2p, D) level-0 padded; coords: (BH, W1, 1)."""
     bh, w1, d = f1.shape
     w2p = f2.shape[1]
@@ -102,7 +104,7 @@ def _pallas_alt(f1: jax.Array, f2: jax.Array, coords: jax.Array,
                                scale=scale)
     return pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((bh, w1, out_ch), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((bh, w1, out_ch), out_dtype),
         grid=(bh,),
         in_specs=[pl.BlockSpec((1, w1, 1), lambda i: (i, 0, 0),
                                memory_space=pltpu.VMEM),
@@ -153,24 +155,28 @@ def _masked_alt_xla(f1: jax.Array, f2: jax.Array, coords: jax.Array,
     return map_chunked(chunk, (f1, coords, f2), chunk=8, axis=0)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _alt_lookup(f1, f2, coords, radius: int, num_levels: int,
-                widths: Tuple[int, ...], scale: float):
-    return _pallas_alt(f1, f2, coords, radius, num_levels, widths, scale)
+                widths: Tuple[int, ...], scale: float,
+                out_dtype=jnp.float32):
+    return _pallas_alt(f1, f2, coords, radius, num_levels, widths, scale,
+                       out_dtype)
 
 
-def _alt_fwd(f1, f2, coords, radius, num_levels, widths, scale):
-    out = _alt_lookup(f1, f2, coords, radius, num_levels, widths, scale)
+def _alt_fwd(f1, f2, coords, radius, num_levels, widths, scale, out_dtype):
+    out = _alt_lookup(f1, f2, coords, radius, num_levels, widths, scale,
+                      out_dtype)
     return out, (f1, f2, coords)
 
 
-def _alt_bwd(radius, num_levels, widths, scale, residuals, g):
+def _alt_bwd(radius, num_levels, widths, scale, out_dtype, residuals, g):
     f1, f2, coords = residuals
     _, vjp = jax.vjp(
         lambda a, b: _masked_alt_xla(a, b, coords, radius, num_levels,
                                      widths, scale),
         f1, f2)
-    df1, df2 = vjp(g)
+    # The oracle emits fp32; a bf16-out kernel hands back a bf16 cotangent.
+    df1, df2 = vjp(g.astype(jnp.float32))
     return df1, df2, jnp.zeros_like(coords)
 
 
@@ -178,7 +184,8 @@ _alt_lookup.defvjp(_alt_fwd, _alt_bwd)
 
 
 def make_alt_tpu_corr_fn(fmap1: jax.Array, fmap2: jax.Array, *,
-                         num_levels: int, radius: int):
+                         num_levels: int, radius: int, out_dtype=None):
+    out_dtype = jnp.float32 if out_dtype is None else out_dtype
     b, h, w1, d = fmap1.shape
     w2 = fmap2.shape[2]
     widths = level_widths(w2, num_levels)
@@ -193,7 +200,7 @@ def make_alt_tpu_corr_fn(fmap1: jax.Array, fmap2: jax.Array, *,
     def corr_fn(coords_x: jax.Array) -> jax.Array:
         coords_flat = coords_x.astype(jnp.float32).reshape(b * h, w1, 1)
         out = _alt_lookup(f1_flat, f2_flat, coords_flat, radius, num_levels,
-                          widths, scale)
+                          widths, scale, out_dtype)
         return out.reshape(b, h, w1, -1)
 
     return corr_fn

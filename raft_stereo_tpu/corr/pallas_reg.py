@@ -143,11 +143,12 @@ def _lookup_kernel(coords_ref, *refs, radius: int, widths: Sequence[int]):
     for lvl, vol_ref in enumerate(vol_refs):
         cl = c * (1.0 / (1 << lvl))
         out_ref[:, lvl * k:(lvl + 1) * k] = gather_lerp_taps(
-            vol_ref[:], cl, radius, widths[lvl])
+            vol_ref[:], cl, radius, widths[lvl]).astype(out_ref.dtype)
 
 
 def _pallas_lookup(pyramid: Sequence[jax.Array], coords_flat: jax.Array,
-                   radius: int, widths: Tuple[int, ...]) -> jax.Array:
+                   radius: int, widths: Tuple[int, ...],
+                   out_dtype) -> jax.Array:
     """pyramid: list of (N, W2p_l) fp32; coords_flat: (N, 1) fp32."""
     n = coords_flat.shape[0]
     k = 2 * radius + 1
@@ -156,7 +157,7 @@ def _pallas_lookup(pyramid: Sequence[jax.Array], coords_flat: jax.Array,
     kernel = functools.partial(_lookup_kernel, radius=radius, widths=widths)
     out = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((n, out_ch), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((n, out_ch), out_dtype),
         grid=(grid,),
         in_specs=[pl.BlockSpec((TILE, 1), lambda i: (i, 0),
                                memory_space=pltpu.VMEM)] +
@@ -197,21 +198,24 @@ def _masked_lookup_xla(pyramid: Sequence[jax.Array], coords_flat: jax.Array,
     return jnp.concatenate(out, axis=-1)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
 def _lookup(pyramid: List[jax.Array], coords_flat: jax.Array,
-            radius: int, widths: Tuple[int, ...]) -> jax.Array:
-    return _pallas_lookup(pyramid, coords_flat, radius, widths)
+            radius: int, widths: Tuple[int, ...],
+            out_dtype=jnp.float32) -> jax.Array:
+    return _pallas_lookup(pyramid, coords_flat, radius, widths, out_dtype)
 
 
-def _lookup_fwd(pyramid, coords_flat, radius, widths):
-    return _lookup(pyramid, coords_flat, radius, widths), (pyramid, coords_flat)
+def _lookup_fwd(pyramid, coords_flat, radius, widths, out_dtype):
+    return (_lookup(pyramid, coords_flat, radius, widths, out_dtype),
+            (pyramid, coords_flat))
 
 
-def _lookup_bwd(radius, widths, residuals, g):
+def _lookup_bwd(radius, widths, out_dtype, residuals, g):
     pyramid, coords_flat = residuals
     _, vjp = jax.vjp(
         lambda p: _masked_lookup_xla(p, coords_flat, radius, widths), pyramid)
-    (d_pyramid,) = vjp(g)
+    # The oracle emits fp32; a bf16-out kernel hands back a bf16 cotangent.
+    (d_pyramid,) = vjp(g.astype(jnp.float32))
     return d_pyramid, jnp.zeros_like(coords_flat)
 
 
@@ -227,7 +231,8 @@ def level_widths(w2: int, num_levels: int) -> Tuple[int, ...]:
 
 
 def make_reg_tpu_corr_fn(fmap1: jax.Array, fmap2: jax.Array, *,
-                         num_levels: int, radius: int):
+                         num_levels: int, radius: int, out_dtype=None):
+    out_dtype = jnp.float32 if out_dtype is None else out_dtype
     b, h, w1, _ = fmap1.shape
     w2 = fmap2.shape[2]
     widths = level_widths(w2, num_levels)
@@ -259,7 +264,7 @@ def make_reg_tpu_corr_fn(fmap1: jax.Array, fmap2: jax.Array, *,
     def corr_fn(coords_x: jax.Array) -> jax.Array:
         n = b * h * w1
         coords_flat = coords_x.astype(jnp.float32).reshape(n, 1)
-        out = _lookup(flat, coords_flat, radius, widths)
+        out = _lookup(flat, coords_flat, radius, widths, out_dtype)
         return out.reshape(b, h, w1, -1)
 
     return corr_fn

@@ -78,10 +78,14 @@ def lookup_pyramid(pyramid: List[jax.Array], coords_x: jax.Array,
 
 
 def make_reg_corr_fn(fmap1: jax.Array, fmap2: jax.Array, *,
+                     out_dtype=None,
                      num_levels: int, radius: int):
     pyramid = build_pyramid(build_volume(fmap1, fmap2), num_levels)
 
     def corr_fn(coords_x: jax.Array) -> jax.Array:
-        return lookup_pyramid(pyramid, coords_x, radius)
+        out = lookup_pyramid(pyramid, coords_x, radius)
+        # XLA fuses this convert into the reduce epilogue (free, unlike a
+        # convert on a Pallas custom-call output).
+        return out if out_dtype is None else out.astype(out_dtype)
 
     return corr_fn

@@ -135,9 +135,13 @@ def raft_stereo_forward(params: Params, cfg: RAFTStereoConfig,
 
     corr_fp32 = cfg.corr_implementation in ("reg", "alt")
     corr_dtype = jnp.float32 if corr_fp32 else compute_dtype
+    # out_dtype = compute dtype: the Pallas kernels downcast in-kernel (an
+    # external astype on a custom-call output is a separate full-tensor
+    # pass), so the scan body consumes corr_fn's output directly.
     corr_fn = make_corr_fn(cfg.corr_implementation,
                            fmap1.astype(corr_dtype), fmap2.astype(corr_dtype),
-                           num_levels=cfg.corr_levels, radius=cfg.corr_radius)
+                           num_levels=cfg.corr_levels, radius=cfg.corr_radius,
+                           out_dtype=compute_dtype)
 
     b, h, w, _ = net_list[0].shape
     coords0 = coords_grid(b, h, w)
@@ -151,7 +155,7 @@ def raft_stereo_forward(params: Params, cfg: RAFTStereoConfig,
 
     def one_iteration(net, coords1, compute_mask=True):
         coords1 = lax.stop_gradient(coords1)  # truncated BPTT (:109)
-        corr = corr_fn(coords1[..., 0]).astype(compute_dtype)
+        corr = corr_fn(coords1[..., 0])  # already compute_dtype (out_dtype)
         flow = (coords1 - coords0).astype(compute_dtype)
         if cfg.n_gru_layers == 3 and cfg.slow_fast_gru:  # low-res GRU only
             net = apply_update_block(params["update_block"], cfg, net, inp,

@@ -78,6 +78,35 @@ def test_impls_match_reg(fmaps, coords, impl):
     np.testing.assert_allclose(np.asarray(out), np.asarray(reg), atol=1e-4)
 
 
+@pytest.mark.parametrize("impl", ["reg", "alt", "reg_tpu", "alt_tpu"])
+def test_out_dtype_bf16(fmaps, coords, impl):
+    """out_dtype=bf16: the kernels downcast in-kernel (fp32 lerp arithmetic
+    retained), the XLA paths fuse the convert — all four must agree with the
+    fp32 path to bf16 rounding."""
+    f1, f2 = fmaps
+    ref = make_corr_fn("reg", f1, f2, num_levels=LEVELS, radius=RADIUS)(coords)
+    out = make_corr_fn(impl, f1, f2, num_levels=LEVELS, radius=RADIUS,
+                       out_dtype=jnp.bfloat16)(coords)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, np.float32), np.asarray(ref),
+                               atol=0.02, rtol=0.01)
+
+
+@pytest.mark.parametrize("impl", ["reg_tpu", "alt_tpu"])
+def test_out_dtype_bf16_grads_flow(fmaps, coords, impl):
+    """custom_vjp with a bf16 cotangent: grads reach the fmaps, finite."""
+    f1, f2 = fmaps
+
+    def loss(f1, f2):
+        fn = make_corr_fn(impl, f1, f2, num_levels=LEVELS, radius=RADIUS,
+                          out_dtype=jnp.bfloat16)
+        return jnp.sum(fn(coords).astype(jnp.float32) ** 2)
+
+    g1, g2 = jax.grad(loss, argnums=(0, 1))(f1, f2)
+    assert np.isfinite(np.asarray(g1)).all() and np.abs(g1).sum() > 0
+    assert np.isfinite(np.asarray(g2)).all() and np.abs(g2).sum() > 0
+
+
 @pytest.mark.parametrize("impl", ["reg_tpu", "alt_tpu"])
 @pytest.mark.parametrize("w", [200, 376])
 def test_tpu_impls_match_reg_wide(rng, impl, w):
