@@ -1,0 +1,88 @@
+"""CLI-level cross-implementation consistency on hardware.
+
+Builds reference-init weights (torch seed-1234 state_dict saved as .pth),
+fabricates a small synthetic ETH3D tree with shifted-noise stereo pairs
+(known constant disparity, so EPE is meaningful), and runs the REAL
+``evaluate_stereo.py`` CLI once per corr implementation on the TPU chip.
+All paths — XLA fp32 lookup, Pallas bf16-volume kernel, fused alt kernel —
+must report the same benchmark metrics to bf16-drift tolerance. This pins
+the full stack (CLI flag -> kernel dispatch -> validator metrics) on
+hardware, not just the corr layer in isolation.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+sys.path.insert(0, "/root/reference")
+
+import torch
+from PIL import Image
+
+from raft_stereo_tpu.data.frame_utils import write_pfm
+
+torch.set_num_threads(1)
+
+
+def main() -> None:
+    root = tempfile.mkdtemp(prefix="cliconsist")
+    from core.raft_stereo import RAFTStereo  # torch reference, weights only
+    torch.manual_seed(1234)
+    model = RAFTStereo(argparse.Namespace(
+        corr_implementation="reg", shared_backbone=False, corr_levels=4,
+        corr_radius=4, n_downsample=2, slow_fast_gru=False, n_gru_layers=3,
+        hidden_dims=[128, 128, 128], mixed_precision=False))
+    pth = os.path.join(root, "init.pth")
+    torch.save({f"module.{k}": v for k, v in model.state_dict().items()}, pth)
+
+    rng = np.random.default_rng(7)
+    h, w, disp = 192, 320, 12
+    for i in range(2):
+        base = rng.integers(0, 255, (h, w + disp, 3), dtype=np.uint8)
+        # Smooth the noise so bilinear structure survives bf16: block-upsample.
+        base = np.kron(base[::4, ::4], np.ones((4, 4, 1))).astype(np.uint8)[
+            :h, :w + disp]
+        left = base[:, disp:]
+        right = base[:, :-disp] if disp else base
+        sc = os.path.join(root, "ETH3D", "two_view_training", f"scene_{i}")
+        os.makedirs(sc, exist_ok=True)
+        Image.fromarray(left).save(os.path.join(sc, "im0.png"))
+        Image.fromarray(right).save(os.path.join(sc, "im1.png"))
+        gt = os.path.join(root, "ETH3D", "two_view_training_gt", f"scene_{i}")
+        os.makedirs(gt, exist_ok=True)
+        write_pfm(os.path.join(gt, "disp0GT.pfm"),
+                  np.full((h, w), float(disp), np.float32))
+
+    results = {}
+    for impl in ("reg", "reg_tpu", "alt_tpu"):
+        cmd = [sys.executable, "evaluate_stereo.py", "--dataset", "eth3d",
+               "--dataset_root", root, "--restore_ckpt", pth,
+               "--valid_iters", "16", "--corr_implementation", impl]
+        if impl != "reg":
+            cmd.append("--mixed_precision")
+        r = subprocess.run(cmd, cwd="/root/repo", capture_output=True,
+                           text=True)
+        assert r.returncode == 0, r.stderr[-500:]
+        line = [l for l in r.stdout.splitlines()
+                if l.startswith("Validation ETH3D")][0]
+        epe = float(line.split("EPE ")[1].split(",")[0])
+        d1 = float(line.split("D1 ")[1])
+        results[impl] = (epe, d1)
+        print(f"{impl:8s} (mixed={impl != 'reg'}): EPE {epe:.4f} D1 {d1:.3f}")
+
+    ref_epe, _ = results["reg"]
+    for impl, (epe, d1) in results.items():
+        assert abs(epe - ref_epe) < 0.05, (impl, epe, ref_epe)
+    print(json.dumps({"consistency": "OK",
+                      "max_epe_delta": round(max(
+                          abs(e - ref_epe) for e, _ in results.values()), 5)}))
+
+
+if __name__ == "__main__":
+    main()
