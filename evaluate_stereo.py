@@ -84,15 +84,12 @@ def main(argv=None) -> None:
                   root=args.dataset_root)
     if args.spatial_shard > 1:
         from raft_stereo_tpu.parallel import make_mesh
-        n_dev = len(jax.devices())
-        if args.spatial_shard > n_dev:
-            raise SystemExit(
-                f"--spatial_shard {args.spatial_shard} exceeds the "
-                f"{n_dev} available device(s)")
-        if 32 % args.spatial_shard:
-            raise SystemExit(
-                f"--spatial_shard {args.spatial_shard} must divide 32 so "
-                "every /32-padded image height shards evenly")
+        from raft_stereo_tpu.parallel.mesh import validate_spatial_shard
+        try:
+            validate_spatial_shard(args.spatial_shard, len(jax.devices()),
+                                   jax.local_device_count())
+        except ValueError as e:
+            raise SystemExit(f"--{e}") from None
         common["mesh"] = make_mesh(n_data=1, n_space=args.spatial_shard)
     if args.bucket is not None:
         # Otherwise keep each validator's own default (KITTI buckets to /64

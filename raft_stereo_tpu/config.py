@@ -96,6 +96,10 @@ class TrainConfig:
     # Profile one steady-state step into this directory (jax.profiler trace,
     # SURVEY §5 tracing; same hook bench.py exposes as RAFT_BENCH_TRACE).
     trace_dir: Optional[str] = None
+    # Shard each sample's height over this many devices (the mesh `space`
+    # axis) in addition to batch data parallelism — the big-crop/full-res
+    # training enabler, mirroring evaluate's --spatial_shard.
+    spatial_shard: int = 1
 
     def __post_init__(self):
         self.train_datasets = tuple(self.train_datasets)

@@ -32,9 +32,41 @@ from raft_stereo_tpu.engine.logger import Logger
 from raft_stereo_tpu.engine.optimizer import make_optimizer
 from raft_stereo_tpu.engine.steps import make_train_step
 from raft_stereo_tpu.models import init_raft_stereo
-from raft_stereo_tpu.parallel.mesh import make_mesh, maybe_distributed_init
+from raft_stereo_tpu.parallel.mesh import (make_mesh, maybe_distributed_init,
+                                           validate_spatial_shard)
 
 logger = logging.getLogger(__name__)
+
+
+def choose_mesh(batch_size: int, spatial_shard: int, devices,
+                process_count: int, local_device_count=None):
+    """Pick the training mesh from the device/process topology.
+
+    ``spatial_shard`` > 1 reserves a ``space`` axis (each sample's height
+    split across chips — the big-crop enabler); the rest of ``devices`` form
+    the ``data`` axis. Multi-host pods must place EVERY process's devices in
+    the mesh (a process whose chips are excluded would deadlock at the first
+    collective), so there the batch has to divide the data extent exactly.
+    Returns None when a single device (no axis > 1) is the right answer.
+    """
+    devices = list(devices)
+    n_devices = len(devices)
+    n_space = max(1, spatial_shard)
+    validate_spatial_shard(n_space, n_devices, local_device_count)
+    avail = n_devices // n_space
+    if process_count > 1:
+        n_data = avail
+        if batch_size % n_data:
+            raise ValueError(
+                f"batch_size {batch_size} must divide evenly over the "
+                f"pod's data extent {n_data} ({n_devices} devices / "
+                f"{n_space} spatial shards)")
+    else:
+        n_data = max(d for d in range(1, avail + 1) if batch_size % d == 0)
+    if n_data * n_space == 1:
+        return None
+    return make_mesh(n_data=n_data, n_space=n_space,
+                     devices=devices[:n_data * n_space])
 
 
 class PreemptGuard:
@@ -104,24 +136,10 @@ def train(cfg: RAFTStereoConfig, tcfg: TrainConfig,
     # the whole pod and the data mesh spans hosts over DCN. No-op otherwise.
     maybe_distributed_init()
     is_lead = jax.process_index() == 0
-    if mesh is None and len(jax.devices()) > 1:
-        if jax.process_count() > 1:
-            # Multi-host: every process's devices MUST be in the mesh (a
-            # process whose chips are excluded would deadlock at the first
-            # collective), so the batch has to divide the full pod.
-            if tcfg.batch_size % len(jax.devices()):
-                raise ValueError(
-                    f"batch_size {tcfg.batch_size} must divide evenly over "
-                    f"all {len(jax.devices())} devices of the pod")
-            mesh = make_mesh(n_data=len(jax.devices()))
-        else:
-            # Single host: use the largest device count that divides the
-            # batch (all devices in the common case).
-            n_data = max(d for d in range(1, len(jax.devices()) + 1)
-                         if tcfg.batch_size % d == 0)
-            if n_data > 1:
-                mesh = make_mesh(n_data=n_data,
-                                 devices=jax.devices()[:n_data])
+    if mesh is None:
+        mesh = choose_mesh(tcfg.batch_size, tcfg.spatial_shard,
+                           jax.devices(), jax.process_count(),
+                           jax.local_device_count())
 
     key = jax.random.PRNGKey(tcfg.seed)
     params = jax.jit(lambda k: init_raft_stereo(k, cfg))(key)

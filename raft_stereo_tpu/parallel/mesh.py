@@ -51,6 +51,35 @@ def maybe_distributed_init() -> None:
         jax.distributed.initialize()
 
 
+def validate_spatial_shard(n_space: int, n_devices: int,
+                           local_devices: Optional[int] = None) -> None:
+    """Shared checks for the ``space`` (height) axis extent.
+
+    Raises ValueError (CLIs turn it into their exit style). The /32 rule:
+    every input is padded to a /32-multiple height (train crops and eval
+    padding alike), so a shard count dividing 32 shards every feature scale
+    evenly. ``local_devices`` (multi-host): the space axis must fit within
+    one process's devices so halo exchanges and corr-volume traffic ride
+    ICI, not DCN (the layout invariant this module's docstring promises).
+    """
+    if n_space <= 1:
+        return
+    if n_devices % n_space:
+        raise ValueError(
+            f"spatial_shard {n_space} does not divide the "
+            f"{n_devices} available device(s)")
+    if 32 % n_space:
+        raise ValueError(
+            f"spatial_shard {n_space} must divide 32 so every /32-multiple "
+            "input height shards evenly at all scales")
+    if local_devices is not None and local_devices % n_space:
+        raise ValueError(
+            f"spatial_shard {n_space} must divide the {local_devices} "
+            "devices local to each host, or the space axis would span "
+            "hosts and its halo/volume traffic would ride DCN instead of "
+            "ICI")
+
+
 def make_mesh(n_data: Optional[int] = None, n_space: int = 1,
               devices: Optional[Sequence] = None) -> Mesh:
     devices = list(devices if devices is not None else jax.devices())

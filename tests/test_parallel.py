@@ -87,6 +87,34 @@ def test_spatial_sharded_eval_matches_single(rng):
     assert sharded < single / 2, (sharded, single)
 
 
+def test_choose_mesh_topologies():
+    """Training mesh selection from (batch, spatial_shard, devices, procs)."""
+    from raft_stereo_tpu.engine.train import choose_mesh
+
+    dev = jax.devices()  # 8 virtual CPU devices (conftest)
+    m = choose_mesh(8, 1, dev, 1)
+    assert dict(m.shape) == {"data": 8, "space": 1}
+    m = choose_mesh(2, 4, dev, 1)  # big-crop mode: 2-way data x 4-way height
+    assert dict(m.shape) == {"data": 2, "space": 4}
+    m = choose_mesh(6, 1, dev, 1)  # largest batch divisor <= devices
+    assert dict(m.shape) == {"data": 6, "space": 1}
+    assert choose_mesh(1, 1, dev[:1], 1) is None  # single device: no mesh
+    m = choose_mesh(8, 1, dev, 2)  # pod: all devices, batch must divide
+    assert dict(m.shape) == {"data": 8, "space": 1}
+    # pod of 2 hosts x 4 local devices: space axis must stay within a host
+    m = choose_mesh(2, 4, dev, 2, local_device_count=4)
+    assert dict(m.shape) == {"data": 2, "space": 4}
+
+    with pytest.raises(ValueError, match="divide 32"):
+        choose_mesh(8, 3, dev[:6], 1)
+    with pytest.raises(ValueError, match="does not divide"):
+        choose_mesh(8, 16, dev, 1)
+    with pytest.raises(ValueError, match="divide evenly"):
+        choose_mesh(5, 1, dev, 2)
+    with pytest.raises(ValueError, match="ICI"):
+        choose_mesh(1, 8, dev, 2, local_device_count=4)
+
+
 def test_spatial_sharded_train_step_matches_single(rng):
     """Grads/updates under a (data=2, space=4) mesh match single-device."""
     cfg = RAFTStereoConfig(n_gru_layers=1)

@@ -65,6 +65,10 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument('--trace_dir', default=None,
                         help="profile one steady-state train step into this "
                              "directory (jax.profiler trace)")
+    parser.add_argument('--spatial_shard', type=int, default=1,
+                        help="shard each sample's height over this many "
+                             "devices (mesh 'space' axis) in addition to "
+                             "batch data parallelism")
     return parser
 
 
