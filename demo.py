@@ -62,21 +62,53 @@ def demo(args) -> None:
     print(f"Found {len(left_images)} images. "
           f"Saving files to {output_directory}/")
 
+    from concurrent.futures import ThreadPoolExecutor
+
     from matplotlib import pyplot as plt
 
-    for imfile1, imfile2 in zip(left_images, right_images):
-        image1 = read_image_rgb(imfile1).astype(np.float32)[None]
-        image2 = read_image_rgb(imfile2).astype(np.float32)[None]
-        padder = InputPadder(image1.shape, divis_by=32)
-        image1, image2 = padder.pad_np(image1, image2)
-        flow_up, _ = forward(image1, image2)
-        flow_up = np.asarray(padder.unpad(flow_up))[0, ..., 0]
+    from raft_stereo_tpu.engine.evaluate import prefetch_samples
 
-        file_stem = imfile1.split('/')[-2]
+    class _PairLoader:
+        """Indexable over (left, right) paths — lets the demo share the
+        validators' decode-ahead generator (``prefetch_samples``)."""
+
+        def __init__(self, pairs):
+            self.pairs = pairs
+
+        def __len__(self):
+            return len(self.pairs)
+
+        def __getitem__(self, i):
+            f1, f2 = self.pairs[i]
+            return (f1, read_image_rgb(f1).astype(np.float32)[None],
+                    read_image_rgb(f2).astype(np.float32)[None])
+
+    def save_one(file_stem, flow_up):
         if args.save_numpy:
             np.save(output_directory / f"{file_stem}.npy", flow_up.squeeze())
         plt.imsave(output_directory / f"{file_stem}.png", -flow_up.squeeze(),
                    cmap='jet')
+
+    # Decode the next pair (prefetch_samples) and encode the previous result
+    # on background threads while the chip runs the current forward: at full
+    # resolution the jet-PNG encode alone costs about as much host time as
+    # the forward costs device time. At most one save is in flight, awaited
+    # in order, so outputs and memory stay bounded.
+    loader = _PairLoader(list(zip(left_images, right_images)))
+    with ThreadPoolExecutor(max_workers=1) as saver:
+        pending_save = None
+        for imfile1, image1, image2 in prefetch_samples(loader):
+            padder = InputPadder(image1.shape, divis_by=32)
+            image1, image2 = padder.pad_np(image1, image2)
+            flow_up, _ = forward(image1, image2)
+            flow_up = np.asarray(padder.unpad(flow_up))[0, ..., 0]
+
+            if pending_save is not None:
+                pending_save.result()
+            pending_save = saver.submit(save_one, imfile1.split('/')[-2],
+                                        flow_up)
+        if pending_save is not None:
+            pending_save.result()
 
 
 def main(argv=None) -> None:

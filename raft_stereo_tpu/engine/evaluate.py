@@ -120,13 +120,14 @@ def _epe_map(flow_pr: np.ndarray, flow_gt: np.ndarray) -> np.ndarray:
     return np.sqrt(np.sum((flow_pr - flow_gt) ** 2, axis=-1))
 
 
-def _prefetch_samples(dataset):
+def prefetch_samples(dataset):
     """Yield ``dataset[i]`` for all i, decoding sample i+1 on a background
     thread while the caller runs the forward — image decode (PIL/cv2, GIL
     released) overlaps device compute, so eval wall-clock approaches
     max(decode, forward) per frame instead of their sum. Yield order and
     contents are identical to direct indexing (eval datasets are
-    augmentation-free, so loading is deterministic)."""
+    augmentation-free, so loading is deterministic). ``dataset`` is anything
+    indexable with a ``len`` (the demo CLI wraps its image-pair list)."""
     from concurrent.futures import ThreadPoolExecutor
     if len(dataset) == 0:
         return
@@ -162,7 +163,7 @@ def validate_eth3d(params, cfg, iters: int = 32, mixed_prec: bool = False,
     forward = make_eval_forward(params, cfg, iters, mixed_prec, mesh=mesh)
 
     out_list, epe_list = [], []
-    for val_id, sample in enumerate(_prefetch_samples(val_dataset)):
+    for val_id, sample in enumerate(prefetch_samples(val_dataset)):
         flow_pr, _ = _run_pair(forward, sample, bucket)
         epe = _epe_map(flow_pr, sample["flow"]).flatten()
         val = sample["valid"].flatten() >= 0.5
@@ -234,7 +235,7 @@ def validate_things(params, cfg, iters: int = 32, mixed_prec: bool = False,
     forward = make_eval_forward(params, cfg, iters, mixed_prec, mesh=mesh)
 
     out_list, epe_list = [], []
-    for val_id, sample in enumerate(_prefetch_samples(val_dataset)):
+    for val_id, sample in enumerate(prefetch_samples(val_dataset)):
         flow_pr, _ = _run_pair(forward, sample, bucket)
         epe = _epe_map(flow_pr, sample["flow"]).flatten()
         val = ((sample["valid"].flatten() >= 0.5)
@@ -259,7 +260,7 @@ def validate_middlebury(params, cfg, iters: int = 32, split: str = "F",
     forward = make_eval_forward(params, cfg, iters, mixed_prec, mesh=mesh)
 
     out_list, epe_list = [], []
-    for val_id, sample in enumerate(_prefetch_samples(val_dataset)):
+    for val_id, sample in enumerate(prefetch_samples(val_dataset)):
         flow_pr, _ = _run_pair(forward, sample, bucket)
         epe = _epe_map(flow_pr, sample["flow"]).flatten()
         # Faithful to the reference: valid>=-0.5 is vacuously true for the 0/1

@@ -63,13 +63,13 @@ def test_prefetch_samples_matches_direct_indexing(tmp_path):
     _make_eth3d_tree(str(tmp_path / "ETH3D"), [0.5, 2.0, 3.0])
     dataset = ds.ETH3D(aug_params=None, root=str(tmp_path / "ETH3D"))
     direct = [dataset[i] for i in range(len(dataset))]
-    fetched = list(ev._prefetch_samples(dataset))
+    fetched = list(ev.prefetch_samples(dataset))
     assert len(fetched) == len(direct) == 3
     for a, b in zip(fetched, direct):
         assert a["paths"] == b["paths"]
         np.testing.assert_array_equal(a["image1"], b["image1"])
         np.testing.assert_array_equal(a["flow"], b["flow"])
-    assert list(ev._prefetch_samples(dataset * 0)) == []  # empty dataset
+    assert list(ev.prefetch_samples(dataset * 0)) == []  # empty dataset
 
 
 def test_validate_eth3d_per_image_aggregation(tmp_path, monkeypatch):
