@@ -443,3 +443,28 @@ def test_sparse_vflip_flips_valid_mask():
     _, _, of, ov = aug(img, img, flow, valid, np.random.default_rng(0))
     assert ov[7, 3] == 1 and ov[0, 3] == 0  # mask flipped with the flow
     np.testing.assert_allclose(of[7, 3], [-4.0, 0.0])
+
+
+def test_device_prefetch_order_dtype_and_flush():
+    """Background-thread device placement: order preserved, every batch
+    yielded (incl. the tail buffer), images downcast when image_dtype is
+    set, non-image arrays untouched."""
+    import jax.numpy as jnp
+
+    from raft_stereo_tpu.data.loader import device_prefetch
+
+    batches = [{"image1": np.full((1, 4, 4, 3), i, np.float32),
+                "image2": np.zeros((1, 4, 4, 3), np.float32),
+                "flow": np.zeros((1, 4, 4, 1), np.float32),
+                "valid": np.ones((1, 4, 4), np.float32)}
+               for i in range(5)]
+
+    out = list(device_prefetch(iter(batches), image_dtype=jnp.bfloat16))
+    assert len(out) == 5
+    for i, b in enumerate(out):
+        assert float(b["image1"][0, 0, 0, 0]) == float(i)  # order preserved
+        assert b["image1"].dtype == jnp.bfloat16
+        assert b["flow"].dtype == jnp.float32  # only images downcast
+
+    out = list(device_prefetch(iter(batches)))
+    assert out[3]["image1"].dtype == jnp.float32  # no dtype override
