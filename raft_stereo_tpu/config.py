@@ -34,6 +34,12 @@ class RAFTStereoConfig:
     # for loss scaling entirely. Correlation math stays fp32 (the reference
     # casts fmaps .float() for non-CUDA corr, core/raft_stereo.py:92-95).
     mixed_precision: bool = False
+    # Streaming Pallas kernels for the scan body (fused ConvGRU / motion
+    # encoder / flow head; ops/pallas_stream.py). Engaged only for bf16
+    # single-sample steps; spatially-sharded eval sets this False — compiled
+    # Mosaic kernels have no SPMD partitioning rule, so a jit sharded over a
+    # real multi-chip mesh cannot split the pallas_call.
+    fused_update: bool = True
 
     def __post_init__(self):
         self.hidden_dims = tuple(self.hidden_dims)

@@ -71,14 +71,15 @@ def make_eval_forward(params, cfg: RAFTStereoConfig, iters: int,
         # kernels in shard_map is the future path.)
         swap = {"reg_tpu": "reg", "alt_tpu": "alt",
                 "reg_cuda": "reg", "alt_cuda": "alt"}
-        if (mesh.shape.get("space", 1) > 1
-                and cfg.corr_implementation in swap):
-            xla_impl = swap[cfg.corr_implementation]
-            logger.warning(
-                "spatial sharding cannot partition the %s Pallas kernel; "
-                "falling back to the XLA '%s' implementation",
-                cfg.corr_implementation, xla_impl)
-            overrides["corr_implementation"] = xla_impl
+        if mesh.shape.get("space", 1) > 1:
+            overrides["fused_update"] = False  # same no-SPMD-rule constraint
+            if cfg.corr_implementation in swap:
+                xla_impl = swap[cfg.corr_implementation]
+                logger.warning(
+                    "spatial sharding cannot partition the %s Pallas kernel; "
+                    "falling back to the XLA '%s' implementation",
+                    cfg.corr_implementation, xla_impl)
+                overrides["corr_implementation"] = xla_impl
     run_cfg = (cfg if not overrides else
                RAFTStereoConfig(**{**cfg.__dict__, **overrides}))
 

@@ -153,6 +153,19 @@ def raft_stereo_forward(params: Params, cfg: RAFTStereoConfig,
     inp = [tuple(c.astype(compute_dtype) for c in triple) for triple in inp_list]
     factor = cfg.downsample_factor
 
+    # Pre-folded per-level GRU context for the streaming Pallas kernels —
+    # loop-invariant, so built ONCE here rather than inside the scan.
+    # cfg.fused_update=False (spatially-sharded eval) leaves every entry
+    # None, keeping the whole scan body on partitionable XLA ops.
+    from raft_stereo_tpu.ops.pallas_stream import (
+        gru_is_fusable, prepare_gru_context)
+    fused_ctx = [
+        prepare_gru_context(
+            params["update_block"][("gru08", "gru16", "gru32")[i]],
+            inp[i], compute_dtype)
+        if cfg.fused_update and gru_is_fusable(net[i]) else None
+        for i in range(cfg.n_gru_layers)]
+
     def one_iteration(net, coords1, compute_mask=True):
         coords1 = lax.stop_gradient(coords1)  # truncated BPTT (:109)
         corr = corr_fn(coords1[..., 0])  # already compute_dtype (out_dtype)
@@ -160,15 +173,16 @@ def raft_stereo_forward(params: Params, cfg: RAFTStereoConfig,
         if cfg.n_gru_layers == 3 and cfg.slow_fast_gru:  # low-res GRU only
             net = apply_update_block(params["update_block"], cfg, net, inp,
                                      iter32=True, iter16=False, iter08=False,
-                                     update=False)
+                                     update=False, fused_ctx=fused_ctx)
         if cfg.n_gru_layers >= 2 and cfg.slow_fast_gru:  # low+mid-res GRUs
             net = apply_update_block(params["update_block"], cfg, net, inp,
                                      iter32=cfg.n_gru_layers == 3, iter16=True,
-                                     iter08=False, update=False)
+                                     iter08=False, update=False,
+                                     fused_ctx=fused_ctx)
         net, up_mask, delta_flow = apply_update_block(
             params["update_block"], cfg, net, inp, corr, flow,
             iter32=cfg.n_gru_layers == 3, iter16=cfg.n_gru_layers >= 2,
-            compute_mask=compute_mask)
+            compute_mask=compute_mask, fused_ctx=fused_ctx)
         # Stereo: project the update onto the epipolar line (:120).
         delta_flow = delta_flow.astype(jnp.float32).at[..., 1].set(0.0)
         coords1 = coords1 + delta_flow

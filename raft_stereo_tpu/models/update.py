@@ -81,15 +81,14 @@ def apply_conv_gru(p: Params, h: jax.Array, context: Sequence[jax.Array],
     weights concatenated along the output channels) and every gate conv is
     split over its input parts instead of concatenating them — same
     arithmetic, no materialized ``[h; x]`` tensors in the scan body.
+    (Measured at Middlebury-F: materializing the x concat + a single wide
+    conv is SLOWER — XLA emits the concat, its conv-layout pad, and the
+    fp32 upcast of the output as three extra full-tensor passes.)
     """
     cz, cr, cq = context
     pad = p["convz"]["w"].shape[0] // 2
     ch = h.shape[-1]
     wz, wr, wq = p["convz"]["w"], p["convr"]["w"], p["convq"]["w"]
-    # Every gate conv splits into an h-part (first ch input channels) and an
-    # x-part. The x inputs are shared by all three gates, so their three
-    # convs fuse into ONE split-conv with 3*ch output channels — same
-    # FLOPs, one wide MXU pass over x instead of two narrower ones.
     wx = jnp.concatenate([jax.lax.slice_in_dim(w, ch, w.shape[2], axis=2)
                           for w in (wz, wr, wq)], axis=-1)
     ax = _split_conv(wx, None, x_list, pad, out_dtype=jnp.float32)
@@ -142,17 +141,20 @@ def init_motion_encoder(key, cfg: RAFTStereoConfig) -> Params:
 
 
 def apply_motion_encoder(p: Params, flow: jax.Array,
-                         corr: jax.Array) -> Tuple[jax.Array, jax.Array]:
+                         corr: jax.Array) -> jax.Array:
     cor = jax.nn.relu(apply_conv(p["convc1"], corr))
     cor = jax.nn.relu(apply_conv(p["convc2"], cor, padding=1))
     flo = jax.nn.relu(apply_conv(p["convf1"], flow, padding=3))
     flo = jax.nn.relu(apply_conv(p["convf2"], flo, padding=1))
     out = jax.nn.relu(_split_conv(p["conv"]["w"], p["conv"]["b"], (cor, flo),
                                   pad=1))
-    # Motion features are (fused 126ch, raw 2ch flow) — returned as parts;
-    # the consuming gate convs split over parts, so the reference's channel
-    # order (update.py:85) is preserved without materializing the concat.
-    return out, flow
+    # Motion features are (fused 126ch ‖ raw 2ch flow), reference channel
+    # order (update.py:85). Emitting the 128ch concat here (one fused copy
+    # pass) lets the consuming gate conv read ONE lane-aligned tensor —
+    # the alternative, a separate 2-channel conv partial, costs a full
+    # (H, W, 3*hidden) fp32 write+read per iteration for two channels of
+    # input (profiled ~1 ms/iter at Middlebury-F).
+    return jnp.concatenate([out, flow.astype(out.dtype)], axis=-1)
 
 
 def init_update_block(key, cfg: RAFTStereoConfig) -> Params:
@@ -188,7 +190,8 @@ def apply_update_block(p: Params, cfg: RAFTStereoConfig,
                        net: Tuple[jax.Array, ...], inp: Sequence[Sequence[jax.Array]],
                        corr: jax.Array | None = None, flow: jax.Array | None = None,
                        iter08: bool = True, iter16: bool = True, iter32: bool = True,
-                       update: bool = True, compute_mask: bool = True):
+                       update: bool = True, compute_mask: bool = True,
+                       fused_ctx: Sequence | None = None):
     """Reference ``BasicMultiUpdateBlock.forward`` (``core/update.py:115-138``).
 
     net: per-scale hidden states, finest first. inp: per-scale (cz, cr, cq).
@@ -200,27 +203,65 @@ def apply_update_block(p: Params, cfg: RAFTStereoConfig,
     (``raft_stereo.py:126-127`` semantics) can hoist the mask convs out of
     the iteration loop — identical outputs, ~2/33 of the per-iteration conv
     FLOPs saved (the reference computes-and-discards it every iteration).
+
+    ``fused_ctx``: per-level pre-folded context from
+    ``pallas_stream.prepare_gru_context`` (hoisted out of the scan);
+    non-None entries route that level through the streaming Pallas GRU
+    kernel. In the test-mode scan (``compute_mask=False``) the FlowHead is
+    chained into the finest kernel and the x-delta comes back with it.
     """
+    from raft_stereo_tpu.ops.pallas_stream import (
+        fused_conv_gru, fused_gru_head, fused_motion, gru_is_fusable,
+        motion_is_fusable)
+    fc = list(fused_ctx) if fused_ctx is not None else []
+    fc += [None] * (3 - len(fc))
+
+    def gru(idx, h, ctx, *xs):
+        gp = p[("gru08", "gru16", "gru32")[idx]]
+        # bf16 single-sample steps run the streaming Pallas kernel (gate
+        # convs + nonlinearities + state update fused in VMEM); other
+        # shapes/dtypes use the XLA formulation.
+        if fc[idx] is not None and gru_is_fusable(h, *xs):
+            return fused_conv_gru(gp, h, fc[idx], ctx, *xs)
+        return apply_conv_gru(gp, h, ctx, *xs)
+
     net = list(net)
     n = cfg.n_gru_layers
     if iter32:
-        net[2] = apply_conv_gru(p["gru32"], net[2], inp[2], pool2x(net[1]))
+        net[2] = gru(2, net[2], inp[2], pool2x(net[1]))
     if iter16:
         if n > 2:
-            net[1] = apply_conv_gru(p["gru16"], net[1], inp[1], pool2x(net[0]),
-                                    interp_align_corners(net[2], net[1].shape[1:3]))
+            net[1] = gru(1, net[1], inp[1], pool2x(net[0]),
+                         interp_align_corners(net[2], net[1].shape[1:3]))
         else:
-            net[1] = apply_conv_gru(p["gru16"], net[1], inp[1], pool2x(net[0]))
+            net[1] = gru(1, net[1], inp[1], pool2x(net[0]))
+    delta_x = None
     if iter08:
-        motion_parts = apply_motion_encoder(p["encoder"], flow, corr)
-        if n > 1:
-            net[0] = apply_conv_gru(p["gru08"], net[0], inp[0], *motion_parts,
-                                    interp_align_corners(net[1], net[0].shape[1:3]))
+        if fc[0] is not None and motion_is_fusable(corr):
+            motion = fused_motion(p["encoder"], flow, corr)
         else:
-            net[0] = apply_conv_gru(p["gru08"], net[0], inp[0], *motion_parts)
+            motion = apply_motion_encoder(p["encoder"], flow, corr)
+        xs = (motion, interp_align_corners(net[1], net[0].shape[1:3])) \
+            if n > 1 else (motion,)
+        if (update and not compute_mask and fc[0] is not None
+                and gru_is_fusable(net[0], *xs)):
+            net[0], delta_x = fused_gru_head(
+                p["gru08"], p["flow_head"], net[0], fc[0], inp[0], *xs)
+        else:
+            net[0] = gru(0, net[0], inp[0], *xs)
     net = tuple(net)
     if not update:
         return net
+
+    if delta_x is not None:
+        # Kernel emits the x-delta without conv2's bias; adding b[0] here
+        # keeps its gradient path. The y-delta is identically zero after
+        # the epipolar projection (raft_stereo.py:120), so it is never
+        # computed.
+        delta_x = delta_x + p["flow_head"]["conv2"]["b"][0]
+        delta_flow = jnp.concatenate(
+            [delta_x, jnp.zeros_like(delta_x)], axis=-1)
+        return net, None, delta_flow
 
     delta_flow = apply_flow_head(p["flow_head"], net[0])
     mask = apply_mask_head(p, net[0]) if compute_mask else None

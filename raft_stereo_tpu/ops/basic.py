@@ -111,10 +111,24 @@ def instance_norm(x: jax.Array, *, eps: float = 1e-5) -> jax.Array:
     full-resolution fp32 copy (3 GB at Middlebury-F in the fnet stem) plus
     layout copies either side; the fp32 converts here fuse into the
     reductions instead. Identical arithmetic when x is fp32.
+
+    Under bf16 compute the variance uses the one-pass ``E[x^2]-E[x]^2``
+    form: both sums come out of a single multi-output reduction fusion, so
+    the activation is read twice (stats + normalize) instead of three
+    times — at full-res encoder shapes the extra pass costs more than the
+    catastrophic-cancellation risk, which fp32 accumulation over bf16
+    inputs keeps benign (values are O(1) post-norm-pre-norm). The fp32
+    path keeps the exact two-pass form for reference parity.
     """
-    mean = jnp.mean(x, axis=(1, 2), keepdims=True, dtype=jnp.float32)
-    var = jnp.mean(jnp.square(x.astype(jnp.float32) - mean), axis=(1, 2),
-                   keepdims=True)
+    if x.dtype == jnp.bfloat16:
+        mean = jnp.mean(x, axis=(1, 2), keepdims=True, dtype=jnp.float32)
+        sq = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=(1, 2),
+                      keepdims=True)
+        var = jnp.maximum(sq - jnp.square(mean), 0.0)
+    else:
+        mean = jnp.mean(x, axis=(1, 2), keepdims=True, dtype=jnp.float32)
+        var = jnp.mean(jnp.square(x.astype(jnp.float32) - mean), axis=(1, 2),
+                       keepdims=True)
     inv = lax.rsqrt(var + eps)
     return ((x - mean.astype(x.dtype)) * inv.astype(x.dtype)).astype(x.dtype)
 

@@ -1,0 +1,582 @@
+"""Streaming fused conv-chain Pallas kernels for the GRU scan body.
+
+Why these exist: the per-iteration update at Middlebury-F (1/4-res ≈
+504x744x128) is HBM-bandwidth-bound under XLA — profiling shows every gate
+conv materializing fp32 partials, the zr tensor, r*h, and the state update
+as separate full-tensor HBM round trips (~9 ms/iter for gru08 against a
+~5 ms MXU roofline; `core/raft_stereo.py:108-136` is the reference hot
+loop, its CUDA analog keeps this chain in torch ops). These kernels keep
+every intermediate in VMEM, and chain kernel-to-kernel in row-major
+layout (the corr lookup kernel's native output layout) so the scan body
+never pays an XLA conv-layout round trip.
+
+Streaming design (the TPU-native replacement for GPU-style halo tiles):
+a 1D grid walks row-blocks of TH rows top-to-bottom. Convolution halos
+are carried across grid steps in VMEM scratch ring-windows — each
+intermediate row is computed EXACTLY once (no overlapped-tile recompute)
+and consumed as soon as its dependents' rows arrive. A chain of k 3x3
+convs delays the output by k rows, so kernels write out rows
+``[i*TH - lag, (i+1)*TH - lag)`` as block i of a lag-shifted output
+array; the caller slices ``out[lag:lag+H]``. Extra flush steps at the
+end drain the pipeline (input index maps clamp with ``jnp.minimum``;
+flushed input blocks are replaced with zeros so bottom conv padding is
+exact). Top/bottom zero conv padding falls out of zero-initialized rings
+and the zeroed flush blocks.
+
+All arithmetic accumulates in fp32 (dots with preferred_element_type)
+and downcasts once at each nonlinearity — numerically tighter than the
+XLA path it replaces. Weights ride whole-array blocks with constant
+index maps, so the pipeline fetches them once.
+
+Kernels:
+- ``fused_conv_gru``: the ConvGRU step (reference ``core/update.py:16-32``)
+  — optionally chaining the FlowHead (``core/update.py:6-14``) onto the
+  new hidden state at +2 rows of lag, emitting the x-delta map directly
+  (the y-delta is zeroed by the epipolar projection, ``raft_stereo.py:120``,
+  so only channel 0 is computed).
+- ``fused_motion``: BasicMotionEncoder (``core/update.py:64-85``),
+  consuming the lookup kernel's output and an XLA-built 7x7 patches
+  tensor of the flow, emitting the 128-ch motion feature
+  (126 fused + 2 raw flow).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_VMEM_LIMIT = 100 * 2**20  # v5e has 128M physical; default scoped cap is 16M
+
+
+def _interpret() -> bool:
+    return jax.default_backend() not in ("tpu",)
+
+
+def pick_th(hh: int, width: int = 744) -> int:
+    """Largest supported row-block evenly dividing H (0 = not supported).
+
+    Bigger blocks amortize per-step DMA/loop overhead; the cap keeps the
+    VMEM block buffers near what an 8x744 (Middlebury-F 1/4-res) block
+    uses, which measures fastest on v5e."""
+    for th in (24, 18, 16, 12, 8, 6, 4, 2):
+        if hh % th == 0 and th * width <= 8192:
+            return th
+    return 0
+
+
+def _dot(x, w):
+    """(R, W, Cin) x (Cin, Cout) -> (R, W, Cout), fp32+ accumulation."""
+    acc = jnp.promote_types(x.dtype, jnp.float32)
+    return jax.lax.dot_general(
+        x, w, (((2,), (0,)), ((), ())), preferred_element_type=acc)
+
+
+def _conv_rows(scr, w, rows, width, acc=None):
+    """3x3 conv over a scratch window: out row j reads scr rows j+dy.
+
+    scr: (>= rows+2, width+2, C) window whose row 0 holds the first output
+    row's top tap; w: (3, 3, Cin, Cout). Returns fp32 (rows, width, Cout).
+    """
+    for dy in range(3):
+        x = scr[dy:dy + rows]
+        for dx in range(3):
+            y = _dot(x[:, dx:dx + width], w[dy, dx])
+            acc = y if acc is None else acc + y
+    return acc
+
+
+def _zeros(ref, sl=slice(None)):
+    ref[sl] = jnp.zeros(ref[sl].shape, ref.dtype)
+
+
+def _row_mask(i, offset: int, th: int, hh: int, x):
+    """Zero rows whose global index i*TH+offset+j falls outside [0, H).
+
+    Needed for chained intermediates of the form relu(conv+bias): at
+    out-of-range rows they are NOT zero (the bias passes the relu), but
+    the downstream conv's zero padding requires them to be."""
+    g = i * th + offset + jax.lax.broadcasted_iota(jnp.int32, (th, 1, 1), 0)
+    return jnp.where((g >= 0) & (g < hh), x, jnp.zeros_like(x))
+
+
+def _shift(ref, keep):
+    """Move the window's last ``keep`` rows to the top (value-copy, safe
+    for overlapping ranges)."""
+    th = ref.shape[0] - keep
+    tail = ref[th:th + keep]
+    ref[0:keep] = tail
+
+
+# ---------------------------------------------------------------------------
+# Fused ConvGRU (+ optional FlowHead)
+# ---------------------------------------------------------------------------
+
+
+def _gru_kernel(h_ref, czrq_ref, *rest, np_: int, th: int, nb: int,
+                width: int, ch: int, head: bool, hh: int, coffs):
+    part_refs = rest[:np_]
+    k = np_
+    whzr_ref, whq_ref, wx_ref = rest[k:k + 3]
+    k += 3
+    if head:
+        w1_ref, b1_ref, w2_ref, out_ref, dx_ref = rest[k:k + 5]
+        k += 5
+    else:
+        out_ref = rest[k]
+        k += 1
+    scr_h, scr_rh, scr_z, scr_aqx, scr_x = rest[k:k + 5]
+    k += 5
+    if head:
+        scr_hn, scr_f1 = rest[k:k + 2]
+
+    i = pl.program_id(0)
+    dtype = h_ref.dtype
+
+    @pl.when(i == 0)
+    def _init():
+        scrs = [scr_h, scr_rh, scr_z, scr_aqx, scr_x]
+        if head:
+            scrs += [scr_hn, scr_f1]
+        for s in scrs:
+            _zeros(s)
+
+    # Land the new input block (zeros on flush steps: exact bottom pad).
+    # The x parts land in channel slices of ONE scratch so the gate x-conv
+    # runs as K=sum(parts) dots (better MXU K-utilization than per-part
+    # K=128 passes).
+    _shift(scr_h, 3)
+    _shift(scr_x, 2)
+
+    @pl.when(i < nb)
+    def _place():
+        scr_h[3:3 + th, 1:width + 1] = h_ref[...]
+        for p, c0, c1 in zip(part_refs, coffs[:-1], coffs[1:]):
+            scr_x[2:2 + th, 1:width + 1, c0:c1] = p[...]
+
+    @pl.when(i >= nb)
+    def _flush():
+        _zeros(scr_h, slice(3, 3 + th))
+        _zeros(scr_x, slice(2, 2 + th))
+
+    # ---- preact rows [i*TH-1, (i+1)*TH-1): all-gate x-side conv, z/r
+    # h-side conv, nonlinearities (czrq arrives pre-shifted to these rows).
+    acc_x = _conv_rows(scr_x, wx_ref, th, width)
+    acc_x = acc_x + czrq_ref[...].astype(jnp.float32)
+    acc_h = _conv_rows(scr_h[1:], whzr_ref, th, width)
+
+    z_new = jax.nn.sigmoid(acc_h[..., :ch] + acc_x[..., :ch]).astype(dtype)
+    r_new = jax.nn.sigmoid(acc_h[..., ch:] + acc_x[..., ch:2 * ch]).astype(dtype)
+    rh_new = r_new * scr_h[2:2 + th, 1:width + 1]
+
+    _shift(scr_rh, 3)
+    scr_rh[3:3 + th, 1:width + 1] = rh_new
+    _shift(scr_z, 2)
+    scr_z[2:2 + th] = z_new
+    _shift(scr_aqx, 2)
+    scr_aqx[2:2 + th] = acc_x[..., 2 * ch:]
+
+    # ---- h' rows [i*TH-3, (i+1)*TH-3): q gate + state update.
+    acc_q = _conv_rows(scr_rh, whq_ref, th, width, None) + scr_aqx[0:th]
+    q = jnp.tanh(acc_q).astype(dtype)
+    z = scr_z[0:th]
+    h_new = (1 - z) * scr_h[0:th, 1:width + 1] + z * q
+    out_ref[...] = h_new
+
+    if head:
+        # ---- FlowHead chained on h': conv1+relu rows [i*TH-4, ...),
+        # delta-x rows [i*TH-5, (i+1)*TH-5). h' and f1 rows outside [0, H)
+        # are masked to zero — they stand in for conv zero padding.
+        _shift(scr_hn, 2)
+        scr_hn[2:2 + th, 1:width + 1] = _row_mask(i, -3, th, hh, h_new)
+        f1 = jax.nn.relu(_conv_rows(scr_hn, w1_ref, th, width)
+                         + b1_ref[...].astype(jnp.float32))
+        _shift(scr_f1, 2)
+        scr_f1[2:2 + th, 1:width + 1] = _row_mask(i, -4, th, hh,
+                                                  f1.astype(dtype))
+        dx = _conv_rows(scr_f1, w2_ref, th, width)
+        dx_ref[...] = dx[..., 0].astype(dx_ref.dtype)
+
+
+def _gru_pallas(h, parts, czrq, whzr, whq, wx_full, th: int, head):
+    b, hh, width, ch = h.shape
+    assert b == 1, "streaming kernel is per-sample (B folded by caller)"
+    nb = hh // th
+    lag = 5 if head else 3
+    grid = pl.cdiv(hh + lag, th)
+    h3 = h[0]
+    parts3 = [p[0] for p in parts]
+    np_ = len(parts3)
+    # czrq arrives pre-shifted/pre-padded from prepare_gru_context (hoisted
+    # out of the scan — padding it here would re-run a 300 MB pass per
+    # iteration).
+    czrq3 = czrq[0]
+    assert czrq3.shape[0] >= grid * th, (czrq3.shape, grid, th)
+
+    def idx_in(i):
+        return (jnp.minimum(i, nb - 1), 0, 0)
+
+    coffs = [0]
+    for p in parts3:
+        coffs.append(coffs[-1] + p.shape[-1])
+    kernel = functools.partial(_gru_kernel, np_=np_, th=th, nb=nb,
+                               width=width, ch=ch, head=head is not None,
+                               hh=hh, coffs=tuple(coffs))
+    in_specs = (
+        [pl.BlockSpec((th, width, ch), idx_in, memory_space=pltpu.VMEM),
+         pl.BlockSpec((th, width, 3 * ch), lambda i: (i, 0, 0),
+                      memory_space=pltpu.VMEM)] +
+        [pl.BlockSpec((th, width, p.shape[-1]), idx_in,
+                      memory_space=pltpu.VMEM) for p in parts3] +
+        [pl.BlockSpec(w.shape, lambda i, nd=w.ndim: (0,) * nd,
+                      memory_space=pltpu.VMEM)
+         for w in [whzr, whq, wx_full]])
+    out_specs = [pl.BlockSpec((th, width, ch), lambda i: (i, 0, 0),
+                              memory_space=pltpu.VMEM)]
+    out_shape = [jax.ShapeDtypeStruct((grid * th, width, ch), h.dtype)]
+    scratch = [pltpu.VMEM((th + 3, width + 2, ch), h.dtype),     # h window
+               pltpu.VMEM((th + 3, width + 2, ch), h.dtype),     # r*h window
+               pltpu.VMEM((th + 2, width, ch), h.dtype),         # z ring
+               pltpu.VMEM((th + 2, width, ch), jnp.float32),     # aq_x ring
+               pltpu.VMEM((th + 2, width + 2, coffs[-1]), h.dtype)]  # x parts
+    inputs = [h3, czrq3, *parts3, whzr, whq, wx_full]
+    if head is not None:
+        w1, b1, w2 = head
+        in_specs += [pl.BlockSpec(w1.shape, lambda i: (0,) * 4,
+                                  memory_space=pltpu.VMEM),
+                     pl.BlockSpec(b1.shape, lambda i: (0, 0),
+                                  memory_space=pltpu.VMEM),
+                     pl.BlockSpec(w2.shape, lambda i: (0,) * 4,
+                                  memory_space=pltpu.VMEM)]
+        out_specs.append(pl.BlockSpec((th, width), lambda i: (i, 0),
+                                      memory_space=pltpu.VMEM))
+        out_shape.append(
+            jax.ShapeDtypeStruct((grid * th, width), jnp.float32))
+        scratch += [pltpu.VMEM((th + 2, width + 2, ch), h.dtype),  # h' window
+                    pltpu.VMEM((th + 2, width + 2, w1.shape[-1]), h.dtype)]
+        inputs += [w1, b1, w2]
+
+    outs = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=in_specs,
+        out_specs=tuple(out_specs) if head is not None else out_specs[0],
+        out_shape=tuple(out_shape) if head is not None else out_shape[0],
+        scratch_shapes=scratch,
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
+        interpret=_interpret(),
+    )(*inputs)
+    if head is None:
+        return outs[3:3 + hh][None], None
+    # h' streams at lag 3; the chained FlowHead delta trails 2 convs behind.
+    h_out, dx_out = outs
+    return h_out[3:3 + hh][None], dx_out[5:5 + hh][None, ..., None]
+
+
+def gru_weights(p: dict, ch: int):
+    """Pack reference per-gate convs into kernel layout: h-side (z,r) and
+    one x-side weight with all three gates' output channels concatenated
+    (input channels ordered like the callers' x parts)."""
+    wz, wr, wq = p["convz"]["w"], p["convr"]["w"], p["convq"]["w"]
+    whzr = jnp.concatenate([wz[:, :, :ch], wr[:, :, :ch]], axis=-1)
+    whq = wq[:, :, :ch]
+    wx_full = jnp.concatenate([wz[:, :, ch:], wr[:, :, ch:], wq[:, :, ch:]],
+                              axis=-1)
+    return whzr, whq, wx_full
+
+
+def prepare_gru_context(p: dict, context, dtype):
+    """Fold the gate conv biases into the (loop-invariant) context tensor,
+    shift it down one row (so kernel block i covers the preact rows
+    [i*TH-1, (i+1)*TH-1) with an identity index map) and zero-pad through
+    the flush steps. One pass per frame instead of per iteration — hoist
+    outside the scan."""
+    bias = jnp.concatenate([p["convz"]["b"], p["convr"]["b"], p["convq"]["b"]])
+    czrq = jnp.concatenate(list(context), axis=-1).astype(jnp.float32)
+    czrq = (czrq + bias).astype(dtype)
+    hh, width = czrq.shape[1:3]
+    th = pick_th(hh, width)
+    if th == 0:
+        return czrq
+    rows = pl.cdiv(hh + 5, th) * th  # widest lag (head variant) = 5
+    return jnp.pad(czrq, ((0, 0), (1, rows - hh - 1), (0, 0), (0, 0)))
+
+
+def fused_conv_gru_fwd_impl(p: dict, h, czrq, *x_list, head_p=None):
+    """Kernel forward. czrq from ``prepare_gru_context``; x parts separate.
+    head_p: optional FlowHead params {conv1, conv2} chained onto h'."""
+    ch = h.shape[-1]
+    whzr, whq, wx_full = gru_weights(p, ch)
+    dtype = h.dtype
+    whzr, whq = whzr.astype(dtype), whq.astype(dtype)
+    wx_full = wx_full.astype(dtype)
+    head = None
+    if head_p is not None:
+        # conv2's bias and y-channel drop out: only delta-x is emitted and
+        # conv2.b[0] is added by the caller (scalar, fused into the coords
+        # update).
+        head = (head_p["conv1"]["w"].astype(dtype),
+                head_p["conv1"]["b"].reshape(1, -1),
+                head_p["conv2"]["w"][..., :1].astype(dtype))
+    th = pick_th(h.shape[1], h.shape[2])
+    return _gru_pallas(h, x_list, czrq, whzr, whq, wx_full, th, head)
+
+
+@jax.custom_vjp
+def fused_conv_gru(p: dict, h, czrq, context, *x_list):
+    """ConvGRU step via the streaming Pallas kernel.
+
+    Gradients run through the XLA formulation (``apply_conv_gru``) — the
+    same arithmetic modulo bf16 rounding points; the reference's own
+    mixed-precision autocast tolerates larger fwd/bwd dtype asymmetry.
+    ``context`` rides along unused in the forward so the VJP can rebuild
+    the XLA computation (czrq is derived from it, so its cotangent is zero
+    — no double counting).
+    """
+    out, _ = fused_conv_gru_fwd_impl(p, h, czrq, *x_list)
+    return out
+
+
+def _gru_oracle(p: dict, h, context, *x_list):
+    from raft_stereo_tpu.models.update import apply_conv_gru
+    return apply_conv_gru(p, h, context, *x_list)
+
+
+def _fused_gru_fwd(p, h, czrq, context, *x_list):
+    return (fused_conv_gru(p, h, czrq, context, *x_list),
+            (p, h, czrq, context, x_list))
+
+
+def _fused_gru_bwd(res, g):
+    p, h, czrq, context, x_list = res
+    out, vjp = jax.vjp(lambda *a: _gru_oracle(a[0], a[1], a[2], *a[3:]),
+                       p, h, context, *x_list)
+    dp, dh, dctx, *dxs = vjp(g.astype(out.dtype))
+    return (dp, dh, jnp.zeros_like(czrq), dctx, *dxs)
+
+
+fused_conv_gru.defvjp(_fused_gru_fwd, _fused_gru_bwd)
+
+
+@jax.custom_vjp
+def fused_gru_head(p: dict, head_p: dict, h, czrq, context, *x_list):
+    """ConvGRU + FlowHead in one streaming kernel (test-mode scan body).
+
+    Returns ``(h', delta_x)`` with delta_x fp32 (1, H, W, 1) EXCLUDING the
+    final conv bias — the caller adds the scalar ``conv2.b[0]`` (so its
+    gradient flows through that add, matching the oracle below which also
+    omits it)."""
+    return fused_conv_gru_fwd_impl(p, h, czrq, *x_list, head_p=head_p)
+
+
+def _gru_head_oracle(p, head_p, h, context, *x_list):
+    from raft_stereo_tpu.models.update import apply_conv_gru
+    from raft_stereo_tpu.models.layers import apply_conv
+    from raft_stereo_tpu.ops.basic import conv2d
+    h2 = apply_conv_gru(p, h, context, *x_list)
+    f1 = jax.nn.relu(apply_conv(head_p["conv1"], h2, padding=1))
+    dx = conv2d(f1, head_p["conv2"]["w"][..., :1], None, padding=1,
+                out_dtype=jnp.float32)
+    return h2, dx
+
+
+def _fused_gru_head_fwd(p, head_p, h, czrq, context, *x_list):
+    return (fused_gru_head(p, head_p, h, czrq, context, *x_list),
+            (p, head_p, h, czrq, context, x_list))
+
+
+def _fused_gru_head_bwd(res, g):
+    p, head_p, h, czrq, context, x_list = res
+    (h2, _), vjp = jax.vjp(
+        lambda *a: _gru_head_oracle(a[0], a[1], a[2], a[3], *a[4:]),
+        p, head_p, h, context, *x_list)
+    gh, gdx = g
+    dp, dhead, dh, dctx, *dxs = vjp((gh.astype(h2.dtype),
+                                     gdx.astype(jnp.float32)))
+    return (dp, dhead, dh, jnp.zeros_like(czrq), dctx, *dxs)
+
+
+fused_gru_head.defvjp(_fused_gru_head_fwd, _fused_gru_head_bwd)
+
+
+def gru_is_fusable(h, *x_list) -> bool:
+    """Shapes/dtype the streaming kernel supports; callers fall back to the
+    XLA path otherwise (fp32 runs exceed the VMEM budget at full res; B>1
+    would turn the batch into an outer Pallas grid dim and break the
+    ``program_id(0)`` streaming logic, so training batches stay on XLA)."""
+    return (h.dtype == jnp.bfloat16 and h.shape[0] == 1
+            and pick_th(h.shape[1], h.shape[2]) > 0 and h.shape[1] >= 8)
+
+
+# ---------------------------------------------------------------------------
+# Fused motion encoder (reference BasicMotionEncoder, core/update.py:64-85).
+# The 7x7 flow conv is re-expressed as an XLA-built patches tensor (49 taps
+# x 2 channels) consumed by a POINTWISE dot in the kernel — a 7x7 conv over
+# 2 channels is pathological for both XLA conv layouts and in-kernel
+# lane-packing, but its im2col is one cheap shifted-copy fusion. Both
+# branches then stream with the same lag structure: stage-1 pointwise
+# (c1 from corr, f1 from patches), stage-2 3x3 (c2, f2), fusion conv over
+# [c2 ; f2] at lag 2, with the raw 2-ch flow (the patch center taps)
+# riding along as output channels 126:128 (update.py:85).
+# ---------------------------------------------------------------------------
+
+
+def _motion_kernel(corr_ref, pat_ref, flow_ref, w1_ref, b1_ref, w2_ref,
+                   b2_ref, wf_ref, bf_ref, out_ref, scr_s1, scr_s2, scr_fl,
+                   *, th: int, nb: int, width: int, cfused: int, hh: int,
+                   ncorr: int):
+    i = pl.program_id(0)
+    dtype = corr_ref.dtype
+
+    @pl.when(i == 0)
+    def _init():
+        for s in (scr_s1, scr_s2, scr_fl):
+            _zeros(s)
+
+    for s in (scr_s1, scr_s2):
+        _shift(s, 2)
+    _shift(scr_fl, 2)
+
+    # Stage 1 (pointwise, rows [i*TH, (i+1)*TH)): ONE block-diagonal dot
+    # computes both branches — [c1 | f1] = relu([corr | patches] @
+    # blockdiag(wc1, wf1) + [bc1 | bf1]). The two inputs stay separate
+    # refs; their dots accumulate into one fp32 buffer.
+    acc1 = _dot(corr_ref[...], w1_ref[0:ncorr])
+    acc1 = acc1 + _dot(pat_ref[...], w1_ref[ncorr:])
+    s1v = jax.nn.relu(acc1 + b1_ref[...].astype(jnp.float32)).astype(dtype)
+
+    @pl.when(i < nb)
+    def _place():
+        scr_s1[2:2 + th, 1:width + 1] = s1v
+        scr_fl[2:2 + th] = flow_ref[...]
+
+    @pl.when(i >= nb)
+    def _flush():
+        _zeros(scr_s1, slice(2, 2 + th))
+        _zeros(scr_fl, slice(2, 2 + th))
+
+    # Stage 2 (3x3, rows [i*TH-1, (i+1)*TH-1)): one block-diagonal conv
+    # computes [c2 | f2]; out-of-range rows masked to zero (they stand in
+    # for the fusion conv's padding; relu(bias) is not zero there).
+    s2 = jax.nn.relu(_conv_rows(scr_s1, w2_ref, th, width)
+                     + b2_ref[...].astype(jnp.float32)).astype(dtype)
+    scr_s2[2:2 + th, 1:width + 1] = _row_mask(i, -1, th, hh, s2)
+
+    # Fusion rows [i*TH-2, (i+1)*TH-2): the reference's fusion conv reads
+    # [c2 ; f2] exactly in this channel order (update.py:85), so its
+    # weight is used verbatim; the raw 2-ch flow rides along as output
+    # channels 126:128.
+    acc = _conv_rows(scr_s2, wf_ref, th, width)
+    fused = jax.nn.relu(acc + bf_ref[...].astype(jnp.float32)).astype(dtype)
+    out_ref[:, :, :cfused] = fused
+    out_ref[:, :, cfused:] = scr_fl[0:th]
+
+
+def flow_patches(flow, dtype):
+    """(1, H, W, 2) flow -> (1, H, W, 98) 7x7 zero-padded patches.
+
+    Channel order is feature-major — patch channel c*49 + dy*7 + dx — per
+    ``lax.conv_general_dilated_patches``; the kernel's f1 weight matrix is
+    reshaped to match."""
+    return jax.lax.conv_general_dilated_patches(
+        flow.astype(dtype), (7, 7), (1, 1), [(3, 3), (3, 3)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def _blockdiag3x3(wa, wb):
+    """(3,3,Ka,Na), (3,3,Kb,Nb) -> (3,3,Ka+Kb,Na+Nb) block-diagonal."""
+    ka, na = wa.shape[2:]
+    kb, nb_ = wb.shape[2:]
+    top = jnp.concatenate([wa, jnp.zeros((3, 3, ka, nb_), wa.dtype)], axis=3)
+    bot = jnp.concatenate([jnp.zeros((3, 3, kb, na), wb.dtype), wb], axis=3)
+    return jnp.concatenate([top, bot], axis=2)
+
+
+def fused_motion_fwd_impl(p: dict, flow, corr):
+    b, hh, width, ccorr = corr.shape
+    assert b == 1
+    dtype = corr.dtype
+    th = pick_th(hh, width)
+    nb = hh // th
+    lag = 2
+    grid = pl.cdiv(hh + lag, th)
+    n1 = p["convc1"]["w"].shape[-1]
+    # Stage-1 weight: rows 0:ccorr act on corr (convc1 1x1), the rest on
+    # the flow patches (convf1 reshaped feature-major); columns are
+    # [c1 | f1]. Stage-2: block-diagonal (convc2, convf2).
+    wc1 = p["convc1"]["w"].reshape(p["convc1"]["w"].shape[2:])
+    wf1 = p["convf1"]["w"].transpose(2, 0, 1, 3).reshape(-1, n1)
+    z12 = jnp.zeros((ccorr, n1), wc1.dtype)
+    z21 = jnp.zeros((wf1.shape[0], n1), wc1.dtype)
+    w1 = jnp.concatenate(
+        [jnp.concatenate([wc1, z12], axis=1),
+         jnp.concatenate([z21, wf1], axis=1)], axis=0).astype(dtype)
+    b1 = jnp.concatenate([p["convc1"]["b"], p["convf1"]["b"]]).reshape(1, -1)
+    w2 = _blockdiag3x3(p["convc2"]["w"], p["convf2"]["w"]).astype(dtype)
+    b2 = jnp.concatenate([p["convc2"]["b"], p["convf2"]["b"]]).reshape(1, -1)
+    wf = p["conv"]["w"].astype(dtype)  # verbatim: input order [c2 ; f2]
+    bf = p["conv"]["b"].reshape(1, -1)
+    cfused = wf.shape[-1]
+    pat = flow_patches(flow, dtype)[0]
+    npat = pat.shape[-1]
+    ns1 = 2 * n1
+
+    def idx_in(i):
+        return (jnp.minimum(i, nb - 1), 0, 0)
+
+    kernel = functools.partial(_motion_kernel, th=th, nb=nb, width=width,
+                               cfused=cfused, hh=hh, ncorr=ccorr)
+    weights = (w1, b1, w2, b2, wf, bf)
+    out = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[pl.BlockSpec((th, width, ccorr), idx_in,
+                               memory_space=pltpu.VMEM),
+                  pl.BlockSpec((th, width, npat), idx_in,
+                               memory_space=pltpu.VMEM),
+                  pl.BlockSpec((th, width, flow.shape[-1]), idx_in,
+                               memory_space=pltpu.VMEM)] +
+                 [pl.BlockSpec(w.shape, lambda i, nd=w.ndim: (0,) * nd,
+                               memory_space=pltpu.VMEM)
+                  for w in weights],
+        out_specs=pl.BlockSpec((th, width, cfused + 2),
+                               lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((grid * th, width, cfused + 2), dtype),
+        scratch_shapes=[
+            pltpu.VMEM((th + 2, width + 2, ns1), dtype),
+            pltpu.VMEM((th + 2, width + 2, ns1), dtype),
+            pltpu.VMEM((th + 2, width, flow.shape[-1]), dtype)],
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
+        interpret=_interpret(),
+    )(corr[0], pat, flow.astype(dtype)[0], *weights)
+    return out[lag:lag + hh][None]
+
+
+def motion_is_fusable(corr) -> bool:
+    return (corr.dtype == jnp.bfloat16 and corr.shape[0] == 1
+            and pick_th(corr.shape[1], corr.shape[2]) > 0 and corr.shape[1] >= 8)
+
+
+@jax.custom_vjp
+def fused_motion(p: dict, flow, corr):
+    """BasicMotionEncoder with both branches streamed in Pallas; backward
+    via the XLA oracle (``apply_motion_encoder``)."""
+    return fused_motion_fwd_impl(p, flow, corr)
+
+
+def _fused_motion_fwd(p, flow, corr):
+    return fused_motion(p, flow, corr), (p, flow, corr)
+
+
+def _fused_motion_bwd(res, g):
+    p, flow, corr = res
+    from raft_stereo_tpu.models.update import apply_motion_encoder
+    out, vjp = jax.vjp(apply_motion_encoder, p, flow, corr)
+    return vjp(g.astype(out.dtype))
+
+
+fused_motion.defvjp(_fused_motion_fwd, _fused_motion_bwd)

@@ -1,0 +1,70 @@
+import sys; sys.path.insert(0, "/root/repo")
+import os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax, jax.numpy as jnp
+import numpy as np
+from raft_stereo_tpu.models.update import (
+    init_conv_gru, apply_conv_gru, init_motion_encoder, apply_motion_encoder,
+    init_flow_head, apply_flow_head)
+from raft_stereo_tpu.ops.pallas_stream import (
+    fused_conv_gru_fwd_impl, prepare_gru_context, fused_motion_fwd_impl)
+from raft_stereo_tpu.config import RAFTStereoConfig
+
+key = jax.random.PRNGKey(0)
+for (H, W, ch, parts_c, dtype) in [
+        (16, 24, 128, (128, 128), jnp.float32),
+        (8, 13, 64, (64,), jnp.float32),
+        (24, 9, 32, (32, 32), jnp.float32),
+        (16, 24, 128, (128, 128), jnp.bfloat16),
+]:
+    cin = sum(parts_c)
+    p = init_conv_gru(key, ch, cin)
+    hp = init_flow_head(jax.random.PRNGKey(9), ch, 64, 2)
+    ks = jax.random.split(key, 8)
+    h = jax.random.normal(ks[0], (1, H, W, ch), dtype) * 0.5
+    xs = [jax.random.normal(k, (1, H, W, c), dtype)
+          for k, c in zip(ks[1:1 + len(parts_c)], parts_c)]
+    ctx = tuple(jax.random.normal(k, (1, H, W, ch), dtype) * 0.3
+                for k in ks[5:8])
+    czrq = prepare_gru_context(p, ctx, dtype)
+    ref = apply_conv_gru(p, h, ctx, *xs)
+    got, _ = fused_conv_gru_fwd_impl(p, h, czrq, *xs)
+    err = float(jnp.max(jnp.abs(got.astype(jnp.float32) - ref.astype(jnp.float32))))
+    tol = 1e-5 if dtype == jnp.float32 else 3e-2
+    # with flow head chained (delta compared against the head applied to
+    # the KERNEL's h', isolating the head from gru rounding amplification)
+    got2, dx = fused_conv_gru_fwd_impl(p, h, czrq, *xs, head_p=hp)
+    dref = apply_flow_head(hp, got2)[..., :1] - hp["conv2"]["b"][0]
+    err2 = float(jnp.max(jnp.abs(got2.astype(jnp.float32) - ref.astype(jnp.float32))))
+    err3 = float(jnp.max(jnp.abs(dx - dref.astype(jnp.float32))))
+    print(f"H={H} W={W} ch={ch} parts={parts_c} {dtype.__name__}: "
+          f"gru={err:.2e} gru+head={err2:.2e} dx={err3:.2e}")
+    assert err < tol and err2 < tol and err3 < 3 * tol, "MISMATCH"
+
+# motion encoder — integer-valued inputs are EXACT in fp32, so any tap /
+# shift / boundary-mask bug shows as an integer-sized error while pure
+# reassociation shows as 0. Float inputs then only check the rounding
+# amplification envelope (seeds ~1e-6 growing through two more 576/1152-
+# term conv stages).
+cfg = RAFTStereoConfig()
+rng = np.random.default_rng(0)
+pm = init_motion_encoder(key, cfg)
+pmi = jax.tree.map(lambda t: jnp.asarray(rng.integers(-2, 3, t.shape),
+                                         jnp.float32), pm)
+corr_i = jnp.asarray(rng.integers(-3, 4, (1, 16, 24, cfg.cor_planes)),
+                     jnp.float32)
+flow_i = jnp.asarray(rng.integers(-3, 4, (1, 16, 24, 2)), jnp.float32)
+ref = apply_motion_encoder(pmi, flow_i, corr_i)
+got = fused_motion_fwd_impl(pmi, flow_i, corr_i)
+err = float(jnp.max(jnp.abs(got - ref)))
+print(f"motion integer-exact: max|d|={err}")
+assert err == 0.0, "MISMATCH"
+for (H, W, dtype) in [(16, 24, jnp.float32), (16, 24, jnp.bfloat16)]:
+    corr = jax.random.normal(key, (1, H, W, cfg.cor_planes), dtype)
+    flow = jax.random.normal(key, (1, H, W, 2), dtype)
+    ref = apply_motion_encoder(pm, flow, corr)
+    got = fused_motion_fwd_impl(pm, flow, corr)
+    err = float(jnp.max(jnp.abs(got.astype(jnp.float32) - ref.astype(jnp.float32))))
+    print(f"motion H={H} W={W} {dtype.__name__}: max|d|={err:.2e}")
+    assert err < 5e-2, "MISMATCH"
+print("OK")

@@ -1,0 +1,59 @@
+"""Probe Pallas TPU behaviors needed for streaming conv kernels:
+1. index_map with jnp.minimum / python arithmetic
+2. grid longer than the array's block count (flush step) — does the OOB
+   input block index clamp?
+3. scratch persistence across grid steps (ring buffers)
+"""
+import sys; sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+TH, W, NB = 8, 128, 4
+H = TH * NB
+
+
+def kernel(x_ref, o_ref, ring):
+    i = pl.program_id(0)
+    # write out block i = ring (previous step's input); step 0 writes zeros
+    @pl.when(i == 0)
+    def _():
+        ring[:] = jnp.zeros_like(ring)
+    o_ref[:] = ring[:]
+    ring[:] = x_ref[:]
+
+
+x = jnp.arange(H * W, dtype=jnp.float32).reshape(H, W)
+
+out = pl.pallas_call(
+    kernel,
+    grid=(NB + 1,),
+    in_specs=[pl.BlockSpec((TH, W), lambda i: (jnp.minimum(i, NB - 1), 0),
+                           memory_space=pltpu.VMEM)],
+    out_specs=pl.BlockSpec((TH, W), lambda i: (i, 0),
+                           memory_space=pltpu.VMEM),
+    out_shape=jax.ShapeDtypeStruct((H + TH, W), jnp.float32),
+    scratch_shapes=[pltpu.VMEM((TH, W), jnp.float32)],
+)(x)
+out = np.asarray(out)
+print("jnp.minimum index_map ok; lag-write correct:",
+      np.array_equal(out[TH:], np.asarray(x)))
+
+# 2: OOB index without clamping
+try:
+    out2 = pl.pallas_call(
+        kernel,
+        grid=(NB + 1,),
+        in_specs=[pl.BlockSpec((TH, W), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((TH, W), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((H + TH, W), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((TH, W), jnp.float32)],
+    )(x)
+    out2 = np.asarray(out2)
+    print("OOB input index ran; lag-write correct:",
+          np.array_equal(out2[TH:], np.asarray(x)))
+except Exception as e:
+    print("OOB input index failed:", type(e).__name__, str(e)[:120])

@@ -1,0 +1,50 @@
+"""Join profiler trace durations with HLO metadata -> per-source-line ranking."""
+import sys, glob, gzip, json, collections, re
+
+tdir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/trace_r3"
+hlo = open(sys.argv[2] if len(sys.argv) > 2 else "/tmp/hlo_full.txt").read()
+
+# name -> (shape, source, op_name)
+meta = {}
+for m in re.finditer(
+        r"%(\S+?) = (\S+?) (?:fusion|copy|pad|convolution|custom-call|reduce-window|"
+        r"transpose|reshape|slice|convert|bitcast-convert|dynamic-slice|dynamic-update-slice|"
+        r"all-reduce|select-and-scatter|reduce)\(.*?metadata=\{([^}]*)\}", hlo):
+    name, shp, md = m.groups()
+    src = re.search(r'source_file="([^"]*)"', md)
+    line = re.search(r"source_line=(\d+)", md)
+    op = re.search(r'op_name="([^"]*)"', md)
+    key = ""
+    if src:
+        key = src.group(1).split("/")[-1] + ":" + (line.group(1) if line else "?")
+    meta[name] = (shp.split("{")[0], key, op.group(1)[-60:] if op else "")
+
+files = glob.glob(f"{tdir}/**/*.trace.json.gz", recursive=True)
+ev = json.load(gzip.open(sorted(files)[-1]))["traceEvents"]
+pids = {}
+for e in ev:
+    if e.get("ph") == "M" and e.get("name") == "process_name":
+        pids[e["pid"]] = e["args"]["name"]
+
+byline = collections.Counter()
+byop = collections.defaultdict(float)
+total = 0.0
+for e in ev:
+    if e.get("ph") != "X" or "dur" not in e:
+        continue
+    if "TPU" not in pids.get(e.get("pid"), ""):
+        continue
+    name = str(e.get("name", ""))
+    if name.startswith(("jit_", "while")):
+        continue
+    shp, key, op = meta.get(name, ("?", "(unmapped)", ""))
+    byline[key] += e["dur"]
+    byop[(key, name, shp, op)] += e["dur"]
+    total += e["dur"]
+
+print(f"device op total: {total/1e3:.1f} ms")
+for key, dur in byline.most_common(20):
+    print(f"{dur/1e3:9.2f} ms  {100*dur/total:5.1f}%  {key}")
+print("\ntop ops with shape:")
+for (key, name, shp, op), dur in sorted(byop.items(), key=lambda kv: -kv[1])[:30]:
+    print(f"{dur/1e3:8.2f} ms  {key:22s} {shp:38s} {name[:28]}")
