@@ -27,6 +27,29 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def _trace_device_seconds(trace_dir: str):
+    """Sum device-stream op durations from the newest profiler trace."""
+    import glob
+    import gzip
+
+    files = glob.glob(f"{trace_dir}/**/*.trace.json.gz", recursive=True)
+    if not files:
+        return None
+    ev = json.load(gzip.open(sorted(files)[-1]))["traceEvents"]
+    pids = {e["pid"]: e["args"]["name"] for e in ev
+            if e.get("ph") == "M" and e.get("name") == "process_name"}
+    total = sum(e["dur"] for e in ev
+                if e.get("ph") == "X" and "dur" in e
+                and "TPU" in pids.get(e.get("pid"), "")
+                and not str(e.get("name", "")).startswith(("jit_", "while")))
+    return total / 1e6 if total else None
+
+
+# Peak bf16 TFLOP/s by device kind (MFU denominator).
+_PEAK_FLOPS = {"TPU v5 lite": 197e12, "TPU v5e": 197e12,
+               "TPU v4": 275e12, "TPU v5p": 459e12, "TPU v6e": 918e12}
+
+
 def main() -> None:
     from raft_stereo_tpu.config import RAFTStereoConfig
     from raft_stereo_tpu.models import init_raft_stereo, raft_stereo_forward
@@ -96,10 +119,29 @@ def main() -> None:
     run(img1, img2)
     run(img1, img2)
 
-    trace_dir = os.environ.get("RAFT_BENCH_TRACE")
-    if trace_dir:
+    # Device-side op time from a one-frame profiler trace: wall fps through
+    # the tunnel includes ~100 ms host overhead per barrier, and the
+    # flops-derived MFU needs the on-device time to be honest. Failure to
+    # trace (or parse) degrades to null rather than failing the bench.
+    trace_dir = os.environ.get("RAFT_BENCH_TRACE") or "/tmp/raft_bench_trace"
+    device_s = None
+    try:
+        import shutil
+        if not os.environ.get("RAFT_BENCH_TRACE"):
+            shutil.rmtree(trace_dir, ignore_errors=True)
         with jax.profiler.trace(trace_dir):
             run(img1, img2)
+        device_s = _trace_device_seconds(trace_dir)
+    except Exception:  # noqa: BLE001 - diagnostics only
+        pass
+
+    flops = None
+    try:
+        cost = forward.lower(params, img1, img2).compile().cost_analysis()
+        if cost:
+            flops = float(cost.get("flops", 0.0)) or None
+    except Exception:  # noqa: BLE001 - diagnostics only
+        pass
 
     # One device-resident pair, dispatched n_frames times (the reference
     # also times only the forward: its timer starts after load + pad +
@@ -145,6 +187,15 @@ def main() -> None:
             except (OSError, ValueError):
                 pass
 
+    kind = jax.devices()[0].device_kind
+    peak = next((v for k, v in _PEAK_FLOPS.items() if k in kind), None)
+    # MFU against the device-time of one dispatch (falls back to wall):
+    # XLA cost_analysis counts the algorithmic flops of the lowered
+    # program, which like the trace covers one dispatch (= ``batch``
+    # frames).
+    dispatch_s = device_s if device_s else elapsed / n_frames
+    mfu = (flops / dispatch_s / peak) if (flops and peak) else None
+
     print(json.dumps({
         "metric": (f"middlebury_F_disparity_fps_per_chip_{iters}iters_"
                    f"{h}x{w}_{corr}_{'bf16' if mixed else 'fp32'}"
@@ -153,6 +204,9 @@ def main() -> None:
         "unit": "frames/s",
         "vs_baseline": round(fps / baseline, 4) if baseline else None,
         "checksum": round(checksum, 2),
+        "device_s": round(device_s, 4) if device_s else None,
+        "flops": flops,
+        "mfu": round(mfu, 4) if mfu else None,
     }))
 
 

@@ -48,9 +48,15 @@ class StereoLoader:
     def __init__(self, dataset: StereoDataset, batch_size: int,
                  shuffle: bool = True, num_workers: int = 4,
                  drop_last: bool = True, seed: int = 0, prefetch: int = 2,
-                 return_paths: bool = False):
+                 return_paths: bool = False,
+                 local_rows: Optional[slice] = None):
         self.dataset = dataset
         self.batch_size = batch_size
+        # Multi-host: decode only this process's rows of each (globally
+        # deterministic) batch. Epoch order and per-sample RNG stay keyed
+        # by GLOBAL position, so the pod-wide batch is identical to the
+        # single-host one — only the decode work is partitioned.
+        self.local_rows = local_rows
         self.shuffle = shuffle
         self.num_workers = max(1, num_workers)
         self.drop_last = drop_last
@@ -98,8 +104,12 @@ class StereoLoader:
             def submit_batch(b):
                 lo = b * self.batch_size
                 idxs = order[lo:lo + self.batch_size]
-                return [pool.submit(self._load, i, epoch, lo + k)
-                        for k, i in enumerate(idxs)]
+                # len(idxs) < batch_size on the final batch when
+                # drop_last=False; the local-rows window clamps to it.
+                rows = (range(len(idxs)) if self.local_rows is None
+                        else range(*self.local_rows.indices(len(idxs))))
+                return [pool.submit(self._load, idxs[k], epoch, lo + k)
+                        for k in rows]
 
             while submitted < n_batches and len(pending) < self.prefetch:
                 pending.append(submit_batch(submitted))
@@ -121,18 +131,26 @@ class StereoLoader:
                 pass
 
 
-def fetch_dataloader(train_cfg, root: Optional[str] = None) -> StereoLoader:
-    """Build the training-mix loader (reference ``fetch_dataloader``)."""
+def fetch_dataloader(train_cfg, root: Optional[str] = None,
+                     local_rows: Optional[slice] = None) -> StereoLoader:
+    """Build the training-mix loader (reference ``fetch_dataloader``).
+
+    ``local_rows``: on a multi-host pod, the global-batch row range this
+    process's devices own (``parallel.mesh.local_batch_rows``) — only
+    those samples are decoded here (the reference's per-process
+    DataLoader equivalent)."""
     dataset = fetch_dataset(train_cfg, root=root)
     num_workers = getattr(train_cfg, "num_workers", None)
     if num_workers is None:
         num_workers = int(os.environ.get("SLURM_CPUS_PER_TASK", 6)) - 2
     return StereoLoader(dataset, batch_size=train_cfg.batch_size, shuffle=True,
                         num_workers=num_workers, drop_last=True,
-                        seed=getattr(train_cfg, "seed", 0))
+                        seed=getattr(train_cfg, "seed", 0),
+                        local_rows=local_rows)
 
 
-def device_prefetch(loader, mesh=None, size: int = 2, image_dtype=None):
+def device_prefetch(loader, mesh=None, size: int = 2, image_dtype=None,
+                    global_batch: Optional[int] = None):
     """Double-buffer batches onto device (sharded over the mesh's data axis).
 
     The host->device transfer of batch N+1 runs on a background thread while
@@ -144,15 +162,18 @@ def device_prefetch(loader, mesh=None, size: int = 2, image_dtype=None):
     ``image_dtype`` (e.g. ``jnp.bfloat16`` under mixed precision) downcasts
     the image arrays BEFORE transfer, halving upload bytes; the model's
     first op casts images to the compute dtype anyway, so the values the
-    network consumes are the same to one rounding step.
+    network consumes are the same to one rounding step. (Precisely: the
+    normalization ``2*(x/255)-1`` then runs on bf16-quantized uint8 values
+    at train time while eval feeds fp32 — a train/eval asymmetry of at
+    most one bf16 ulp per pixel, dwarfed by train-time photometric
+    augmentation. Set ``image_dtype=None`` for bit-identical transport.)
 
-    Multi-host note: every process iterates the SAME deterministic loader
-    (same seed, same file listing) and device_puts the full global batch
-    onto the pod-wide sharding — correct, but each host decodes/augments
-    the whole global batch. Pods that become input-bound should shard the
-    dataset by ``jax.process_index()`` and assemble with
-    ``jax.make_array_from_process_local_data`` instead; single-host (this
-    image, and the reference's scale) is unaffected.
+    Multi-host: when ``global_batch`` exceeds the rows present in the
+    yielded batches, each process is holding ONLY its shard of the global
+    batch (a row-local loader via ``fetch_dataloader(local_rows=...)``,
+    the reference's one-DataLoader-per-process equivalent) and the global
+    array is assembled with ``jax.make_array_from_process_local_data`` —
+    no host decodes work for another host's devices.
     """
     import jax
 
@@ -161,7 +182,12 @@ def device_prefetch(loader, mesh=None, size: int = 2, image_dtype=None):
         # not insert a reshard that defeats the double-buffering overlap.
         from raft_stereo_tpu.parallel.mesh import data_sharding
         sharding = data_sharding(mesh)
-        placed = lambda v: jax.device_put(v, sharding)
+
+        def placed(v):
+            if global_batch is not None and v.shape[0] != global_batch:
+                return jax.make_array_from_process_local_data(
+                    sharding, v, (global_batch,) + v.shape[1:])
+            return jax.device_put(v, sharding)
     else:
         placed = lambda v: jax.device_put(v)
 
@@ -188,7 +214,11 @@ def device_prefetch(loader, mesh=None, size: int = 2, image_dtype=None):
         # No blocking join (mirrors StereoLoader above): train loops abandon
         # this generator at num_steps/preemption, and waiting here would
         # stall on a multi-second upload of a batch nobody will use — on
-        # the preemption path that wait eats SIGTERM grace time.
+        # the preemption path that wait eats SIGTERM grace time. (Note
+        # concurrent.futures still registers an atexit join of the worker
+        # thread, so an in-flight device_put can delay interpreter EXIT;
+        # the preempt checkpoint is already on disk by then, so only exit
+        # latency — not safety — is affected.)
         try:
             ex.shutdown(wait=False, cancel_futures=True)
         except Exception:

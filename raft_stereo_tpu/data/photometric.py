@@ -96,6 +96,9 @@ class ColorJitter:
         h = rng.uniform(-self.hue, self.hue)
         gamma_gain_draw = (rng.uniform(*self.gamma_range),
                            rng.uniform(*self.gain_range))
+        # Native and numpy paths may differ by ±1 uint8 count (tested,
+        # bounded): bit-exact reproduction across machines additionally
+        # requires the same RAFT_NATIVE setting (README notes this).
         if native.available():
             self._apply_native(out, ops, b, c, s, h, *gamma_gain_draw)
             return out.astype(np.uint8)

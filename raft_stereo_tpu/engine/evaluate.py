@@ -210,11 +210,11 @@ def validate_kitti(params, cfg, iters: int = 32, mixed_prec: bool = False,
         val = sample["valid"].flatten() >= 0.5
         out = epe > 3.0
         image_epe = epe[val].mean()
-        if val_id < 9 or (val_id + 1) % 10 == 0:
-            logger.info(
-                "KITTI Iter %d out of %d. EPE %.4f D1 %.4f. Runtime: %.3fs "
-                "(%.2f-FPS)", val_id + 1, len(val_dataset), image_epe,
-                out[val].mean(), elapsed, 1 / elapsed)
+        # Every frame, like the reference (evaluate_stereo.py:95-103).
+        logger.info(
+            "KITTI Iter %d out of %d. EPE %.4f D1 %.4f. Runtime: %.3fs "
+            "(%.2f-FPS)", val_id + 1, len(val_dataset), image_epe,
+            out[val].mean(), elapsed, 1 / elapsed)
         epe_list.append(image_epe)
         out_list.append(out[val])  # per-pixel aggregation (:97-100)
 

@@ -4,17 +4,37 @@
 Same observable behavior: scalars flushed every ``SUM_FREQ=100`` steps from
 running means, per-batch ``live_loss`` and ``learning_rate`` entries, and
 ``write_dict`` for validation results. The writer is tensorboardX (pure
-python), lazily constructed so headless / test runs pay nothing.
+python), lazily constructed so headless / test runs pay nothing; when
+tensorboardX is unavailable the scalars land in ``<log_dir>/scalars.jsonl``
+(one ``{"step", "tag", "value"}`` object per line) so training telemetry is
+never silently dropped.
 """
 
 from __future__ import annotations
 
+import json
 import logging
+import os
 from typing import Dict, Optional
 
 SUM_FREQ = 100
 
 logger = logging.getLogger(__name__)
+
+
+class _JsonlWriter:
+    """SummaryWriter-shaped fallback: newline-delimited JSON scalars."""
+
+    def __init__(self, log_dir: str):
+        os.makedirs(log_dir, exist_ok=True)
+        self._f = open(os.path.join(log_dir, "scalars.jsonl"), "a")
+
+    def add_scalar(self, tag: str, value, step) -> None:
+        self._f.write(json.dumps(
+            {"step": int(step), "tag": tag, "value": float(value)}) + "\n")
+
+    def close(self) -> None:
+        self._f.close()
 
 
 class Logger:
@@ -27,8 +47,11 @@ class Logger:
 
     def _ensure_writer(self):
         if self.writer is None:
-            from tensorboardX import SummaryWriter
-            self.writer = SummaryWriter(log_dir=self.log_dir)
+            try:
+                from tensorboardX import SummaryWriter
+                self.writer = SummaryWriter(log_dir=self.log_dir)
+            except ImportError:
+                self.writer = _JsonlWriter(self.log_dir)
         return self.writer
 
     def _print_training_status(self):

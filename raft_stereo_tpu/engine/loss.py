@@ -53,5 +53,10 @@ def sequence_loss(flow_preds: jax.Array, flow_gt: jax.Array, valid: jax.Array,
         "1px": _masked_mean((epe < 1.0).astype(jnp.float32), m),
         "3px": _masked_mean((epe < 3.0).astype(jnp.float32), m),
         "5px": _masked_mean((epe < 5.0).astype(jnp.float32), m),
+        # The reference asserts no NaN/Inf in predictions and loss
+        # (train_stereo.py:48-56); under jit the invariant surfaces as a
+        # metric the train loop raises on (engine/train.py).
+        "finite": (jnp.isfinite(flow_loss)
+                   & jnp.all(jnp.isfinite(flow_preds))).astype(jnp.float32),
     }
     return flow_loss, metrics

@@ -50,7 +50,12 @@ def choose_mesh(batch_size: int, spatial_shard: int, devices,
     collective), so there the batch has to divide the data extent exactly.
     Returns None when a single device (no axis > 1) is the right answer.
     """
-    devices = list(devices)
+    # Group each process's devices contiguously before slicing the mesh:
+    # jax.devices() order is not guaranteed per-process-contiguous on every
+    # topology, and a ``space`` row spanning hosts would put the spatial
+    # halo collectives on DCN instead of ICI (perf, not correctness).
+    devices = sorted(devices, key=lambda d: (getattr(d, "process_index", 0),
+                                             getattr(d, "id", 0)))
     n_devices = len(devices)
     n_space = max(1, spatial_shard)
     validate_spatial_shard(n_space, n_devices, local_device_count)
@@ -79,13 +84,17 @@ class PreemptGuard:
     boundaries, where params/opt_state are consistent, saves, and returns.
 
     On a multi-host pod every process polls ``stop()`` which ORs the local
-    flags across processes (one tiny allgather per step, ~µs over ICI), so
-    all processes leave the collective region at the SAME step — a host-local
-    check would deadlock the survivors at the next psum.
+    flags across processes, so all processes leave the collective region at
+    the SAME step — a host-local check would deadlock the survivors at the
+    next psum. The allgather + host sync is NOT free over DCN-connected
+    pods, so it runs every ``poll_every`` steps (all processes agree on the
+    step counter, hence on when to poll); preemption grace windows are tens
+    of seconds, so a few steps of polling latency is safe.
     """
 
-    def __init__(self):
+    def __init__(self, poll_every: int = 8):
         self.requested = False
+        self.poll_every = max(1, poll_every)
         self._prev = None
         try:
             self._prev = signal.signal(signal.SIGTERM, self._on_signal)
@@ -96,9 +105,11 @@ class PreemptGuard:
         self.requested = True
         logger.warning("SIGTERM received: checkpointing at next step boundary")
 
-    def stop(self) -> bool:
+    def stop(self, step: int = 0) -> bool:
         if jax.process_count() == 1:
             return self.requested
+        if step % self.poll_every:
+            return False
         from jax.experimental import multihost_utils
         flags = multihost_utils.process_allgather(
             np.asarray([self.requested]))
@@ -161,7 +172,16 @@ def train(cfg: RAFTStereoConfig, tcfg: TrainConfig,
                         tcfg.restore_ckpt, start_step)
 
     logger.info("Parameter Count: %d", count_parameters(params))
-    train_loader = fetch_dataloader(tcfg, root=data_root)
+    # Multi-host: each process decodes only the global-batch rows its
+    # devices own (the reference runs one DataLoader per process,
+    # core/stereo_datasets.py:311-312); device_prefetch reassembles the
+    # global array from the process-local shards.
+    local_rows = None
+    if mesh is not None and jax.process_count() > 1:
+        from raft_stereo_tpu.parallel.mesh import local_batch_rows
+        local_rows = local_batch_rows(mesh, tcfg.batch_size)
+    train_loader = fetch_dataloader(tcfg, root=data_root,
+                                    local_rows=local_rows)
     train_step = make_train_step(cfg, tx, tcfg.train_iters, mesh=mesh)
     log = Logger(scheduler=schedule) if is_lead else _NullLogger()
     log.total_steps = start_step
@@ -190,8 +210,10 @@ def train(cfg: RAFTStereoConfig, tcfg: TrainConfig,
     image_dtype = jnp.bfloat16 if cfg.mixed_precision else None
     try:
         while should_keep_training:
-            for batch in device_prefetch(train_loader, mesh=mesh,
-                                         image_dtype=image_dtype):
+            for batch in device_prefetch(
+                    train_loader, mesh=mesh, image_dtype=image_dtype,
+                    global_batch=(tcfg.batch_size if local_rows is not None
+                                  else None)):
                 if (tcfg.trace_dir is not None and is_lead
                         and total_steps == start_step + 2):  # post-compile
                     with jax.profiler.trace(tcfg.trace_dir):
@@ -200,6 +222,13 @@ def train(cfg: RAFTStereoConfig, tcfg: TrainConfig,
                 else:
                     params, opt_state, host = run_step(params, opt_state,
                                                        batch)
+                if host.get("finite", 1.0) < 1.0:
+                    # Reference invariant (train_stereo.py:48-56): NaN/Inf in
+                    # the predictions or loss aborts loudly instead of
+                    # silently corrupting the parameters.
+                    raise FloatingPointError(
+                        f"non-finite loss/predictions at step {total_steps} "
+                        f"(loss={host.get('loss')})")
                 log.push({k: host[k] for k in
                           ("epe", "1px", "3px", "5px", "loss") if k in host})
                 log.write_scalar("live_loss", host["loss"], total_steps)
@@ -233,7 +262,7 @@ def train(cfg: RAFTStereoConfig, tcfg: TrainConfig,
                 if total_steps >= tcfg.num_steps:
                     should_keep_training = False
                     break
-                if guard.stop():
+                if guard.stop(total_steps):
                     preempted = True
                     if is_lead:
                         save_path = (f"checkpoints/{total_steps}_preempt_"

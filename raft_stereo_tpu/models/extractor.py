@@ -58,13 +58,21 @@ def init_basic_encoder(key: jax.Array, output_dim: int = 128,
 
 
 def apply_basic_encoder(p: Params, x: jax.Array, *, norm_fn: str,
-                        downsample: int) -> jax.Array:
+                        downsample: int, fused: bool = True) -> jax.Array:
     from raft_stereo_tpu.models.layers import apply_norm
+    from raft_stereo_tpu.ops.pallas_encoder import (
+        fused_in_stem_layer1, in_stem_layer1_is_fusable)
     s_stem, s2, s3 = _trunk_strides(downsample)
-    x = apply_conv(p["conv1"], x, stride=s_stem, padding=3)
-    # Stem GroupNorm uses 8 groups (extractor.py:129), unlike blocks (planes//8).
-    x = jax.nn.relu(apply_norm(norm_fn, p["norm1"], x, num_groups=8))
-    x = _apply_stage(p["layer1"], x, norm_fn, 1)
+    if fused and in_stem_layer1_is_fusable(p, x, norm_fn, s_stem):
+        # Full-resolution stem + layer1 streamed one-pass-per-conv with
+        # inline instance normalization (see ops/pallas_encoder.py).
+        x = fused_in_stem_layer1(p, x)
+    else:
+        x = apply_conv(p["conv1"], x, stride=s_stem, padding=3)
+        # Stem GroupNorm uses 8 groups (extractor.py:129), unlike blocks
+        # (planes//8).
+        x = jax.nn.relu(apply_norm(norm_fn, p["norm1"], x, num_groups=8))
+        x = _apply_stage(p["layer1"], x, norm_fn, 1)
     x = _apply_stage(p["layer2"], x, norm_fn, s2)
     x = _apply_stage(p["layer3"], x, norm_fn, s3)
     return apply_conv(p["conv2"], x)
@@ -101,14 +109,22 @@ def init_multi_basic_encoder(key: jax.Array, output_dim: Sequence[Sequence[int]]
 
 def apply_multi_basic_encoder(p: Params, x: jax.Array, *, norm_fn: str,
                               downsample: int, num_layers: int = 3,
-                              dual_inp: bool = False):
+                              dual_inp: bool = False, fused: bool = True):
     """Returns a tuple of per-scale lists (finest first), plus the full-batch
     trunk features when ``dual_inp``."""
     from raft_stereo_tpu.models.layers import apply_norm
+    from raft_stereo_tpu.ops.pallas_encoder import (
+        fused_stem_layer1, stem_layer1_is_fusable)
     s_stem, s2, s3 = _trunk_strides(downsample)
-    x = apply_conv(p["conv1"], x, stride=s_stem, padding=3)
-    x = jax.nn.relu(apply_norm(norm_fn, p["norm1"], x, num_groups=8))
-    x = _apply_stage(p["layer1"], x, norm_fn, 1)
+    if fused and stem_layer1_is_fusable(p, x, norm_fn, s_stem):
+        # Full-resolution stem + layer1 as ONE streaming Pallas pass
+        # (frozen-BN folded into the convs) — the XLA chain materializes
+        # five ~770 MB activations per frame at Middlebury-F.
+        x = fused_stem_layer1(p, x)
+    else:
+        x = apply_conv(p["conv1"], x, stride=s_stem, padding=3)
+        x = jax.nn.relu(apply_norm(norm_fn, p["norm1"], x, num_groups=8))
+        x = _apply_stage(p["layer1"], x, norm_fn, 1)
     x = _apply_stage(p["layer2"], x, norm_fn, s2)
     x = _apply_stage(p["layer3"], x, norm_fn, s3)
     if dual_inp:

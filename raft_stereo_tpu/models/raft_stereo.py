@@ -85,7 +85,7 @@ def _context_and_features(params: Params, cfg: RAFTStereoConfig,
     else:
         cnet_list = apply_multi_basic_encoder(
             params["cnet"], image1, norm_fn="batch", downsample=cfg.n_downsample,
-            num_layers=cfg.n_gru_layers)
+            num_layers=cfg.n_gru_layers, fused=cfg.fused_update)
         if image1.shape[1] * image1.shape[2] >= FNET_SEQUENTIAL_MIN_PIXELS:
             # Full-resolution inputs (>=2M px): run the two images through
             # the feature net SEQUENTIALLY (lax.map reuses the stem buffers
@@ -98,7 +98,7 @@ def _context_and_features(params: Params, cfg: RAFTStereoConfig,
             fmaps = lax.map(
                 lambda im: apply_basic_encoder(
                     params["fnet"], im, norm_fn="instance",
-                    downsample=cfg.n_downsample),
+                    downsample=cfg.n_downsample, fused=cfg.fused_update),
                 jnp.stack([image1, image2]))
             fmap1, fmap2 = fmaps[0], fmaps[1]
         else:
@@ -182,7 +182,8 @@ def raft_stereo_forward(params: Params, cfg: RAFTStereoConfig,
         net, up_mask, delta_flow = apply_update_block(
             params["update_block"], cfg, net, inp, corr, flow,
             iter32=cfg.n_gru_layers == 3, iter16=cfg.n_gru_layers >= 2,
-            compute_mask=compute_mask, fused_ctx=fused_ctx)
+            compute_mask=compute_mask, fused_ctx=fused_ctx,
+            fuse_motion=flow_init is None)
         # Stereo: project the update onto the epipolar line (:120).
         delta_flow = delta_flow.astype(jnp.float32).at[..., 1].set(0.0)
         coords1 = coords1 + delta_flow

@@ -191,7 +191,8 @@ def apply_update_block(p: Params, cfg: RAFTStereoConfig,
                        corr: jax.Array | None = None, flow: jax.Array | None = None,
                        iter08: bool = True, iter16: bool = True, iter32: bool = True,
                        update: bool = True, compute_mask: bool = True,
-                       fused_ctx: Sequence | None = None):
+                       fused_ctx: Sequence | None = None,
+                       fuse_motion: bool = True):
     """Reference ``BasicMultiUpdateBlock.forward`` (``core/update.py:115-138``).
 
     net: per-scale hidden states, finest first. inp: per-scale (cz, cr, cq).
@@ -237,7 +238,11 @@ def apply_update_block(p: Params, cfg: RAFTStereoConfig,
             net[1] = gru(1, net[1], inp[1], pool2x(net[0]))
     delta_x = None
     if iter08:
-        if fc[0] is not None and motion_is_fusable(corr):
+        # fuse_motion=False when a caller-supplied flow_init could carry a
+        # nonzero y component — the fused motion encoder drops convf1's
+        # flow-y weights on the strength of the y==0 invariant, which only
+        # the default zero-init coords guarantee.
+        if fuse_motion and fc[0] is not None and motion_is_fusable(corr):
             motion = fused_motion(p["encoder"], flow, corr)
         else:
             motion = apply_motion_encoder(p["encoder"], flow, corr)

@@ -1,0 +1,414 @@
+"""Streaming Pallas passes for the encoders' full-resolution trunks.
+
+The cnet/fnet stem + layer1 run at FULL image resolution (stride-1 stem
+for ``n_downsample=2``, reference ``core/extractor.py:122-146,199-225``):
+five convs whose activations are ~770 MB each at Middlebury-F. Under XLA
+every conv/norm/relu materializes in HBM and the small-channel (3->64,
+64ch) shapes run far off roofline (profiled ~340 ms per frame for both
+encoders against a ~50 ms bound).
+
+Design: ONE streamed pass per conv (ops/pallas_stream.py ring-window
+machinery). Pass k reads conv k-1's RAW output, applies the input
+transform inline — for fnet: relu((x - mean) * inv) with the instance-norm
+stats pass k-1 accumulated in scratch; for cnet the frozen BatchNorm is
+folded into the conv weights (the reference never updates BN —
+``freeze_bn``, ``train_stereo.py:151``), so the same kernels run with
+mean=0, inv=1 — convolves, and writes conv k's raw output while
+accumulating its stats. The global-stats barrier between instance-norm
+convs thus costs one HBM round trip per conv, the minimum possible.
+
+Per-pass details that matter on v5e:
+- outputs are emitted BLOCK-ALIGNED (a one-block ring delays the write by
+  one grid step), so chained passes never pay an unaligned-row slice copy
+  of a 770 MB tensor;
+- the 7x7 stem runs as 7 per-dy dots with all 7 dx-taps merged into the
+  dot's N dimension (4 -> 7*64 channels), then cheap shifted slice-adds —
+  49 tiny-K MXU passes would be pipeline-fill-bound;
+- row blocks are tall (th<=24): per-step fixed costs (MXU fill, DMA
+  issue) dominate these low-arithmetic-intensity convs.
+
+Residual structure (reference ResidualBlock, core/extractor.py:6-60):
+x = act(stem); y1 = act(conv1(x)); y2 = conv2(y1);
+o1 = relu(x + act0(y2)); y3 = act(conv3(o1)); y4 = conv4(y3);
+out = relu(o1 + act0(y4)) — where act = relu(norm(.)) and act0 likewise;
+identity shortcuts (stride-1, equal channels) only.
+
+Gradients via custom_vjp through the XLA oracles.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from raft_stereo_tpu.ops.pallas_stream import (
+    _conv_rows, _dot, _interpret, _row_mask, _shift, _zeros)
+
+_ENC_VMEM = 120 * 2**20  # v5e has 128M physical
+
+# Default-off: the streamed encoder passes are numerically validated
+# (tests/test_fused_stream.py) but the 12-kernel program currently drives
+# the AOT TPU compiler into multi-ten-minute compiles / OOM at full
+# Middlebury-F width, so the production path keeps the XLA encoders.
+# RAFT_FUSED_ENCODERS=1 opts in for experimentation.
+import os as _os
+
+ENABLE = _os.environ.get("RAFT_FUSED_ENCODERS", "0").lower() not in (
+    "0", "false", "no", "")
+
+
+def _enc_th(hh: int, width: int) -> int:
+    """Row-block for the encoder passes (single conv + small scratches:
+    tall blocks amortize per-step fixed costs)."""
+    for th in (24, 16, 12, 8, 6, 4, 2):
+        if hh % th == 0 and th * width <= 72 * 1024:
+            return th
+    return 0
+
+
+def _normed(raw, m_ref, v_ref):
+    """relu((raw - mean) * inv) in fp32 -> raw.dtype."""
+    x = raw.astype(jnp.float32) - m_ref[...].astype(jnp.float32)
+    return jax.nn.relu(x * v_ref[...].astype(jnp.float32)).astype(raw.dtype)
+
+
+def _conv7_rows(scr, w7, th, width):
+    """7x7 conv over a (>=th+6, width+6, 4) window: 7 per-dy dots with the
+    7 dx-taps stacked along N (4 -> 7*Cout), then shifted slice-adds."""
+    cout = w7.shape[-1] // 7
+    acc = None
+    for dy in range(7):
+        r = _dot(scr[dy:dy + th], w7[dy])
+        for dx in range(7):
+            y = r[:, dx:dx + width, dx * cout:(dx + 1) * cout]
+            acc = y if acc is None else acc + y
+    return acc
+
+
+def _aligned_out(out_ref, scr_prev, new, lag: int, th: int):
+    """Emit block max(i-1, 0) = true rows [(i-1)T, iT) from the previous
+    step's tail + this step's head; keeps outputs block-aligned so chained
+    passes never pay an unaligned-row slice copy."""
+    out_ref[0:th - lag] = scr_prev[lag:th]
+    out_ref[th - lag:th] = new[0:lag]
+    scr_prev[...] = new
+
+
+def _pass_kernel(*refs, kind: str, th: int, nb: int, width: int, hh: int,
+                 stats: bool):
+    """kind: 'stem7' (7x7 on the raw 4-ch image), 'mid1'
+    (relu(norm(x)) -> 3x3), 'mid2' (relu(relu(norm(a)) + relu(norm(b)))
+    -> 3x3), 'point3' (relu(relu(relu(norm(s)) + relu(norm(y2)))
+    + relu(norm(y4))), no conv)."""
+    i = pl.program_id(0)
+    k = 0
+
+    def take(n):
+        nonlocal k
+        r = refs[k:k + n]
+        k += n
+        return r
+
+    if kind == "stem7":
+        (img_ref,), (w_ref, b_ref) = take(1), take(2)
+    elif kind == "mid1":
+        (x_ref, m_ref, v_ref), (w_ref, b_ref) = take(3), take(2)
+    elif kind == "mid2":
+        (a_ref, ma_ref, va_ref, b2_ref, mb_ref, vb_ref) = take(6)
+        (w_ref, b_ref) = take(2)
+    else:  # point3
+        (s_ref, ms_ref, vs_ref, y2_ref, m2_ref, v2_ref,
+         y4_ref, m4_ref, v4_ref) = take(9)
+        (out_ref,) = take(1)
+        o1 = jax.nn.relu(
+            _normed(s_ref[...], ms_ref, vs_ref).astype(jnp.float32)
+            + _normed(y2_ref[...], m2_ref, v2_ref))
+        out_ref[...] = jax.nn.relu(
+            o1 + _normed(y4_ref[...], m4_ref, v4_ref)).astype(out_ref.dtype)
+        return
+
+    out_ref = take(1)[0]
+    st_ref = take(1)[0] if stats else None
+    scr_in, scr_prev = take(2)
+    scr_st = take(1)[0] if stats else None
+    dtype = out_ref.dtype
+    lag = 3 if kind == "stem7" else 1
+    pad = 3 if kind == "stem7" else 1
+
+    @pl.when(i == 0)
+    def _init():
+        _zeros(scr_in)
+        if stats:
+            scr_st[...] = jnp.zeros(scr_st.shape, scr_st.dtype)
+
+    _shift(scr_in, 2 * lag)
+
+    @pl.when(i < nb)
+    def _place():
+        if kind == "stem7":
+            scr_in[2 * lag:2 * lag + th, pad:width + pad] = img_ref[...]
+        elif kind == "mid1":
+            scr_in[2 * lag:2 * lag + th, pad:width + pad] = _normed(
+                x_ref[...], m_ref, v_ref)
+        else:
+            o1 = jax.nn.relu(
+                _normed(a_ref[...], ma_ref, va_ref).astype(jnp.float32)
+                + _normed(b2_ref[...], mb_ref, vb_ref)).astype(dtype)
+            scr_in[2 * lag:2 * lag + th, pad:width + pad] = o1
+
+    @pl.when(i >= nb)
+    def _flush():
+        _zeros(scr_in, slice(2 * lag, 2 * lag + th))
+
+    if kind == "stem7":
+        acc = _conv7_rows(scr_in, w_ref, th, width)
+    else:
+        acc = _conv_rows(scr_in, w_ref, th, width)
+    out = acc + b_ref[...].astype(jnp.float32)
+    new = out.astype(dtype)
+    _aligned_out(out_ref, scr_prev, new, lag, th)
+
+    if stats:
+        # Running sums over VALID out rows (conv-of-zero + bias at the
+        # top/flush rows would poison the next pass's normalize).
+        contrib = _row_mask(i, -lag, th, hh, out)
+        scr_st[0] += jnp.sum(contrib, axis=(0, 1))
+        scr_st[1] += jnp.sum(jnp.square(contrib), axis=(0, 1))
+        st_ref[...] = scr_st[...]
+
+
+def _stats_to_mv(stats, n: int, eps: float = 1e-5):
+    mean = stats[0] / n
+    var = jnp.maximum(stats[1] / n - jnp.square(mean), 0.0)
+    return mean.reshape(1, -1), jax.lax.rsqrt(var + eps).reshape(1, -1)
+
+
+def _run_pass(kind, inputs, w, bias, hh, width, cout, dtype, stats: bool):
+    """One streamed pass. inputs: list of (raw(H,W,C), mean, inv) triples
+    ((img4, None, None) for stem7). Returns (raw_out(H,W,cout), stats?)."""
+    th = _enc_th(hh, width)
+    nb = hh // th
+    lag = 0 if kind == "point3" else (3 if kind == "stem7" else 1)
+    grid = nb + 1 if lag else nb
+
+    def idx_in(i):
+        return (jnp.minimum(i, nb - 1), 0, 0)
+
+    in_specs, args = [], []
+    for raw, m, v in inputs:
+        in_specs.append(pl.BlockSpec((th, width, raw.shape[-1]), idx_in,
+                                     memory_space=pltpu.VMEM))
+        args.append(raw)
+        if m is not None:
+            for t in (m, v):
+                in_specs.append(pl.BlockSpec(t.shape, lambda i: (0, 0),
+                                             memory_space=pltpu.VMEM))
+                args.append(t)
+    if kind != "point3":
+        for t in (w, bias):
+            in_specs.append(pl.BlockSpec(t.shape,
+                                         lambda i, nd=t.ndim: (0,) * nd,
+                                         memory_space=pltpu.VMEM))
+            args.append(t)
+
+    kernel = functools.partial(_pass_kernel, kind=kind, th=th, nb=nb,
+                               width=width, hh=hh, stats=stats)
+    common = dict(
+        grid=(grid,), in_specs=in_specs,
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_ENC_VMEM),
+        interpret=_interpret())
+    if kind == "point3":
+        return pl.pallas_call(
+            kernel,
+            out_specs=pl.BlockSpec((th, width, cout), lambda i: (i, 0, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((hh, width, cout), dtype),
+            **common)(*args)
+
+    out_specs = [pl.BlockSpec((th, width, cout),
+                              lambda i: (jnp.maximum(i - 1, 0), 0, 0),
+                              memory_space=pltpu.VMEM)]
+    out_shape = [jax.ShapeDtypeStruct((hh, width, cout), dtype)]
+    if stats:
+        out_specs.append(pl.BlockSpec((2, cout), lambda i: (0, 0),
+                                      memory_space=pltpu.VMEM))
+        out_shape.append(jax.ShapeDtypeStruct((2, cout), jnp.float32))
+    scratch = [pltpu.VMEM((th + 2 * lag, width + 2 * pad_of(kind),
+                           inputs[0][0].shape[-1]), dtype),
+               pltpu.VMEM((th, width, cout), dtype)]
+    if stats:
+        scratch.append(pltpu.VMEM((2, cout), jnp.float32))
+    outs = pl.pallas_call(
+        kernel, out_specs=tuple(out_specs) if stats else out_specs[0],
+        out_shape=tuple(out_shape) if stats else out_shape[0],
+        scratch_shapes=scratch, **common)(*args)
+    return outs if stats else (outs, None)
+
+
+def pad_of(kind: str) -> int:
+    return 3 if kind == "stem7" else 1
+
+
+def _stem7_weights(w, dtype):
+    """(7,7,3,Cout) -> per-dy merged-N (7, 4, 7*Cout): channel-pad K to 4,
+    stack the dx taps along N."""
+    cout = w.shape[-1]
+    w4 = jnp.pad(w.astype(jnp.float32), ((0, 0), (0, 0), (0, 1), (0, 0)))
+    return w4.transpose(0, 2, 1, 3).reshape(7, 4, 7 * cout).astype(dtype)
+
+
+def _ident_mv(c):
+    return jnp.zeros((1, c), jnp.float32), jnp.ones((1, c), jnp.float32)
+
+
+def _fold_bn(conv: dict, bn: dict, dtype, eps: float = 1e-5):
+    """Fold frozen-BN stats into the preceding conv (fp32 fold, one cast)."""
+    k = (bn["scale"] * jax.lax.rsqrt(bn["var"] + eps)).astype(jnp.float32)
+    w = conv["w"].astype(jnp.float32) * k
+    b = (conv.get("b", 0.0) - bn["mean"]) * k + bn["bias"]
+    return w.astype(dtype), jnp.asarray(b, jnp.float32).reshape(1, -1)
+
+
+def _trunk_passes(x4, convs, hh, width, dtype, instance: bool):
+    """Shared stem+layer1 chain. convs: [(w_stem7, b), (w3x3, b) x4] — BN
+    pre-folded for the frozen-BN (cnet) trunk, raw for instance norm."""
+    n = hh * width
+
+    def mv(st, c):
+        return _stats_to_mv(st, n) if instance else _ident_mv(c)
+
+    (ws, bs), (w1, b1), (w2, b2), (w3, b3), (w4, b4) = convs
+    stem, st = _run_pass("stem7", [(x4, None, None)], ws, bs,
+                         hh, width, 64, dtype, instance)
+    m1, v1 = mv(st, 64)
+    y1, st = _run_pass("mid1", [(stem, m1, v1)], w1, b1,
+                       hh, width, 64, dtype, instance)
+    my, vy = mv(st, 64)
+    y2, st = _run_pass("mid1", [(y1, my, vy)], w2, b2,
+                       hh, width, 64, dtype, instance)
+    m2, v2 = mv(st, 64)
+    y3, st = _run_pass("mid2", [(stem, m1, v1), (y2, m2, v2)], w3, b3,
+                       hh, width, 64, dtype, instance)
+    m3, v3 = mv(st, 64)
+    y4, st = _run_pass("mid1", [(y3, m3, v3)], w4, b4,
+                       hh, width, 64, dtype, instance)
+    m4, v4 = mv(st, 64)
+    o2 = _run_pass("point3", [(stem, m1, v1), (y2, m2, v2), (y4, m4, v4)],
+                   None, None, hh, width, 64, dtype, False)
+    return o2[None]
+
+
+def fused_stem_layer1_impl(p: dict, x: jax.Array):
+    """Frozen-BN (cnet) stem + layer1; BN folded into the conv weights."""
+    b, hh, width, _ = x.shape
+    assert b == 1
+    dtype = x.dtype
+    blk1, blk2 = p["layer1"]
+    ws, bs = _fold_bn(p["conv1"], p["norm1"], jnp.float32)
+    convs = [(_stem7_weights(ws, dtype), bs)]
+    for blk in (blk1, blk2):
+        convs.append(_fold_bn(blk["conv1"], blk["norm1"], dtype))
+        convs.append(_fold_bn(blk["conv2"], blk["norm2"], dtype))
+    x4 = jnp.pad(x, ((0, 0), (0, 0), (0, 0), (0, 1)))[0]
+    return _trunk_passes(x4, convs, hh, width, dtype, instance=False)
+
+
+def fused_in_stem_layer1_impl(p: dict, x: jax.Array):
+    """Instance-norm (fnet) stem + layer1 for one (1, H, W, 3) image."""
+    b, hh, width, _ = x.shape
+    assert b == 1
+    dtype = x.dtype
+    blk1, blk2 = p["layer1"]
+
+    def cb(conv):
+        return conv["w"].astype(dtype), conv["b"].reshape(1, -1)
+
+    convs = [(_stem7_weights(p["conv1"]["w"], dtype),
+              p["conv1"]["b"].reshape(1, -1)),
+             cb(blk1["conv1"]), cb(blk1["conv2"]),
+             cb(blk2["conv1"]), cb(blk2["conv2"])]
+    x4 = jnp.pad(x, ((0, 0), (0, 0), (0, 0), (0, 1)))[0]
+    return _trunk_passes(x4, convs, hh, width, dtype, instance=True)
+
+
+def _fusable(p: dict, x, stride: int) -> bool:
+    from raft_stereo_tpu.ops.pallas_stream import _dtype_ok
+    if not ENABLE:
+        return False
+    if not (_dtype_ok(x) and x.shape[0] == 1 and stride == 1
+            and x.shape[1] >= 24 and _enc_th(x.shape[1], x.shape[2]) > 0):
+        return False
+    blk1, blk2 = p["layer1"]
+    # Identity shortcuts only (stride-1 equal-channel layer1 blocks).
+    return "downsample" not in blk1 and "downsample" not in blk2
+
+
+def stem_layer1_is_fusable(p: dict, x, norm_fn: str, stride: int) -> bool:
+    return norm_fn == "batch" and _fusable(p, x, stride)
+
+
+def in_stem_layer1_is_fusable(p: dict, x, norm_fn: str, stride: int) -> bool:
+    return norm_fn == "instance" and _fusable(p, x, stride)
+
+
+def _oracle(p: dict, x):
+    from raft_stereo_tpu.models.layers import apply_conv, apply_residual_block
+    from raft_stereo_tpu.ops.basic import frozen_batch_norm
+    h = apply_conv(p["conv1"], x, stride=1, padding=3)
+    h = jax.nn.relu(frozen_batch_norm(h, p["norm1"]))
+    for blk in p["layer1"]:
+        h = apply_residual_block(blk, h, "batch", stride=1)
+    return h
+
+
+def _in_oracle(p: dict, x):
+    from raft_stereo_tpu.models.layers import apply_conv, apply_residual_block
+    from raft_stereo_tpu.ops.basic import instance_norm
+    h = apply_conv(p["conv1"], x, stride=1, padding=3)
+    h = jax.nn.relu(instance_norm(h))
+    for blk in p["layer1"]:
+        h = apply_residual_block(blk, h, "instance", stride=1)
+    return h
+
+
+@jax.custom_vjp
+def fused_stem_layer1(p: dict, x):
+    """cnet stem + layer1 via streamed passes; backward via the XLA oracle."""
+    return fused_stem_layer1_impl(p, x)
+
+
+def _fwd(p, x):
+    return fused_stem_layer1(p, x), (p, x)
+
+
+def _bwd(res, g):
+    p, x = res
+    out, vjp = jax.vjp(_oracle, p, x)
+    return vjp(g.astype(out.dtype))
+
+
+fused_stem_layer1.defvjp(_fwd, _bwd)
+
+
+@jax.custom_vjp
+def fused_in_stem_layer1(p: dict, x):
+    """fnet stem + layer1 via streamed norm-conv passes; backward via the
+    XLA oracle."""
+    return fused_in_stem_layer1_impl(p, x)
+
+
+def _in_fwd(p, x):
+    return fused_in_stem_layer1(p, x), (p, x)
+
+
+def _in_bwd(res, g):
+    p, x = res
+    out, vjp = jax.vjp(_in_oracle, p, x)
+    return vjp(g.astype(out.dtype))
+
+
+fused_in_stem_layer1.defvjp(_in_fwd, _in_bwd)

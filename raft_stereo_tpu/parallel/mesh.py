@@ -115,6 +115,31 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def local_batch_rows(mesh: Mesh, batch_size: int) -> Optional[slice]:
+    """Rows of the global batch whose shards live on THIS process's devices.
+
+    The pod input pipeline decodes only these rows (the reference runs one
+    DataLoader per process, ``core/stereo_datasets.py:311-312``; a pod
+    where every host decodes the global batch turns the input pipeline
+    into the bottleneck at scale). Returns None when the assignment is not
+    a contiguous row range (unusual topology) — callers then fall back to
+    decoding everything, which is correct but redundant.
+    """
+    n_data = mesh.shape.get("data", 1)
+    if batch_size % n_data:
+        return None
+    rows_per = batch_size // n_data
+    pidx = jax.process_index()
+    mine = sorted({int(i) for i in range(mesh.devices.shape[0])
+                   if any(d.process_index == pidx
+                          for d in np.atleast_1d(mesh.devices[i]).flat)})
+    if not mine:
+        return None
+    if mine != list(range(mine[0], mine[-1] + 1)):
+        return None
+    return slice(mine[0] * rows_per, (mine[-1] + 1) * rows_per)
+
+
 def shard_batch(batch, mesh: Mesh, spatial: Optional[bool] = None):
     """Device-put a pytree of batch-leading arrays onto the mesh.
 

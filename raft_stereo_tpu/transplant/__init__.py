@@ -1,4 +1,6 @@
 from raft_stereo_tpu.transplant.torch_loader import (  # noqa: F401
+    export_state_dict,
     load_pth,
+    save_pth,
     transplant_state_dict,
 )

@@ -141,3 +141,113 @@ def load_pth(path: str, cfg: RAFTStereoConfig) -> Dict:
     import torch  # local import: torch is only needed for transplant
     state_dict = torch.load(path, map_location="cpu", weights_only=True)
     return transplant_state_dict(state_dict, cfg)
+
+
+# ---------------------------------------------------------------------------
+# Reverse transplant: param pytree -> reference state_dict / .pth, so
+# checkpoints trained here can feed the torch ecosystem the reference's
+# consumers expect (``train_stereo.py:184`` saves, ``demo.py:24-27`` /
+# ``evaluate_stereo.py:215-220`` load strict with the ``module.`` prefix).
+# ---------------------------------------------------------------------------
+
+
+def _put_conv(out: Dict, name: str, p: Mapping) -> None:
+    out[f"{name}.weight"] = np.asarray(p["w"], np.float32).transpose(3, 2, 0, 1)
+    if "b" in p:
+        out[f"{name}.bias"] = np.asarray(p["b"], np.float32)
+
+
+def _put_norm(out: Dict, name: str, p: Mapping, norm_fn: str) -> None:
+    if norm_fn == "batch":
+        out[f"{name}.weight"] = np.asarray(p["scale"], np.float32)
+        out[f"{name}.bias"] = np.asarray(p["bias"], np.float32)
+        out[f"{name}.running_mean"] = np.asarray(p["mean"], np.float32)
+        out[f"{name}.running_var"] = np.asarray(p["var"], np.float32)
+        # Strict loading requires the counter key; its value is unused in
+        # eval mode (and the reference always freezes BN).
+        out[f"{name}.num_batches_tracked"] = np.asarray(0, np.int64)
+    elif norm_fn == "group":
+        out[f"{name}.weight"] = np.asarray(p["scale"], np.float32)
+        out[f"{name}.bias"] = np.asarray(p["bias"], np.float32)
+    # instance / none: stateless
+
+
+def _put_residual_block(out: Dict, name: str, p: Mapping, norm_fn: str) -> None:
+    _put_conv(out, f"{name}.conv1", p["conv1"])
+    _put_conv(out, f"{name}.conv2", p["conv2"])
+    _put_norm(out, f"{name}.norm1", p["norm1"], norm_fn)
+    _put_norm(out, f"{name}.norm2", p["norm2"], norm_fn)
+    if "downsample" in p:
+        _put_conv(out, f"{name}.downsample.0", p["downsample"]["conv"])
+        # The reference registers the downsample norm twice (``norm3`` and
+        # ``downsample.1`` alias one module, core/extractor.py:40-45);
+        # strict loading needs both spellings.
+        _put_norm(out, f"{name}.downsample.1", p["downsample"]["norm"], norm_fn)
+        _put_norm(out, f"{name}.norm3", p["downsample"]["norm"], norm_fn)
+
+
+def _put_stage(out: Dict, name: str, blocks, norm_fn: str) -> None:
+    for j, blk in enumerate(blocks):
+        _put_residual_block(out, f"{name}.{j}", blk, norm_fn)
+
+
+def _put_basic_encoder(out: Dict, prefix: str, p: Mapping, norm_fn: str) -> None:
+    _put_conv(out, f"{prefix}.conv1", p["conv1"])
+    _put_norm(out, f"{prefix}.norm1", p["norm1"], norm_fn)
+    for stage in ("layer1", "layer2", "layer3"):
+        _put_stage(out, f"{prefix}.{stage}", p[stage], norm_fn)
+    _put_conv(out, f"{prefix}.conv2", p["conv2"])
+
+
+def _put_multi_encoder(out: Dict, prefix: str, p: Mapping, norm_fn: str) -> None:
+    _put_conv(out, f"{prefix}.conv1", p["conv1"])
+    _put_norm(out, f"{prefix}.norm1", p["norm1"], norm_fn)
+    for stage in ("layer1", "layer2", "layer3", "layer4", "layer5"):
+        _put_stage(out, f"{prefix}.{stage}", p[stage], norm_fn)
+    for scale in ("outputs08", "outputs16"):
+        for j, head in enumerate(p[scale]):
+            _put_residual_block(out, f"{prefix}.{scale}.{j}.0", head["res"],
+                                norm_fn)
+            _put_conv(out, f"{prefix}.{scale}.{j}.1", head["conv"])
+    for j, head in enumerate(p["outputs32"]):
+        _put_conv(out, f"{prefix}.outputs32.{j}", head["conv"])
+
+
+def export_state_dict(params: Mapping, cfg: RAFTStereoConfig, *,
+                      module_prefix: bool = True) -> Dict[str, np.ndarray]:
+    """Param pytree -> reference-layout state_dict (numpy values).
+
+    ``module_prefix=True`` emits ``module.``-prefixed keys so the result
+    loads strict into the reference's DataParallel-wrapped model exactly
+    like its own checkpoints.
+    """
+    out: Dict[str, np.ndarray] = {}
+    _put_multi_encoder(out, "cnet", params["cnet"], "batch")
+    ub = params["update_block"]
+    for c in ("convc1", "convc2", "convf1", "convf2", "conv"):
+        _put_conv(out, f"update_block.encoder.{c}", ub["encoder"][c])
+    for g in ("gru08", "gru16", "gru32"):
+        for conv in ("convz", "convr", "convq"):
+            _put_conv(out, f"update_block.{g}.{conv}", ub[g][conv])
+    _put_conv(out, "update_block.flow_head.conv1", ub["flow_head"]["conv1"])
+    _put_conv(out, "update_block.flow_head.conv2", ub["flow_head"]["conv2"])
+    _put_conv(out, "update_block.mask.0", ub["mask"]["conv1"])
+    _put_conv(out, "update_block.mask.2", ub["mask"]["conv2"])
+    for i, conv in enumerate(params["context_zqr_convs"]):
+        _put_conv(out, f"context_zqr_convs.{i}", conv)
+    if cfg.shared_backbone:
+        _put_residual_block(out, "conv2.0", params["conv2"]["res"], "instance")
+        _put_conv(out, "conv2.1", params["conv2"]["conv"])
+    else:
+        _put_basic_encoder(out, "fnet", params["fnet"], "instance")
+    if module_prefix:
+        out = {f"module.{k}": v for k, v in out.items()}
+    return out
+
+
+def save_pth(params: Mapping, cfg: RAFTStereoConfig, path: str) -> None:
+    """Save a param pytree as a reference-loadable ``.pth`` checkpoint."""
+    import torch  # local import: torch is only needed for transplant
+    sd = {k: torch.from_numpy(np.ascontiguousarray(v))
+          for k, v in export_state_dict(params, cfg).items()}
+    torch.save(sd, path)

@@ -1,0 +1,57 @@
+"""Isolate encoder (cnet+fnet) device time at Middlebury-F shape.
+
+Variants via env:
+  ENC_BATCHED=1  force the batch-concat fnet path (no lax.map)
+  ENC_H/ENC_W    input shape (default 2016x2976)
+"""
+import sys; sys.path.insert(0, "/root/repo")
+import os, time, glob, gzip, json, collections
+import numpy as np
+import jax, jax.numpy as jnp
+
+from raft_stereo_tpu.config import RAFTStereoConfig
+from raft_stereo_tpu.models import init_raft_stereo
+from raft_stereo_tpu.models import raft_stereo as rs
+
+if os.environ.get("ENC_BATCHED"):
+    rs.FNET_SEQUENTIAL_MIN_PIXELS = 1 << 62
+
+h = int(os.environ.get("ENC_H", 2016))
+w = int(os.environ.get("ENC_W", 2976))
+cfg = RAFTStereoConfig(corr_implementation="reg_tpu", mixed_precision=True)
+params = init_raft_stereo(jax.random.PRNGKey(0), cfg)
+
+@jax.jit
+def encoders(params, img1, img2):
+    net, inp, f1, f2 = rs._context_and_features(params, cfg, img1, img2,
+                                                jnp.bfloat16)
+    outs = [f1, f2] + [n for n in net] + [c for t in inp for c in t]
+    return jnp.stack([jnp.sum(o.astype(jnp.float32)) for o in outs])
+
+rng = np.random.default_rng(0)
+img1 = jnp.asarray(rng.uniform(0, 255, (1, h, w, 3)), jnp.float32)
+img2 = jnp.asarray(rng.uniform(0, 255, (1, h, w, 3)), jnp.float32)
+
+float(encoders(params, img1, img2)[0])  # compile+run
+t0 = time.perf_counter()
+pending = [encoders(params, img1, img2) for _ in range(4)]
+for p in pending:
+    float(p[0])
+wall = (time.perf_counter() - t0) / 4
+
+tdir = "/tmp/trace_enc"
+os.system(f"rm -rf {tdir}")
+with jax.profiler.trace(tdir):
+    float(encoders(params, img1, img2)[0])
+
+files = glob.glob(f"{tdir}/**/*.trace.json.gz", recursive=True)
+ev = json.load(gzip.open(sorted(files)[-1]))["traceEvents"]
+pids = {e["pid"]: e["args"]["name"] for e in ev
+        if e.get("ph") == "M" and e.get("name") == "process_name"}
+total = sum(e["dur"] for e in ev
+            if e.get("ph") == "X" and "dur" in e
+            and "TPU" in pids.get(e.get("pid"), "")
+            and not str(e.get("name", "")).startswith(("jit_", "while")))
+print(json.dumps({"wall_s": round(wall, 4),
+                  "device_ms": round(total / 1e3, 1),
+                  "batched": bool(os.environ.get("ENC_BATCHED"))}))

@@ -468,3 +468,42 @@ def test_device_prefetch_order_dtype_and_flush():
 
     out = list(device_prefetch(iter(batches)))
     assert out[3]["image1"].dtype == jnp.float32  # no dtype override
+
+
+def test_loader_local_rows_partition(tmp_path):
+    """Pod input sharding: a row-local loader decodes ONLY its rows, and
+    the union of two half-loaders equals the full loader's batch bit-for-
+    bit (global positions key the RNG, so partitioning changes no values)."""
+    import numpy as np
+    from raft_stereo_tpu.data.loader import StereoLoader
+
+    class CountingDataset:
+        def __init__(self):
+            self.calls = []
+
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i, rng=None):
+            self.calls.append(i)
+            v = float(rng.uniform())
+            return {"image1": np.full((4, 6, 3), i, np.float32) + v,
+                    "image2": np.zeros((4, 6, 3), np.float32),
+                    "flow": np.zeros((4, 6, 1), np.float32),
+                    "valid": np.ones((4, 6), np.float32)}
+
+    def batches(local):
+        ds = CountingDataset()
+        loader = StereoLoader(ds, batch_size=4, shuffle=True, num_workers=1,
+                              seed=7, local_rows=local)
+        out = [b for b in loader]
+        return ds, out
+
+    ds_full, full = batches(None)
+    ds_a, part_a = batches(slice(0, 2))
+    ds_b, part_b = batches(slice(2, 4))
+    assert len(ds_a.calls) == len(ds_full.calls) // 2
+    assert len(ds_b.calls) == len(ds_full.calls) // 2
+    for f, a, b in zip(full, part_a, part_b):
+        np.testing.assert_array_equal(f["image1"][:2], a["image1"])
+        np.testing.assert_array_equal(f["image1"][2:], b["image1"])

@@ -74,3 +74,21 @@ def test_optimizer_decreases_simple_loss(rng):
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
     assert float(loss(params)) < l0
+
+
+def test_sequence_loss_nan_guard(rng):
+    """The reference asserts no NaN/Inf in predictions or loss
+    (train_stereo.py:48-56); here the invariant is the 'finite' metric the
+    train loop raises on (FloatingPointError in engine/train.py)."""
+    import jax.numpy as jnp
+    preds = jnp.asarray(rng.normal(size=(3, 1, 8, 12, 1)).astype(np.float32))
+    gt = jnp.asarray(rng.normal(size=(1, 8, 12, 1)).astype(np.float32))
+    valid = jnp.ones((1, 8, 12), jnp.float32)
+    _, metrics = sequence_loss(preds, gt, valid)
+    assert float(metrics["finite"]) == 1.0
+    bad = preds.at[1, 0, 3, 4, 0].set(jnp.nan)
+    _, metrics = sequence_loss(bad, gt, valid)
+    assert float(metrics["finite"]) == 0.0
+    bad = preds.at[0, 0, 0, 0, 0].set(jnp.inf)
+    _, metrics = sequence_loss(bad, gt, valid)
+    assert float(metrics["finite"]) == 0.0

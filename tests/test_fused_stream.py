@@ -72,7 +72,11 @@ def test_fused_motion_integer_exact():
         lambda t: jnp.asarray(rng.integers(-2, 3, t.shape), jnp.float32), pm)
     corr = jnp.asarray(rng.integers(-3, 4, (1, 16, 24, cfg.cor_planes)),
                        jnp.float32)
+    # Model invariant: flow-y is identically zero (epipolar projection,
+    # raft_stereo.py:120); the fused motion encoder relies on it (flow-x-
+    # only f1 patches), so the oracle comparison feeds zero-y flow too.
     flow = jnp.asarray(rng.integers(-3, 4, (1, 16, 24, 2)), jnp.float32)
+    flow = flow.at[..., 1].set(0.0)
     ref = apply_motion_encoder(pm, flow, corr)
     got = fused_motion_fwd_impl(pm, flow, corr)
     assert float(jnp.max(jnp.abs(got - ref))) == 0.0
@@ -86,6 +90,7 @@ def test_fused_motion_matches_oracle(dtype, tol):
     pm = init_motion_encoder(key, cfg)
     corr = jax.random.normal(key, (1, 16, 24, cfg.cor_planes), dtype)
     flow = jax.random.normal(key, (1, 16, 24, 2), dtype)
+    flow = flow.at[..., 1].set(0.0)  # model invariant (see integer test)
     ref = apply_motion_encoder(pm, flow, corr)
     got = fused_motion_fwd_impl(pm, flow, corr)
     err = float(jnp.max(jnp.abs(got.astype(jnp.float32)
@@ -93,33 +98,73 @@ def test_fused_motion_matches_oracle(dtype, tol):
     assert err < tol, err
 
 
-def test_bf16_test_mode_fused_vs_xla(rng):
-    """End-to-end coverage for the head-chained test-mode scan (the branch
-    only the fused path takes: update=True, compute_mask=False)."""
-    cfg_f = RAFTStereoConfig(mixed_precision=True)
-    cfg_x = RAFTStereoConfig(mixed_precision=True, fused_update=False)
+def test_fp32_test_mode_fused_vs_xla(rng, monkeypatch):
+    """End-to-end check of the full fused scan body (cnet stem kernel,
+    motion kernel, head-chained GRU kernel — the update=True /
+    compute_mask=False branch only the fused path takes) against the pure
+    XLA path, in fp32 where the comparison is tight. The FORCE hook lets
+    fp32 through the bf16-only fusable gates; interpret mode has no VMEM
+    ceiling, so this is test-only."""
+    import raft_stereo_tpu.ops.pallas_stream as ps
+    monkeypatch.setattr(ps, "FORCE_FUSABLE_DTYPE", True)
+    cfg_f = RAFTStereoConfig()
+    cfg_x = RAFTStereoConfig(fused_update=False)
     params = init_raft_stereo(jax.random.key(0), cfg_f)
     img1 = jnp.asarray(rng.uniform(0, 255, size=(1, 32, 64, 3)),
                        dtype=jnp.float32)
     img2 = jnp.asarray(rng.uniform(0, 255, size=(1, 32, 64, 3)),
                        dtype=jnp.float32)
-    # ONE iteration: both are bf16 computations with different (documented)
-    # rounding points, and with random-init weights + random images the
-    # corr-lookup recurrence is chaotic — each further iteration can sample
-    # different correlation taps and amplify a 1e-2 gate difference to
-    # pixels. Multi-iteration agreement on real weights is pinned on-chip
-    # by scratch/cli_impl_consistency.py (EPE delta ~3e-3 px at 32 iters).
-    lr_f, up_f = raft_stereo_forward(params, cfg_f, img1, img2, iters=1,
+    lr_f, up_f = raft_stereo_forward(params, cfg_f, img1, img2, iters=3,
                                      test_mode=True)
-    lr_x, up_x = raft_stereo_forward(params, cfg_x, img1, img2, iters=1,
+    lr_x, up_x = raft_stereo_forward(params, cfg_x, img1, img2, iters=3,
                                      test_mode=True)
-    # The diff is diffuse (no row/col structure — structural bugs are pinned
-    # by the integer-exact kernel tests above); random-init weights amplify
-    # the per-op bf16 rounding diffs ~10x vs trained weights, hence the
-    # loose bound even for one iteration.
-    np.testing.assert_allclose(np.asarray(lr_f), np.asarray(lr_x), atol=0.5)
-    np.testing.assert_allclose(np.asarray(up_f), np.asarray(up_x), atol=0.5)
-    # And the multi-iteration fused path must at least stay finite.
-    lr3, up3 = raft_stereo_forward(params, cfg_f, img1, img2, iters=3,
+    # fp32 reassociation only, amplified by 3 recurrent iterations.
+    np.testing.assert_allclose(np.asarray(lr_f), np.asarray(lr_x), atol=2e-2)
+    np.testing.assert_allclose(np.asarray(up_f), np.asarray(up_x), atol=2e-2)
+
+
+def test_bf16_test_mode_fused_runs(rng):
+    """bf16 wiring smoke: the real (non-forced) fused path stays finite.
+    Numerical agreement at bf16 on trained weights is pinned on-chip by
+    scratch/cli_impl_consistency.py (EPE delta ~3e-3 px at 32 iters)."""
+    cfg = RAFTStereoConfig(mixed_precision=True)
+    params = init_raft_stereo(jax.random.key(0), cfg)
+    img1 = jnp.asarray(rng.uniform(0, 255, size=(1, 32, 64, 3)),
+                       dtype=jnp.float32)
+    img2 = jnp.asarray(rng.uniform(0, 255, size=(1, 32, 64, 3)),
+                       dtype=jnp.float32)
+    lr3, up3 = raft_stereo_forward(params, cfg, img1, img2, iters=3,
                                    test_mode=True)
     assert np.isfinite(np.asarray(up3, dtype=np.float32)).all()
+
+
+def test_fused_cnet_stem_layer1_matches_oracle():
+    """Streaming frozen-BN stem+layer1 (ops/pallas_encoder.py) vs XLA."""
+    from raft_stereo_tpu.models.extractor import init_multi_basic_encoder
+    from raft_stereo_tpu.ops.pallas_encoder import (
+        fused_stem_layer1_impl, _oracle)
+    key = jax.random.PRNGKey(0)
+    p = init_multi_basic_encoder(key, output_dim=[[128] * 3, [128] * 3],
+                                 norm_fn="batch", downsample=2)
+    x = jax.random.normal(key, (1, 48, 24, 3))
+    ref = np.asarray(_oracle(p, x))
+    got = np.asarray(fused_stem_layer1_impl(p, x))
+    d = np.abs(got - ref)
+    # fp32 reassociation through 5 convs (BN folded into weights vs applied
+    # after); diffuse across rows — boundary bugs would localize.
+    assert d.max() < 5e-2, d.max()
+    assert d[0].max(axis=(1, 2)).std() < d.max()  # no row stands out
+
+
+def test_fused_fnet_stem_layer1_matches_oracle():
+    """Streamed one-pass-per-conv instance-norm stem+layer1 vs XLA."""
+    from raft_stereo_tpu.models.extractor import init_basic_encoder
+    from raft_stereo_tpu.ops.pallas_encoder import (
+        fused_in_stem_layer1_impl, _in_oracle)
+    key = jax.random.PRNGKey(0)
+    p = init_basic_encoder(key, output_dim=256, norm_fn="instance",
+                           downsample=2)
+    x = jax.random.normal(key, (1, 48, 24, 3))
+    ref = np.asarray(_in_oracle(p, x))
+    got = np.asarray(fused_in_stem_layer1_impl(p, x))
+    assert np.abs(got - ref).max() < 5e-2, np.abs(got - ref).max()

@@ -38,6 +38,22 @@ jax.config.update("jax_platforms", "cpu")
 from raft_stereo_tpu.config import RAFTStereoConfig, TrainConfig
 from raft_stereo_tpu.engine.train import train
 
+# Count decoded samples: with the process-sharded input pipeline each
+# process must touch ONLY its global-batch rows (half the decode work).
+import raft_stereo_tpu.engine.train as T
+_orig_fetch = T.fetch_dataloader
+decoded = []
+def counting_fetch(tcfg, root=None, local_rows=None):
+    loader = _orig_fetch(tcfg, root=root, local_rows=local_rows)
+    ds = loader.dataset
+    orig_get = ds.__getitem__
+    def counted(i, rng=None):
+        decoded.append(i)
+        return orig_get(i, rng=rng)
+    ds.__getitem__ = counted
+    return loader
+T.fetch_dataloader = counting_fetch
+
 cfg = RAFTStereoConfig(hidden_dims=(32, 32, 32), corr_levels=2, corr_radius=2)
 tcfg = TrainConfig(name="mh", batch_size=8, image_size=(32, 48),
                    num_steps={num_steps}, train_iters=2,
@@ -45,6 +61,8 @@ tcfg = TrainConfig(name="mh", batch_size=8, image_size=(32, 48),
                    spatial_scale=(-0.2, 0.4))
 os.chdir({workdir!r})
 train(cfg, tcfg, data_root={root!r}, validate=False)
+print("RAFT_MH_DECODED", os.environ["PROCESS_ID"], len(decoded),
+      "steps", {num_steps}, "batch", tcfg.batch_size)
 print("RAFT_MH_DONE", os.environ["PROCESS_ID"], jax.process_count(),
       len(jax.devices()))
 """
@@ -105,9 +123,17 @@ def _ckpts(tmp_path, pid):
 def test_two_process_pod_trains_and_lead_writes(tmp_path):
     root = _tiny_things_tree(tmp_path)
     procs = _spawn_pod(tmp_path, root, num_steps=3, ckpt_every=100)
-    _finish(procs)
+    outs = _finish(procs)
     assert "mh.msgpack" in _ckpts(tmp_path, 0)  # lead wrote the final state
     assert _ckpts(tmp_path, 1) == []            # non-lead wrote nothing
+    # Process-sharded input: each process decodes only ITS half of every
+    # global batch (4 of 8 rows) — 3 consumed steps + up to 2 prefetched
+    # batches, never the full-batch 24-40 a replicated loader would touch.
+    for i, out in enumerate(outs):
+        line = next(l for l in out.splitlines()
+                    if l.startswith(f"RAFT_MH_DECODED {i} "))
+        n = int(line.split()[2])
+        assert 12 <= n <= 20, line
 
 
 def test_preemption_of_one_process_stops_the_pod(tmp_path):

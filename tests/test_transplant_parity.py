@@ -137,3 +137,27 @@ def test_train_mode_prediction_list_parity(rng):
         np.testing.assert_allclose(np.asarray(preds[i]),
                                    ref_preds[i].numpy().transpose(0, 2, 3, 1),
                                    atol=5e-3, err_msg=f"iteration {i}")
+
+
+def test_reverse_transplant_round_trip():
+    """params -> state_dict -> strict torch load must reproduce every tensor
+    (VERDICT r2 item 7: checkpoints trained here must feed the torch
+    ecosystem the reference's consumers expect)."""
+    import torch
+    from raft_stereo_tpu.transplant import export_state_dict
+    model, cfg = _make_reference_model()
+    ref_sd = model.state_dict()
+    params = transplant_state_dict(ref_sd, cfg)
+    out = export_state_dict(params, cfg, module_prefix=False)
+    assert set(out) == set(ref_sd)
+    for k, v in out.items():
+        if k.endswith("num_batches_tracked"):
+            continue  # counter value is unused in (always-frozen) eval BN
+        np.testing.assert_array_equal(v, ref_sd[k].numpy(), err_msg=k)
+    # Strict load back into the reference model (the real consumer check).
+    model.load_state_dict({k: torch.from_numpy(np.ascontiguousarray(v))
+                           for k, v in out.items()}, strict=True)
+    # And the module-prefixed spelling matches the reference's on-disk
+    # checkpoints (saved DataParallel-wrapped, train_stereo.py:184).
+    pref = export_state_dict(params, cfg)
+    assert all(k.startswith("module.") for k in pref)
