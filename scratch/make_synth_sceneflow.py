@@ -1,0 +1,58 @@
+"""Synthetic SceneFlow-shaped dataset tree for sustained-train runs.
+
+FlyingThings3D layout at the real 540x960 resolution: TRAIN split for the
+training mix, TEST split for validate_things. Left/right pairs are
+consistent with the generated disparity (right = left warped), so the
+loss has real structure to fit, at real decode cost.
+"""
+import os
+import os.path as osp
+import sys
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+from raft_stereo_tpu.data import frame_utils  # noqa: E402
+import cv2  # noqa: E402
+
+ROOT = sys.argv[1] if len(sys.argv) > 1 else "/tmp/synth_sceneflow"
+N_TRAIN = int(sys.argv[2]) if len(sys.argv) > 2 else 96
+N_TEST = int(sys.argv[3]) if len(sys.argv) > 3 else 8
+H, W = 540, 960
+
+
+def make_pair(rng):
+    base = rng.uniform(0, 255, (H // 8, W // 8, 3)).astype(np.float32)
+    left = cv2.resize(base, (W, H), interpolation=cv2.INTER_CUBIC)
+    left = np.clip(left + rng.normal(0, 6, left.shape), 0, 255)
+    dbase = rng.uniform(5, 60, (H // 32, W // 32)).astype(np.float32)
+    disp = cv2.resize(dbase, (W, H), interpolation=cv2.INTER_CUBIC)
+    xs = np.arange(W)[None, :] - disp
+    right = np.stack([
+        np.stack([np.interp(xs[y], np.arange(W), left[y, :, c])
+                  for y in range(H)])
+        for c in range(3)], axis=-1)
+    return left.astype(np.uint8), right.astype(np.uint8), disp
+
+
+def write(split, n, seed):
+    rng = np.random.default_rng(seed)
+    for i in range(n):
+        scene = f"{i:04d}"
+        for dstype in ("frames_cleanpass", "frames_finalpass"):
+            base = osp.join(ROOT, "FlyingThings3D", dstype, split, "A", scene)
+            left, right, disp = make_pair(np.random.default_rng([seed, i]))
+            for side, img in (("left", left), ("right", right)):
+                d = osp.join(base, side)
+                os.makedirs(d, exist_ok=True)
+                cv2.imwrite(osp.join(d, "0006.png"), img[..., ::-1])
+        ddir = osp.join(ROOT, "FlyingThings3D", "disparity", split, "A",
+                        scene, "left")
+        os.makedirs(ddir, exist_ok=True)
+        frame_utils.write_pfm(osp.join(ddir, "0006.pfm"),
+                              disp.astype(np.float32))
+
+
+write("TRAIN", N_TRAIN, 1)
+write("TEST", N_TEST, 2)
+print("tree at", ROOT, "train", N_TRAIN, "test", N_TEST)
