@@ -273,7 +273,7 @@ def test_train_preemption_checkpoint_and_trace(tmp_path, monkeypatch):
 
     calls = {"n": 0}
 
-    def fake_stop(self):
+    def fake_stop(self, step=0):
         calls["n"] += 1
         return calls["n"] >= 4 or self.requested
 
