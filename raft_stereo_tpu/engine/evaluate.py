@@ -54,9 +54,9 @@ def make_eval_forward(params, cfg: RAFTStereoConfig, iters: int,
     halo exchanges), letting full-resolution frames that exceed one chip's
     HBM evaluate across the pod.
     """
-    overrides = {}
-    if cfg.mixed_precision != mixed_prec:
-        overrides["mixed_precision"] = mixed_prec
+    from raft_stereo_tpu.parallel.mesh import mesh_safe_cfg
+    extra = ({} if cfg.mixed_precision == mixed_prec else
+             {"mixed_precision": mixed_prec})
     if mesh is not None:
         from raft_stereo_tpu.parallel.mesh import (
             data_sharding, replicated, shard_batch)
@@ -65,23 +65,7 @@ def make_eval_forward(params, cfg: RAFTStereoConfig, iters: int,
         # per call would reshard the whole pytree every frame, inside the
         # timed region.
         params = jax.device_put(params, repl)
-        # Compiled Mosaic kernels have no SPMD partitioning rule, so a jit
-        # sharded over a real multi-chip mesh cannot split a pallas_call;
-        # the XLA twins are row-parallel and partition fine. (Wrapping the
-        # kernels in shard_map is the future path.)
-        swap = {"reg_tpu": "reg", "alt_tpu": "alt",
-                "reg_cuda": "reg", "alt_cuda": "alt"}
-        if mesh.shape.get("space", 1) > 1:
-            overrides["fused_update"] = False  # same no-SPMD-rule constraint
-            if cfg.corr_implementation in swap:
-                xla_impl = swap[cfg.corr_implementation]
-                logger.warning(
-                    "spatial sharding cannot partition the %s Pallas kernel; "
-                    "falling back to the XLA '%s' implementation",
-                    cfg.corr_implementation, xla_impl)
-                overrides["corr_implementation"] = xla_impl
-    run_cfg = (cfg if not overrides else
-               RAFTStereoConfig(**{**cfg.__dict__, **overrides}))
+    run_cfg = mesh_safe_cfg(cfg, mesh, **extra)  # warns if kernels stripped
 
     @functools.lru_cache(maxsize=None)
     def compiled(h: int, w: int):

@@ -27,7 +27,10 @@ class _JsonlWriter:
 
     def __init__(self, log_dir: str):
         os.makedirs(log_dir, exist_ok=True)
-        self._f = open(os.path.join(log_dir, "scalars.jsonl"), "a")
+        # Line-buffered: scalars survive crash/SIGKILL paths that never
+        # reach close() (the "never silently dropped" promise above).
+        self._f = open(os.path.join(log_dir, "scalars.jsonl"), "a",
+                       buffering=1)
 
     def add_scalar(self, tag: str, value, step) -> None:
         self._f.write(json.dumps(

@@ -21,7 +21,8 @@ from jax.sharding import Mesh
 from raft_stereo_tpu.config import RAFTStereoConfig
 from raft_stereo_tpu.engine.loss import sequence_loss
 from raft_stereo_tpu.models import raft_stereo_forward
-from raft_stereo_tpu.parallel.mesh import data_sharding, replicated
+from raft_stereo_tpu.parallel.mesh import (data_sharding, mesh_safe_cfg,
+                                           replicated)
 
 
 def make_train_step(cfg: RAFTStereoConfig, tx: optax.GradientTransformation,
@@ -31,6 +32,7 @@ def make_train_step(cfg: RAFTStereoConfig, tx: optax.GradientTransformation,
     batch: dict with ``image1``, ``image2`` (B,H,W,3), ``flow`` (B,H,W,1),
     ``valid`` (B,H,W).
     """
+    cfg = mesh_safe_cfg(cfg, mesh)
 
     def loss_fn(params, batch):
         preds = raft_stereo_forward(params, cfg, batch["image1"], batch["image2"],
@@ -60,6 +62,7 @@ def make_train_step(cfg: RAFTStereoConfig, tx: optax.GradientTransformation,
 def make_eval_step(cfg: RAFTStereoConfig, valid_iters: int,
                    mesh: Optional[Mesh] = None):
     """Returns ``eval_step(params, image1, image2) -> (flow_lr, flow_up)``."""
+    cfg = mesh_safe_cfg(cfg, mesh)
 
     def step(params, image1, image2):
         return raft_stereo_forward(params, cfg, image1, image2,

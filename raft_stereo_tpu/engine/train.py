@@ -88,11 +88,14 @@ class PreemptGuard:
     the SAME step — a host-local check would deadlock the survivors at the
     next psum. The allgather + host sync is NOT free over DCN-connected
     pods, so it runs every ``poll_every`` steps (all processes agree on the
-    step counter, hence on when to poll); preemption grace windows are tens
-    of seconds, so a few steps of polling latency is safe.
+    step counter, hence on when to poll — the cadence must be step-based,
+    not wall-clock, or processes would desynchronize). Default 2: at the
+    slowest observed step rate (~4.3 s/step through the tunnel) that bounds
+    the agreement delay at ~9 s, inside a typical ~30 s SIGTERM grace
+    window; 8 risked exceeding it.
     """
 
-    def __init__(self, poll_every: int = 8):
+    def __init__(self, poll_every: int = 2):
         self.requested = False
         self.poll_every = max(1, poll_every)
         self._prev = None

@@ -119,6 +119,14 @@ def instance_norm(x: jax.Array, *, eps: float = 1e-5) -> jax.Array:
     catastrophic-cancellation risk, which fp32 accumulation over bf16
     inputs keeps benign (values are O(1) post-norm-pre-norm). The fp32
     path keeps the exact two-pass form for reference parity.
+
+    NOTE the benign-cancellation argument is activation-scale-dependent:
+    it holds because every bf16 call site in this model feeds O(1)-scale
+    conv activations. For mean/std ratios around 1e3 the one-pass VARIANCE
+    loses most of its bits while the two-pass form does not
+    (``tests/test_ops.py::test_instance_norm_one_pass_cancellation_bound``
+    pins both against an fp64 oracle); do not reuse this path for
+    large-dynamic-range inputs.
     """
     if x.dtype == jnp.bfloat16:
         mean = jnp.mean(x, axis=(1, 2), keepdims=True, dtype=jnp.float32)

@@ -115,6 +115,43 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def mesh_config_overrides(cfg, mesh: Optional[Mesh]) -> dict:
+    """Config overrides required to run ``cfg`` under ``mesh``.
+
+    Compiled Mosaic/Pallas kernels have no SPMD partitioning rule, so a jit
+    sharded over a real multi-chip ``space`` axis cannot split a
+    ``pallas_call``; the XLA twins are row-parallel and partition fine.
+    Shared by the eval AND train paths (a spatially-sharded train step with
+    ``fused_update`` left on would trace the Pallas scan-body kernels inside
+    the height-sharded jit — compile failure or forced replication).
+    Returns {} when nothing needs changing; warns when it does change
+    something, because the swap is a silent perf cliff otherwise.
+    """
+    if mesh is None or mesh.shape.get("space", 1) <= 1:
+        return {}
+    overrides = {}
+    if getattr(cfg, "fused_update", False):
+        overrides["fused_update"] = False
+    swap = {"reg_tpu": "reg", "alt_tpu": "alt",
+            "reg_cuda": "reg", "alt_cuda": "alt"}
+    impl = getattr(cfg, "corr_implementation", None)
+    if impl in swap:
+        overrides["corr_implementation"] = swap[impl]
+    if overrides:
+        import logging
+        logging.getLogger(__name__).warning(
+            "spatial sharding cannot partition the Pallas kernels; "
+            "applying config overrides %s", overrides)
+    return overrides
+
+
+def mesh_safe_cfg(cfg, mesh: Optional[Mesh], **extra):
+    """``cfg`` with ``mesh_config_overrides`` (+ any ``extra`` overrides)
+    applied; returns the same config class, or ``cfg`` itself unchanged."""
+    ov = {**mesh_config_overrides(cfg, mesh), **extra}
+    return cfg if not ov else type(cfg)(**{**cfg.__dict__, **ov})
+
+
 def local_batch_rows(mesh: Mesh, batch_size: int) -> Optional[slice]:
     """Rows of the global batch whose shards live on THIS process's devices.
 
