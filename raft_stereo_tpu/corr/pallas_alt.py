@@ -56,7 +56,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from raft_stereo_tpu.corr.pallas_reg import (
-    _interpret, gather_lerp_taps, level_widths, pad_width)
+    _interpret, _make_partitioned, gather_lerp_taps, level_widths, pad_width)
 from raft_stereo_tpu.ops.chunked import map_chunked
 
 
@@ -155,12 +155,43 @@ def _masked_alt_xla(f1: jax.Array, f2: jax.Array, coords: jax.Array,
     return map_chunked(chunk, (f1, coords, f2), chunk=8, axis=0)
 
 
+@functools.lru_cache(maxsize=None)
+def _partitioned_alt(radius: int, num_levels: int, widths: Tuple[int, ...],
+                     scale: float, out_dtype_name):
+    """SPMD-partitionable 4D fused build+sample: f1 (B, H, W1, D),
+    f2 (B, H, W2p, D), coords (B, H, W1, 1) -> (B, H, W1, C) — image rows
+    are independent, so any (batch, height) mesh sharding runs the
+    kernel per-shard with no collectives (the feature dim D and the f2
+    row axis must stay unsharded; the Shardy rule marks them
+    need-replication)."""
+    out_dtype = jnp.dtype(out_dtype_name)
+
+    def impl(coords4, f1, f2):
+        b, h, w1, d = f1.shape
+        out = _pallas_alt(f1.reshape(b * h, w1, d),
+                          f2.reshape(b * h, -1, d),
+                          coords4.reshape(b * h, w1, 1),
+                          radius, num_levels, widths, scale, out_dtype)
+        return out.reshape(b, h, w1, -1)
+
+    rule = "b h w u, b h w d, b h v d -> b h w c"
+    # Factors in rule-appearance order (the Shardy verifier requires
+    # it). W1 ('w') must not shard either: f2's third axis is the search
+    # width, not W1, so a w-shard would slice f2's rows out from under
+    # full-width coords.
+    return _make_partitioned(impl, [4, 4, 4], rule,
+                             need_replication_factors=("w", "u", "d", "v",
+                                                       "c"))
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _alt_lookup(f1, f2, coords, radius: int, num_levels: int,
                 widths: Tuple[int, ...], scale: float,
                 out_dtype=jnp.float32):
-    return _pallas_alt(f1, f2, coords, radius, num_levels, widths, scale,
-                       out_dtype)
+    """f1: (B, H, W1, D); f2: (B, H, W2p, D); coords: (B, H, W1, 1)."""
+    fn = _partitioned_alt(radius, num_levels, widths, scale,
+                          jnp.dtype(out_dtype).name)
+    return fn(coords, f1, f2)
 
 
 def _alt_fwd(f1, f2, coords, radius, num_levels, widths, scale, out_dtype):
@@ -171,10 +202,16 @@ def _alt_fwd(f1, f2, coords, radius, num_levels, widths, scale, out_dtype):
 
 def _alt_bwd(radius, num_levels, widths, scale, out_dtype, residuals, g):
     f1, f2, coords = residuals
-    _, vjp = jax.vjp(
-        lambda a, b: _masked_alt_xla(a, b, coords, radius, num_levels,
-                                     widths, scale),
-        f1, f2)
+    bsz, h = f1.shape[:2]
+
+    def flat_oracle(a, b):
+        out = _masked_alt_xla(a.reshape((bsz * h,) + a.shape[2:]),
+                              b.reshape((bsz * h,) + b.shape[2:]),
+                              coords.reshape(bsz * h, -1, 1),
+                              radius, num_levels, widths, scale)
+        return out.reshape((bsz, h) + out.shape[1:])
+
+    _, vjp = jax.vjp(flat_oracle, f1, f2)
     # The oracle emits fp32; a bf16-out kernel hands back a bf16 cotangent.
     df1, df2 = vjp(g.astype(jnp.float32))
     return df1, df2, jnp.zeros_like(coords)
@@ -194,13 +231,13 @@ def make_alt_tpu_corr_fn(fmap1: jax.Array, fmap2: jax.Array, *,
     # in-kernel pooling chain stays aligned (128 = 2^7 covers any level
     # count the model uses).
     f2p = jnp.pad(fmap2, ((0, 0), (0, 0), (0, pad_width(w2) - w2), (0, 0)))
-    f2_flat = f2p.reshape(b * h, -1, d)
-    f1_flat = fmap1.reshape(b * h, w1, d)
 
     def corr_fn(coords_x: jax.Array) -> jax.Array:
-        coords_flat = coords_x.astype(jnp.float32).reshape(b * h, w1, 1)
-        out = _alt_lookup(f1_flat, f2_flat, coords_flat, radius, num_levels,
-                          widths, scale, out_dtype)
-        return out.reshape(b, h, w1, -1)
+        # 4D end to end: batch and height stay real axes, so a
+        # (data, space) mesh sharding of the feature maps flows straight
+        # into the partitioned kernel.
+        coords4 = coords_x.astype(jnp.float32).reshape(b, h, w1, 1)
+        return _alt_lookup(fmap1, f2p, coords4, radius, num_levels,
+                           widths, scale, out_dtype)
 
     return corr_fn

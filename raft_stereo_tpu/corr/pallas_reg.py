@@ -64,7 +64,11 @@ from jax.experimental.pallas import tpu as pltpu
 from raft_stereo_tpu.corr.reg import build_pyramid
 
 LANE = 128
-TILE = 512  # pixels per grid cell (swept 128-1024 on v5e: 512 best by ~1%)
+# Pixels per grid cell. r3 swept 128-1024 and settled on 512; r4's
+# per-step fixed-cost measurement (~5-10 us/step on the remote v5e —
+# 732 steps/lookup ~= 4.4 ms against a ~1.4 ms DMA roofline) says the
+# step COUNT was the real cost: 2048 cuts it 4x for ~11 MB more VMEM.
+TILE = 2048
 
 
 def _interpret() -> bool:
@@ -136,6 +140,103 @@ def gather_lerp_taps(vol, cl, radius: int, w2: int):
     return g[:, :k] * (1.0 - frac) + g[:, 1:k + 1] * frac
 
 
+def _row_sharding(mesh, arg_shapes, ndim: int, n_lead: int = 2):
+    """Sharding along the first ``n_lead`` (row) axes, taken from the
+    first operand; every other axis replicated (for ``alt_tpu`` the
+    third axes disagree between operands — W1 for f1/coords vs the
+    search width for f2 — so only batch and height may shard)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    spec = arg_shapes[0].sharding.spec
+    lead = [spec[i] if i < len(spec) else None for i in range(n_lead)]
+    return NamedSharding(mesh, P(*lead, *([None] * (ndim - n_lead))))
+
+
+def _make_partitioned(impl, ndims: Sequence[int], rule: str,
+                      need_replication_factors: Tuple[str, ...] = ()):
+    """Wrap ``impl`` (positional array args) in a custom_partitioning that
+    splits every operand and the result along their leading axes.
+
+    This is the SPMD story for the correlation kernels: compiled Mosaic
+    kernels have no built-in partitioning rule, but every lookup row
+    (pixel for ``reg_tpu``, image row for ``alt_tpu``) is independent, so
+    the kernel runs unchanged on each device's row shard — the analog of
+    the reference's CUDA sampler running under DataParallel
+    (``core/corr.py:17-29``, ``train_stereo.py:134``). ``rule`` is the
+    einsum-like Shardy sharding rule; the GSPMD callbacks mirror it.
+    """
+    from jax.experimental.custom_partitioning import custom_partitioning
+
+    fn = custom_partitioning(impl)
+
+    def infer(mesh, arg_shapes, result_shape):
+        return _row_sharding(mesh, arg_shapes, result_shape.ndim)
+
+    def partition(mesh, arg_shapes, result_shape):
+        out_sh = _row_sharding(mesh, arg_shapes, result_shape.ndim)
+        arg_sh = tuple(_row_sharding(mesh, arg_shapes, nd) for nd in ndims)
+        return mesh, impl, out_sh, arg_sh
+
+    fn.def_partition(partition, infer_sharding_from_operands=infer,
+                     sharding_rule=rule,
+                     need_replication_factors=need_replication_factors)
+    return fn
+
+
+def make_batch_partitioned(impl, batched_in: Sequence[bool],
+                           in_ndims: Sequence[int],
+                           batched_out: Sequence[bool],
+                           out_ndims: Sequence[int]):
+    """custom_partitioning that splits ONLY the leading batch axis of the
+    flagged operands/results (weights and other replicated small arrays
+    ride along unflagged). Used by the streaming scan-body kernels
+    (``ops/pallas_stream.py``), whose outer grid dimension IS the batch
+    sample — so a data-sharded training step runs them per-shard instead
+    of hitting an unpartitionable ``pallas_call``."""
+    from jax.experimental.custom_partitioning import custom_partitioning
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    fn = custom_partitioning(impl)
+    ops_, results, repl = [], [], []
+    fresh = iter(f"f{i}" for i in range(10000))
+    for flag, nd in zip(batched_in, in_ndims):
+        fs = [next(fresh) for _ in range(nd - 1 if flag else nd)]
+        repl += fs
+        ops_.append(("b " if flag else "") + " ".join(fs))
+    for flag, nd in zip(batched_out, out_ndims):
+        fs = [next(fresh) for _ in range(nd - 1 if flag else nd)]
+        repl += fs
+        results.append(("b " if flag else "") + " ".join(fs))
+    rule = ", ".join(ops_) + " -> " + ", ".join(results)
+
+    def _shardings(mesh, arg_shapes):
+        b_axis = None
+        for flag, s in zip(batched_in, arg_shapes):
+            if flag and len(s.sharding.spec) > 0:
+                b_axis = s.sharding.spec[0]
+                break
+        ins = tuple(
+            NamedSharding(mesh, P(*((b_axis,) if flag else ())
+                                  + (None,) * (nd - (1 if flag else 0))))
+            for flag, nd in zip(batched_in, in_ndims))
+        outs = [
+            NamedSharding(mesh, P(*((b_axis,) if flag else ())
+                                  + (None,) * (nd - (1 if flag else 0))))
+            for flag, nd in zip(batched_out, out_ndims)]
+        return ins, (outs[0] if len(outs) == 1 else tuple(outs))
+
+    def infer(mesh, arg_shapes, result_shape):
+        return _shardings(mesh, arg_shapes)[1]
+
+    def partition(mesh, arg_shapes, result_shape):
+        ins, outs = _shardings(mesh, arg_shapes)
+        return mesh, impl, outs, ins
+
+    fn.def_partition(partition, infer_sharding_from_operands=infer,
+                     sharding_rule=rule,
+                     need_replication_factors=tuple(repl))
+    return fn
+
+
 def _lookup_kernel(coords_ref, *refs, radius: int, widths: Sequence[int]):
     *vol_refs, out_ref = refs
     k = 2 * radius + 1
@@ -165,9 +266,36 @@ def _pallas_lookup(pyramid: Sequence[jax.Array], coords_flat: jax.Array,
                                memory_space=pltpu.VMEM) for p in pyramid],
         out_specs=pl.BlockSpec((TILE, out_ch), lambda i: (i, 0),
                                memory_space=pltpu.VMEM),
+        # The 2048-pixel tile's double-buffered level blocks + fp32
+        # gather temporaries need ~28 MB; the default scoped cap is 16.
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=64 * 2**20),
         interpret=_interpret(),
     )(coords_flat, *pyramid)
     return out
+
+
+@functools.lru_cache(maxsize=None)
+def _partitioned_lookup(radius: int, widths: Tuple[int, ...], out_dtype_name,
+                        nlev: int):
+    """SPMD-partitionable 3D lookup: coords (B, N, 1) + per-level rows
+    (B, N, W2p_l) -> (B, N, nlev*(2r+1)), independent along (B, N) — any
+    mesh sharding of the leading two axes runs the flat kernel per-shard.
+    """
+    out_dtype = jnp.dtype(out_dtype_name)
+
+    def impl(coords3, *pyr3):
+        b, n, _ = coords3.shape
+        flat = [p.reshape(b * n, p.shape[-1]) for p in pyr3]
+        out = _pallas_lookup(flat, coords3.reshape(b * n, 1), radius,
+                             widths, out_dtype)
+        return out.reshape(b, n, -1)
+
+    rule = ("b n u, " + ", ".join(f"b n w{i}" for i in range(nlev))
+            + " -> b n k")
+    # In rule-appearance order (the Shardy verifier requires it).
+    repl = ("u",) + tuple(f"w{i}" for i in range(nlev)) + ("k",)
+    return _make_partitioned(impl, [3] * (nlev + 1), rule,
+                             need_replication_factors=repl)
 
 
 def _masked_lookup_xla(pyramid: Sequence[jax.Array], coords_flat: jax.Array,
@@ -176,7 +304,9 @@ def _masked_lookup_xla(pyramid: Sequence[jax.Array], coords_flat: jax.Array,
 
     Matches the kernel bit-for-bit in exact arithmetic; exists as (a) the
     custom_vjp backward (its VJP is regular VPU/MXU work — scatters don't
-    vectorize on TPU) and (b) an oracle for the kernel tests.
+    vectorize on TPU) and (b) an oracle for the kernel tests. Shape-
+    agnostic over leading axes (used with both flat (N, .) and (B, N, .)
+    row layouts).
     """
     out = []
     for lvl, vol in enumerate(pyramid):
@@ -193,8 +323,8 @@ def _masked_lookup_xla(pyramid: Sequence[jax.Array], coords_flat: jax.Array,
         for t in range(2 * radius + 2):
             onehot = ((j == base + t) & valid_j).astype(jnp.float32)
             taps.append(jnp.sum(vol32 * onehot, axis=-1))
-        g = jnp.stack(taps, axis=-1)  # (N, 2r+2)
-        out.append(g[:, :-1] * (1.0 - frac) + g[:, 1:] * frac)
+        g = jnp.stack(taps, axis=-1)  # (..., 2r+2)
+        out.append(g[..., :-1] * (1.0 - frac) + g[..., 1:] * frac)
     return jnp.concatenate(out, axis=-1)
 
 
@@ -202,7 +332,10 @@ def _masked_lookup_xla(pyramid: Sequence[jax.Array], coords_flat: jax.Array,
 def _lookup(pyramid: List[jax.Array], coords_flat: jax.Array,
             radius: int, widths: Tuple[int, ...],
             out_dtype=jnp.float32) -> jax.Array:
-    return _pallas_lookup(pyramid, coords_flat, radius, widths, out_dtype)
+    """pyramid: per-level (B, N, W2p_l); coords_flat: (B, N, 1)."""
+    fn = _partitioned_lookup(radius, widths, jnp.dtype(out_dtype).name,
+                             len(pyramid))
+    return fn(coords_flat, *pyramid)
 
 
 def _lookup_fwd(pyramid, coords_flat, radius, widths, out_dtype):
@@ -259,11 +392,14 @@ def make_reg_tpu_corr_fn(fmap1: jax.Array, fmap2: jax.Array, *,
             vol = jnp.pad(vol, ((0, 0), (0, 0), (0, 0), (0, want - wp)))
         elif wp > want:
             vol = vol[..., :want]
-        flat.append(vol.reshape(b * h * w1, -1))
+        # (B, H*W1, W2p_l): batch stays a real axis and H (major) merges
+        # with W1 (minor, unsharded) — both mesh axes of a (data, space)
+        # sharding survive the reshape, so the partitioned lookup runs
+        # per-shard under any row mesh.
+        flat.append(vol.reshape(b, h * w1, -1))
 
     def corr_fn(coords_x: jax.Array) -> jax.Array:
-        n = b * h * w1
-        coords_flat = coords_x.astype(jnp.float32).reshape(n, 1)
+        coords_flat = coords_x.astype(jnp.float32).reshape(b, h * w1, 1)
         out = _lookup(flat, coords_flat, radius, widths, out_dtype)
         return out.reshape(b, h, w1, -1)
 

@@ -167,14 +167,16 @@ def validate_eth3d(params, cfg, iters: int = 32, mixed_prec: bool = False,
 
 def validate_kitti(params, cfg, iters: int = 32, mixed_prec: bool = False,
                    root: Optional[str] = None, mesh=None,
-                   bucket: Optional[int] = 64) -> Dict[str, float]:
+                   bucket: Optional[int] = None) -> Dict[str, float]:
     """KITTI-2015 train split: EPE + D1(>3px, per-pixel), FPS protocol.
 
-    ``bucket`` defaults on here (unlike the other validators): KITTI frames
-    come in a handful of near-identical sizes, and the timing protocol only
-    warms up the first shape — bucketing to /64 keeps every timed frame on
-    an already-compiled program instead of timing a recompile. Pass
-    ``bucket=None`` for the reference's exact per-shape padding.
+    The default is the reference-exact protocol (per-shape /32 padding,
+    ``evaluate_stereo.py:77-81``) so a published FPS is apples-to-apples.
+    KITTI frames come in a handful of near-identical sizes and the
+    protocol only warms up the first shape, so a few timed frames pay a
+    one-off compile on this backend; pass ``bucket=64`` to round shapes
+    up so every timed frame runs an already-compiled program (any
+    published number must state which protocol it used).
     """
     kw = {"root": f"{root}/KITTI"} if root else {}
     val_dataset = datasets.KITTI(aug_params=None, image_set="training", **kw)

@@ -3,29 +3,51 @@
 The cnet/fnet stem + layer1 run at FULL image resolution (stride-1 stem
 for ``n_downsample=2``, reference ``core/extractor.py:122-146,199-225``):
 five convs whose activations are ~770 MB each at Middlebury-F. Under XLA
-every conv/norm/relu materializes in HBM and the small-channel (3->64,
-64ch) shapes run far off roofline (profiled ~340 ms per frame for both
-encoders against a ~50 ms bound).
+every conv/norm/relu materializes in HBM — the profiled frame spends
+~150 ms in pure normalize/relu/copy passes and runs the small-channel
+convs far off roofline (~340 ms total for both encoders against a
+~50 ms bound).
 
-Design: ONE streamed pass per conv (ops/pallas_stream.py ring-window
-machinery). Pass k reads conv k-1's RAW output, applies the input
-transform inline — for fnet: relu((x - mean) * inv) with the instance-norm
-stats pass k-1 accumulated in scratch; for cnet the frozen BatchNorm is
-folded into the conv weights (the reference never updates BN —
-``freeze_bn``, ``train_stereo.py:151``), so the same kernels run with
-mean=0, inv=1 — convolves, and writes conv k's raw output while
+Design: ONE streamed pass per conv (ring-window row streaming like
+``ops/pallas_stream.py``). Pass k reads conv k-1's RAW output, applies
+the input transform inline — for fnet: relu((x - mean) * inv) with the
+instance-norm stats pass k-1 accumulated in scratch; for cnet the frozen
+BatchNorm is folded into the conv weights (the reference never updates
+BN — ``freeze_bn``, ``train_stereo.py:151``), so the same kernels run
+with mean=0, inv=1 — convolves, and writes conv k's raw output while
 accumulating its stats. The global-stats barrier between instance-norm
 convs thus costs one HBM round trip per conv, the minimum possible.
 
-Per-pass details that matter on v5e:
-- outputs are emitted BLOCK-ALIGNED (a one-block ring delays the write by
-  one grid step), so chained passes never pay an unaligned-row slice copy
-  of a 770 MB tensor;
-- the 7x7 stem runs as 7 per-dy dots with all 7 dx-taps merged into the
-  dot's N dimension (4 -> 7*64 channels), then cheap shifted slice-adds —
-  49 tiny-K MXU passes would be pipeline-fill-bound;
-- row blocks are tall (th<=24): per-step fixed costs (MXU fill, DMA
-  issue) dominate these low-arithmetic-intensity convs.
+Three structural choices that make this compile AND run fast on v5e:
+
+- **Pixel-pair packed layout.** Every chain tensor lives as
+  ``(H, W/2, 128)`` with channel ``c + 64*(w % 2)`` — two adjacent
+  pixels' 64 channels fill one 128-lane vreg. A 64-channel tensor in
+  the native ``T(8,128)`` tiling wastes HALF of every vector register,
+  HBM tile, and MXU pass; packing halves HBM traffic and fills the
+  MXU's N dimension. A 3x3 conv on the packed layout is the SAME
+  9-dot ring structure (``_conv_rows``) with block-assembled
+  ``(128, 128)`` weights over packed-column offsets (``_pack_w3``).
+- **Width strips.** Mosaic code size — and with it compile time on the
+  remote TPU compiler — scales with the vregs each vector op touches:
+  the structure that compiles in tens of seconds at the GRU kernels'
+  W≈744 takes >10 minutes at full Middlebury-F width (2976), measured.
+  Every pass computes one strip per grid step — grid
+  ``(row_blocks+1, n_strips+1)`` with strips minor. Step (i, s) lands
+  input strip s of row block i into a full-width VMEM ring window
+  (strip-local placement bounds live vregs — a full-width normalize at
+  th=24 spilled ~80 MB), then convolves strip s-1, whose right-halo
+  column was just landed.
+- **The 7x7 stem is a pointwise batched dot.** A stride-1 7x7 conv
+  over 3 channels is pathological everywhere: XLA runs it at ~3% MXU
+  (~20 ms/image); in-kernel tap loops leave the MXU >90% idle; and any
+  narrow-channel patches tensor in channel-minor layout pads 128/x in
+  HBM (the ``conv_general_dilated_patches`` route measured 63 ms/image
+  + OOM-scale padding). Instead XLA builds a TAP-MAJOR packed patches
+  tensor ``(H, 294, W/2)`` (294 = 7*7*3 taps x 2 pixel parities) from
+  cheap W-minor strided slices, and the stem kernel contracts the tap
+  dimension on the MXU in one batched dot per row block, emitting the
+  packed chain layout directly.
 
 Residual structure (reference ResidualBlock, core/extractor.py:6-60):
 x = act(stem); y1 = act(conv1(x)); y2 = conv2(y1);
@@ -46,65 +68,224 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from raft_stereo_tpu.ops.pallas_stream import (
-    _conv_rows, _dot, _interpret, _row_mask, _shift, _zeros)
+    _conv_rows, _interpret, _row_mask, _zeros, _shift)
 
-_ENC_VMEM = 120 * 2**20  # v5e has 128M physical
+_ENC_VMEM = 110 * 2**20  # v5e has 128M physical
 
-# Default-off: the streamed encoder passes are numerically validated
-# (tests/test_fused_stream.py) but the 12-kernel program currently drives
-# the AOT TPU compiler into multi-ten-minute compiles / OOM at full
-# Middlebury-F width, so the production path keeps the XLA encoders.
-# RAFT_FUSED_ENCODERS=1 opts in for experimentation.
 import os as _os
 
-ENABLE = _os.environ.get("RAFT_FUSED_ENCODERS", "0").lower() not in (
+ENABLE = _os.environ.get("RAFT_FUSED_ENCODERS", "1").lower() not in (
     "0", "false", "no", "")
 
 
-def _enc_th(hh: int, width: int) -> int:
-    """Row-block for the encoder passes (single conv + small scratches:
-    tall blocks amortize per-step fixed costs)."""
-    for th in (24, 16, 12, 8, 6, 4, 2):
-        if hh % th == 0 and th * width <= 72 * 1024:
+def _strip_wb(width: int) -> int:
+    """Width-strip size in TRUE columns (0 = unsupported).
+
+    ≤768 computed columns per grid step keeps Mosaic code size in the
+    regime where kernels compile in tens of seconds; wb % 16 == 0 keeps
+    the packed (wb/2-sized) strip slices sublane-aligned (single-strip
+    widths are exempt — their one dynamic slice lands at offset 8)."""
+    for nwb in range(1, 9):
+        wb = width // nwb
+        if width % nwb == 0 and wb <= 768 and (wb % 16 == 0 or nwb == 1):
+            return wb
+    return 0
+
+
+def _enc_th(hh: int, wp: int) -> int:
+    """Row-block over packed-width ``wp`` strips: tall blocks amortize
+    the ~5-10 us/step fixed cost the remote v5e shows; the cap bounds
+    the full-width VMEM ring window."""
+    for th in (48, 32, 24, 16, 12, 8, 6, 4, 2):
+        if hh % th == 0 and th * wp <= 12 * 1024:
             return th
     return 0
 
 
-def _normed(raw, m_ref, v_ref):
+# ---------------------------------------------------------------------------
+# Packed layout helpers: X (H, W, 64) <-> P (H, W/2, 128),
+# P[h, u, c + 64p] = X[h, 2u + p, c].
+# ---------------------------------------------------------------------------
+
+
+def _pack_mv(m, v):
+    """(1, 64) mean/inv -> (1, 128) duplicated across the pixel parity."""
+    return (jnp.concatenate([m, m], axis=-1),
+            jnp.concatenate([v, v], axis=-1))
+
+
+def _pack_bias(b):
+    b = b.reshape(1, -1)
+    return jnp.concatenate([b, b], axis=-1)
+
+
+def _unpack_stats(st):
+    """(2, 128) packed sums -> (2, 64): the two parities' partial sums
+    add per channel."""
+    return st[:, :64] + st[:, 64:]
+
+
+def _pack_w3(w, dtype):
+    """(3, 3, 64, 64) [dy, dx, cin, cout] conv weight -> packed
+    (3, 3, 128, 128) [dy, packed-dx, cin*parity, cout*parity].
+
+    Out parity 0 (true col 2u) taps true cols 2u-1 (hi of packed u-1),
+    2u (lo of u), 2u+1 (hi of u); parity 1 taps 2u (lo u), 2u+1 (hi u),
+    2u+2 (lo u+1). Laid out so the packed conv is the same
+    three-packed-column ring walk as the unpacked one."""
+    z = jnp.zeros_like(w[0, 0])
+    packed = []
+    for dy in range(3):
+        wm1, w0, wp1 = w[dy, 0], w[dy, 1], w[dy, 2]
+        pm1 = jnp.block([[z, z], [wm1, z]])      # packed col u-1
+        p0 = jnp.block([[w0, wm1], [wp1, w0]])   # packed col u
+        pp1 = jnp.block([[z, wp1], [z, z]])      # packed col u+1
+        packed.append(jnp.stack([pm1, p0, pp1]))
+    return jnp.stack(packed).astype(dtype)
+
+
+def _normed(raw, m, v):
     """relu((raw - mean) * inv) in fp32 -> raw.dtype."""
-    x = raw.astype(jnp.float32) - m_ref[...].astype(jnp.float32)
-    return jax.nn.relu(x * v_ref[...].astype(jnp.float32)).astype(raw.dtype)
+    x = raw.astype(jnp.float32) - m.astype(jnp.float32)
+    return jax.nn.relu(x * v.astype(jnp.float32)).astype(raw.dtype)
 
 
-def _conv7_rows(scr, w7, th, width):
-    """7x7 conv over a (>=th+6, width+6, 4) window: 7 per-dy dots with the
-    7 dx-taps stacked along N (4 -> 7*Cout), then shifted slice-adds."""
-    cout = w7.shape[-1] // 7
-    acc = None
-    for dy in range(7):
-        r = _dot(scr[dy:dy + th], w7[dy])
-        for dx in range(7):
-            y = r[:, dx:dx + width, dx * cout:(dx + 1) * cout]
-            acc = y if acc is None else acc + y
-    return acc
+def _stats_update(scr_st, st_ref, contrib):
+    """Accumulate per-channel sum / sum-of-squares over valid rows."""
+    scr_st[0] += jnp.sum(contrib, axis=(0, 1))
+    scr_st[1] += jnp.sum(jnp.square(contrib), axis=(0, 1))
+    st_ref[...] = scr_st[...]
 
 
-def _aligned_out(out_ref, scr_prev, new, lag: int, th: int):
-    """Emit block max(i-1, 0) = true rows [(i-1)T, iT) from the previous
-    step's tail + this step's head; keeps outputs block-aligned so chained
-    passes never pay an unaligned-row slice copy."""
-    out_ref[0:th - lag] = scr_prev[lag:th]
-    out_ref[th - lag:th] = new[0:lag]
-    scr_prev[...] = new
+# ---------------------------------------------------------------------------
+# Stem: tap-major packed patches (XLA) + one batched dot (kernel).
+# ---------------------------------------------------------------------------
 
 
-def _pass_kernel(*refs, kind: str, th: int, nb: int, width: int, hh: int,
+def stem_patches_packed(x: jax.Array) -> jax.Array:
+    """(1, H, W, 3) image -> (294, H, W/2) tap-major packed patches.
+
+    Row t + 147*p (tap t = ci*49 + dy*7 + dx, parity p) at packed column
+    u holds the zero-padded image value x[h+dy-3, 2u+p+dx-3, ci]. Taps
+    OUTER-most: the stack's natural layout keeps W/2 minor, so the build
+    is one slice-concat fusion with no relayout copy (a channel-minor
+    patches layout pads 128/C in HBM, and stacking taps as a middle axis
+    measured a 1.76 GB layout copy behind the fusion)."""
+    b, hh, width, cin = x.shape
+    assert b == 1
+    # Split the padded image into even/odd columns ONCE (the only
+    # strided reads — strided DMA runs ~10x off bandwidth, so doing it
+    # 294 times measured ~60 ms/image); every tap slice below is then
+    # contiguous. Padded col pc = true + 3; tap (dy, dx, parity p) for
+    # out col 2u+p reads pc = 2u + (p+dx): parity (p+dx)%2, col
+    # u + (p+dx)//2.
+    xp = jnp.pad(x[0], ((3, 3), (3, 5), (0, 0)))  # (H+6, W+8, 3)
+    xr = xp.reshape(hh + 6, (width + 8) // 2, 2, cin)
+    halves = (xr[:, :, 0], xr[:, :, 1])  # (H+6, W/2+4, 3) each
+    wp = width // 2
+    rows = []
+    for p in range(2):
+        for ci in range(cin):
+            for dy in range(7):
+                for dx in range(7):
+                    k = (p + dx) // 2
+                    src = halves[(p + dx) % 2]
+                    rows.append(
+                        jax.lax.slice(src, (dy, k, ci),
+                                      (dy + hh, k + wp, ci + 1))[:, :, 0])
+    return jnp.stack(rows, axis=0)
+
+
+def _stem_weights(w: jax.Array, dtype) -> jax.Array:
+    """(7, 7, 3, 64) -> packed (294, 128): tap-major rows in
+    ``stem_patches_packed`` order, parity-block-diagonal columns."""
+    cout = w.shape[-1]
+    flat = w.astype(jnp.float32).transpose(2, 0, 1, 3).reshape(-1, cout)
+    z = jnp.zeros_like(flat)
+    return jnp.block([[flat, z], [z, flat]]).astype(dtype)
+
+
+def _stem_kernel(x_ref, w_ref, b_ref, out_ref, st_ref, scr_st, *,
                  stats: bool):
-    """kind: 'stem7' (7x7 on the raw 4-ch image), 'mid1'
-    (relu(norm(x)) -> 3x3), 'mid2' (relu(relu(norm(a)) + relu(norm(b)))
-    -> 3x3), 'point3' (relu(relu(relu(norm(s)) + relu(norm(y2)))
-    + relu(norm(y4))), no conv)."""
     i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        if stats:
+            scr_st[...] = jnp.zeros(scr_st.shape, scr_st.dtype)
+
+    # (294, th, W/2) x (294, 128) -> (th, W/2, 128): per image row, one
+    # transposed-lhs 2D dot contracts the tap dim (the MXU feeds the
+    # transpose; Mosaic has no shape cast for a 3D outer-dim
+    # contraction). No slicing, no rings — full-width blocks keep the
+    # code tiny (lane-dim blocks must be 128-multiples or whole, so
+    # strips can't cut the packed width here anyway).
+    x = x_ref[...]
+    th = x.shape[1]
+    bias = b_ref[...].astype(jnp.float32)
+    rows = []
+    for r in range(th):
+        out_r = jax.lax.dot_general(
+            x[:, r], w_ref[...], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) + bias
+        out_ref[r] = out_r.astype(out_ref.dtype)
+        rows.append(out_r)
+    if stats:
+        out = jnp.stack(rows)
+        _stats_update(scr_st, st_ref, out)
+
+
+def _stem_th(hh: int, wp_total: int, taps: int) -> int:
+    """Stem row block: bound the (taps, th, W/2) input block to ~14 MB.
+    th sits on the block's sublane dim, so it must be a multiple of 8."""
+    for th in (16, 8):
+        if hh % th == 0 and th * taps * wp_total * 2 <= 14 * 2**20:
+            return th
+    return 0
+
+
+def _run_stem(x294, w, bias, hh, wp_total, dtype, stats: bool):
+    """x294: (294, H, W/2). Returns packed raw (H, W/2, 128) + stats."""
+    taps = x294.shape[0]
+    th = _stem_th(hh, wp_total, taps)
+    nb = hh // th
+    kernel = functools.partial(_stem_kernel, stats=stats)
+    outs = pl.pallas_call(
+        kernel,
+        grid=(nb,),
+        in_specs=[pl.BlockSpec((taps, th, wp_total), lambda i: (0, i, 0),
+                               memory_space=pltpu.VMEM),
+                  pl.BlockSpec(w.shape, lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+                  pl.BlockSpec(bias.shape, lambda i: (0, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=(pl.BlockSpec((th, wp_total, 128), lambda i: (i, 0, 0),
+                                memory_space=pltpu.VMEM),
+                   pl.BlockSpec((2, 128), lambda i: (0, 0),
+                                memory_space=pltpu.VMEM)),
+        out_shape=(jax.ShapeDtypeStruct((hh, wp_total, 128), dtype),
+                   jax.ShapeDtypeStruct((2, 128), jnp.float32)),
+        scratch_shapes=[pltpu.VMEM((2, 128), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_ENC_VMEM),
+        interpret=_interpret(),
+    )(x294, w, bias)
+    return outs if stats else (outs[0], None)
+
+
+# ---------------------------------------------------------------------------
+# 3x3 conv passes ('mid1': one normed input; 'mid2': relu(normed a +
+# normed b)) and the final combine+unpack ('point3').
+# ---------------------------------------------------------------------------
+
+
+def _pass_kernel(*refs, kind: str, th: int, nb: int, nwb: int, wp: int,
+                 hh: int, stats: bool):
+    """Grid (nb+1, nwb+1), strips minor; all widths in packed columns.
+    Step (i, s) lands input strip s of row block i into the full-width
+    ring window, then convolves strip s-1 (whose right-halo column was
+    just landed; the extra s=nwb step convolves the last strip, whose
+    right halo is image-edge zero pad)."""
+    i, s = pl.program_id(0), pl.program_id(1)
     k = 0
 
     def take(n):
@@ -113,72 +294,172 @@ def _pass_kernel(*refs, kind: str, th: int, nb: int, width: int, hh: int,
         k += n
         return r
 
-    if kind == "stem7":
-        (img_ref,), (w_ref, b_ref) = take(1), take(2)
-    elif kind == "mid1":
+    if kind == "mid1":
         (x_ref, m_ref, v_ref), (w_ref, b_ref) = take(3), take(2)
-    elif kind == "mid2":
+    else:  # mid2
         (a_ref, ma_ref, va_ref, b2_ref, mb_ref, vb_ref) = take(6)
         (w_ref, b_ref) = take(2)
-    else:  # point3
-        (s_ref, ms_ref, vs_ref, y2_ref, m2_ref, v2_ref,
-         y4_ref, m4_ref, v4_ref) = take(9)
-        (out_ref,) = take(1)
-        o1 = jax.nn.relu(
-            _normed(s_ref[...], ms_ref, vs_ref).astype(jnp.float32)
-            + _normed(y2_ref[...], m2_ref, v2_ref))
-        out_ref[...] = jax.nn.relu(
-            o1 + _normed(y4_ref[...], m4_ref, v4_ref)).astype(out_ref.dtype)
-        return
-
     out_ref = take(1)[0]
     st_ref = take(1)[0] if stats else None
     scr_in, scr_prev = take(2)
     scr_st = take(1)[0] if stats else None
     dtype = out_ref.dtype
-    lag = 3 if kind == "stem7" else 1
-    pad = 3 if kind == "stem7" else 1
 
-    @pl.when(i == 0)
+    @pl.when((i == 0) & (s == 0))
     def _init():
         _zeros(scr_in)
+        _zeros(scr_prev)
         if stats:
             scr_st[...] = jnp.zeros(scr_st.shape, scr_st.dtype)
 
-    _shift(scr_in, 2 * lag)
+    @pl.when(s == 0)
+    def _roll():
+        _shift(scr_in, 2)
 
-    @pl.when(i < nb)
+    # The ring window carries an 8-packed-column x-pad on each side:
+    # Mosaic requires dynamic sublane slice starts to be provable
+    # 8-multiples, so placement writes at 8 + s*wp and the conv reads an
+    # aligned (wp+16)-wide window, slicing its interior statically.
+    @pl.when((s < nwb) & (i < nb))
     def _place():
-        if kind == "stem7":
-            scr_in[2 * lag:2 * lag + th, pad:width + pad] = img_ref[...]
-        elif kind == "mid1":
-            scr_in[2 * lag:2 * lag + th, pad:width + pad] = _normed(
-                x_ref[...], m_ref, v_ref)
+        if kind == "mid1":
+            v = _normed(x_ref[...], m_ref[...], v_ref[...])
         else:
-            o1 = jax.nn.relu(
-                _normed(a_ref[...], ma_ref, va_ref).astype(jnp.float32)
-                + _normed(b2_ref[...], mb_ref, vb_ref)).astype(dtype)
-            scr_in[2 * lag:2 * lag + th, pad:width + pad] = o1
+            v = jax.nn.relu(
+                _normed(a_ref[...], ma_ref[...], va_ref[...])
+                .astype(jnp.float32)
+                + _normed(b2_ref[...], mb_ref[...], vb_ref[...])
+            ).astype(dtype)
+        scr_in[2:2 + th, pl.ds(pl.multiple_of(8 + s * wp, 8), wp)] = v
 
-    @pl.when(i >= nb)
+    @pl.when((s < nwb) & (i >= nb))
     def _flush():
-        _zeros(scr_in, slice(2 * lag, 2 * lag + th))
+        _zeros(scr_in,
+               (slice(2, 2 + th), pl.ds(pl.multiple_of(8 + s * wp, 8), wp)))
 
-    if kind == "stem7":
-        acc = _conv7_rows(scr_in, w_ref, th, width)
-    else:
-        acc = _conv_rows(scr_in, w_ref, th, width)
-    out = acc + b_ref[...].astype(jnp.float32)
-    new = out.astype(dtype)
-    _aligned_out(out_ref, scr_prev, new, lag, th)
+    @pl.when(s > 0)
+    def _conv():
+        # Strip s-1, output rows [i*TH-1, (i+1)*TH-1): the aligned
+        # (wp+16)-wide window starting at (s-1)*wp has the conv support
+        # [strip start - 1, strip end + 1) at cols [7, wp+9).
+        win8 = scr_in[:, pl.ds(pl.multiple_of((s - 1) * wp, 8), wp + 16)]
+        win = win8[:, 7:wp + 9]
+        acc = _conv_rows(win, w_ref, th, wp)
+        out = acc + b_ref[...].astype(jnp.float32)
+        new = out.astype(dtype)
+        # Block-aligned emission: block i-1 = previous step's tail + this
+        # step's head (the conv lags one row); i=0 parks in the trash
+        # block.
+        out_ref[0:th - 1] = scr_prev[s - 1, 1:th]
+        out_ref[th - 1:th] = new[0:1]
+        scr_prev[s - 1] = new
+        if stats:
+            # Rows outside [0, H) occur only at the first (row -1) and
+            # flush (rows >= H) steps; interior steps skip the mask pass.
+            @pl.when((i > 0) & (i < nb))
+            def _st_interior():
+                _stats_update(scr_st, st_ref, out)
 
+            @pl.when((i == 0) | (i >= nb))
+            def _st_edge():
+                _stats_update(scr_st, st_ref, _row_mask(i, -1, th, hh, out))
+
+
+def _point3_kernel(s_ref, ms_ref, vs_ref, y2_ref, m2_ref, v2_ref,
+                   y4_ref, m4_ref, v4_ref, out_ref):
+    o1 = jax.nn.relu(
+        _normed(s_ref[...], ms_ref[...], vs_ref[...]).astype(jnp.float32)
+        + _normed(y2_ref[...], m2_ref[...], v2_ref[...]))
+    o2 = jax.nn.relu(
+        o1 + _normed(y4_ref[...], m4_ref[...], v4_ref[...])
+    ).astype(out_ref.dtype)
+    out_ref[...] = o2  # packed; the caller unpacks via one XLA reshape
+
+
+def _run_pass(kind, inputs, w, bias, hh, wp_total, wb, dtype,
+              stats: bool):
+    """One streamed pass over packed (H?, W/2, 128) chain tensors.
+
+    inputs: list of (raw, mean128, inv128) triples whose raw arrays may
+    carry trailing trash rows (the upstream pass's lag block) — index
+    maps only ever touch the first ``hh`` rows; mid outputs carry one
+    trash row-block themselves (only point3 exits exact)."""
+    wp = wb // 2
+    th = _enc_th(hh, wp)
+    nb, nwb = hh // th, wp_total // wp
+
+    if kind == "point3":
+        in_specs, args = [], []
+        for raw, m, v in inputs:
+            in_specs.append(pl.BlockSpec((th, wp, 128),
+                                         lambda i, s: (i, s, 0),
+                                         memory_space=pltpu.VMEM))
+            args.append(raw)
+            for t in (m, v):
+                in_specs.append(pl.BlockSpec(t.shape, lambda i, s: (0, 0),
+                                             memory_space=pltpu.VMEM))
+                args.append(t)
+        return pl.pallas_call(
+            _point3_kernel,
+            grid=(nb, nwb),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((th, wp, 128), lambda i, s: (i, s, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((hh, wp_total, 128), dtype),
+            compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_ENC_VMEM),
+            interpret=_interpret(),
+        )(*args)
+
+    def idx_in(i, s):
+        return (jnp.minimum(i, nb - 1), jnp.minimum(s, nwb - 1), 0)
+
+    in_specs, args = [], []
+    for raw, m, v in inputs:
+        in_specs.append(pl.BlockSpec((th, wp, 128), idx_in,
+                                     memory_space=pltpu.VMEM))
+        args.append(raw)
+        for t in (m, v):
+            in_specs.append(pl.BlockSpec(t.shape, lambda i, s: (0, 0),
+                                         memory_space=pltpu.VMEM))
+            args.append(t)
+
+    for t in (w, bias):
+        in_specs.append(pl.BlockSpec(t.shape,
+                                     lambda i, s, nd=t.ndim: (0,) * nd,
+                                     memory_space=pltpu.VMEM))
+        args.append(t)
+
+    kernel = functools.partial(_pass_kernel, kind=kind, th=th, nb=nb,
+                               nwb=nwb, wp=wp, hh=hh, stats=stats)
+    # Conv of strip s-1 emits block (i-1, s-1); the i=0 and s=0 visits
+    # park in the trash row-block nb, so no real block is revisited.
+    out_specs = [pl.BlockSpec(
+        (th, wp, 128),
+        lambda i, s: (jnp.where((i == 0) | (s == 0), nb, i - 1),
+                      jnp.where(s == 0, 0, s - 1), 0),
+        memory_space=pltpu.VMEM)]
+    out_shape = [jax.ShapeDtypeStruct(((nb + 1) * th, wp_total, 128), dtype)]
     if stats:
-        # Running sums over VALID out rows (conv-of-zero + bias at the
-        # top/flush rows would poison the next pass's normalize).
-        contrib = _row_mask(i, -lag, th, hh, out)
-        scr_st[0] += jnp.sum(contrib, axis=(0, 1))
-        scr_st[1] += jnp.sum(jnp.square(contrib), axis=(0, 1))
-        st_ref[...] = scr_st[...]
+        out_specs.append(pl.BlockSpec((2, 128), lambda i, s: (0, 0),
+                                      memory_space=pltpu.VMEM))
+        out_shape.append(jax.ShapeDtypeStruct((2, 128), jnp.float32))
+    scratch = [pltpu.VMEM((th + 2, wp_total + 16, 128), dtype),
+               pltpu.VMEM((nwb, th, wp, 128), dtype)]
+    if stats:
+        scratch.append(pltpu.VMEM((2, 128), jnp.float32))
+    outs = pl.pallas_call(
+        kernel,
+        grid=(nb + 1, nwb + 1),
+        in_specs=in_specs,
+        out_specs=tuple(out_specs) if stats else out_specs[0],
+        out_shape=tuple(out_shape) if stats else out_shape[0],
+        scratch_shapes=scratch,
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_ENC_VMEM),
+        interpret=_interpret(),
+    )(*args)
+    if not stats:
+        return outs, None
+    return outs[0], outs[1]
 
 
 def _stats_to_mv(stats, n: int, eps: float = 1e-5):
@@ -187,119 +468,54 @@ def _stats_to_mv(stats, n: int, eps: float = 1e-5):
     return mean.reshape(1, -1), jax.lax.rsqrt(var + eps).reshape(1, -1)
 
 
-def _run_pass(kind, inputs, w, bias, hh, width, cout, dtype, stats: bool):
-    """One streamed pass. inputs: list of (raw(H,W,C), mean, inv) triples
-    ((img4, None, None) for stem7). Returns (raw_out(H,W,cout), stats?)."""
-    th = _enc_th(hh, width)
-    nb = hh // th
-    lag = 0 if kind == "point3" else (3 if kind == "stem7" else 1)
-    grid = nb + 1 if lag else nb
-
-    def idx_in(i):
-        return (jnp.minimum(i, nb - 1), 0, 0)
-
-    in_specs, args = [], []
-    for raw, m, v in inputs:
-        in_specs.append(pl.BlockSpec((th, width, raw.shape[-1]), idx_in,
-                                     memory_space=pltpu.VMEM))
-        args.append(raw)
-        if m is not None:
-            for t in (m, v):
-                in_specs.append(pl.BlockSpec(t.shape, lambda i: (0, 0),
-                                             memory_space=pltpu.VMEM))
-                args.append(t)
-    if kind != "point3":
-        for t in (w, bias):
-            in_specs.append(pl.BlockSpec(t.shape,
-                                         lambda i, nd=t.ndim: (0,) * nd,
-                                         memory_space=pltpu.VMEM))
-            args.append(t)
-
-    kernel = functools.partial(_pass_kernel, kind=kind, th=th, nb=nb,
-                               width=width, hh=hh, stats=stats)
-    common = dict(
-        grid=(grid,), in_specs=in_specs,
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_ENC_VMEM),
-        interpret=_interpret())
-    if kind == "point3":
-        return pl.pallas_call(
-            kernel,
-            out_specs=pl.BlockSpec((th, width, cout), lambda i: (i, 0, 0),
-                                   memory_space=pltpu.VMEM),
-            out_shape=jax.ShapeDtypeStruct((hh, width, cout), dtype),
-            **common)(*args)
-
-    out_specs = [pl.BlockSpec((th, width, cout),
-                              lambda i: (jnp.maximum(i - 1, 0), 0, 0),
-                              memory_space=pltpu.VMEM)]
-    out_shape = [jax.ShapeDtypeStruct((hh, width, cout), dtype)]
-    if stats:
-        out_specs.append(pl.BlockSpec((2, cout), lambda i: (0, 0),
-                                      memory_space=pltpu.VMEM))
-        out_shape.append(jax.ShapeDtypeStruct((2, cout), jnp.float32))
-    scratch = [pltpu.VMEM((th + 2 * lag, width + 2 * pad_of(kind),
-                           inputs[0][0].shape[-1]), dtype),
-               pltpu.VMEM((th, width, cout), dtype)]
-    if stats:
-        scratch.append(pltpu.VMEM((2, cout), jnp.float32))
-    outs = pl.pallas_call(
-        kernel, out_specs=tuple(out_specs) if stats else out_specs[0],
-        out_shape=tuple(out_shape) if stats else out_shape[0],
-        scratch_shapes=scratch, **common)(*args)
-    return outs if stats else (outs, None)
-
-
-def pad_of(kind: str) -> int:
-    return 3 if kind == "stem7" else 1
-
-
-def _stem7_weights(w, dtype):
-    """(7,7,3,Cout) -> per-dy merged-N (7, 4, 7*Cout): channel-pad K to 4,
-    stack the dx taps along N."""
-    cout = w.shape[-1]
-    w4 = jnp.pad(w.astype(jnp.float32), ((0, 0), (0, 0), (0, 1), (0, 0)))
-    return w4.transpose(0, 2, 1, 3).reshape(7, 4, 7 * cout).astype(dtype)
-
-
 def _ident_mv(c):
     return jnp.zeros((1, c), jnp.float32), jnp.ones((1, c), jnp.float32)
 
 
-def _fold_bn(conv: dict, bn: dict, dtype, eps: float = 1e-5):
-    """Fold frozen-BN stats into the preceding conv (fp32 fold, one cast)."""
+def _fold_bn(conv: dict, bn: dict, eps: float = 1e-5):
+    """Fold frozen-BN stats into the preceding conv (fp32 fold)."""
     k = (bn["scale"] * jax.lax.rsqrt(bn["var"] + eps)).astype(jnp.float32)
     w = conv["w"].astype(jnp.float32) * k
     b = (conv.get("b", 0.0) - bn["mean"]) * k + bn["bias"]
-    return w.astype(dtype), jnp.asarray(b, jnp.float32).reshape(1, -1)
+    return w, jnp.asarray(b, jnp.float32)
 
 
-def _trunk_passes(x4, convs, hh, width, dtype, instance: bool):
-    """Shared stem+layer1 chain. convs: [(w_stem7, b), (w3x3, b) x4] — BN
-    pre-folded for the frozen-BN (cnet) trunk, raw for instance norm."""
+def _trunk_passes(x294, convs, hh, width, dtype, instance: bool):
+    """Shared stem+layer1 chain over packed tensors. convs:
+    [(w_stem(7,7,3,64), b), (w3x3(3,3,64,64), b) x4] — BN pre-folded for
+    the frozen-BN (cnet) trunk, raw for instance norm."""
     n = hh * width
+    wb = _strip_wb(width)
+    wp_total = width // 2
 
-    def mv(st, c):
-        return _stats_to_mv(st, n) if instance else _ident_mv(c)
+    def mv(st):
+        m, v = (_stats_to_mv(_unpack_stats(st), n) if instance
+                else _ident_mv(64))
+        return _pack_mv(m, v)
 
     (ws, bs), (w1, b1), (w2, b2), (w3, b3), (w4, b4) = convs
-    stem, st = _run_pass("stem7", [(x4, None, None)], ws, bs,
-                         hh, width, 64, dtype, instance)
-    m1, v1 = mv(st, 64)
-    y1, st = _run_pass("mid1", [(stem, m1, v1)], w1, b1,
-                       hh, width, 64, dtype, instance)
-    my, vy = mv(st, 64)
-    y2, st = _run_pass("mid1", [(y1, my, vy)], w2, b2,
-                       hh, width, 64, dtype, instance)
-    m2, v2 = mv(st, 64)
-    y3, st = _run_pass("mid2", [(stem, m1, v1), (y2, m2, v2)], w3, b3,
-                       hh, width, 64, dtype, instance)
-    m3, v3 = mv(st, 64)
-    y4, st = _run_pass("mid1", [(y3, m3, v3)], w4, b4,
-                       hh, width, 64, dtype, instance)
-    m4, v4 = mv(st, 64)
+    wpk = [(_pack_w3(w.astype(jnp.float32), dtype), _pack_bias(b))
+           for w, b in ((w1, b1), (w2, b2), (w3, b3), (w4, b4))]
+    stem, st = _run_stem(x294, _stem_weights(ws, dtype), _pack_bias(bs),
+                         hh, wp_total, dtype, instance)
+    m1, v1 = mv(st)
+    y1, st = _run_pass("mid1", [(stem, m1, v1)], *wpk[0],
+                       hh, wp_total, wb, dtype, instance)
+    my, vy = mv(st)
+    y2, st = _run_pass("mid1", [(y1, my, vy)], *wpk[1],
+                       hh, wp_total, wb, dtype, instance)
+    m2, v2 = mv(st)
+    y3, st = _run_pass("mid2", [(stem, m1, v1), (y2, m2, v2)], *wpk[2],
+                       hh, wp_total, wb, dtype, instance)
+    m3, v3 = mv(st)
+    y4, st = _run_pass("mid1", [(y3, m3, v3)], *wpk[3],
+                       hh, wp_total, wb, dtype, instance)
+    m4, v4 = mv(st)
     o2 = _run_pass("point3", [(stem, m1, v1), (y2, m2, v2), (y4, m4, v4)],
-                   None, None, hh, width, 64, dtype, False)
-    return o2[None]
+                   None, None, hh, wp_total, wb, dtype, False)
+    # The chain's one exit from the packed layout (Mosaic has no shape
+    # cast for the lane->sublane unpack; XLA does it in one fused copy).
+    return o2.reshape(hh, wp_total, 2, 64).reshape(hh, width, 64)[None]
 
 
 def fused_stem_layer1_impl(p: dict, x: jax.Array):
@@ -308,13 +524,12 @@ def fused_stem_layer1_impl(p: dict, x: jax.Array):
     assert b == 1
     dtype = x.dtype
     blk1, blk2 = p["layer1"]
-    ws, bs = _fold_bn(p["conv1"], p["norm1"], jnp.float32)
-    convs = [(_stem7_weights(ws, dtype), bs)]
+    convs = [_fold_bn(p["conv1"], p["norm1"])]
     for blk in (blk1, blk2):
-        convs.append(_fold_bn(blk["conv1"], blk["norm1"], dtype))
-        convs.append(_fold_bn(blk["conv2"], blk["norm2"], dtype))
-    x4 = jnp.pad(x, ((0, 0), (0, 0), (0, 0), (0, 1)))[0]
-    return _trunk_passes(x4, convs, hh, width, dtype, instance=False)
+        convs.append(_fold_bn(blk["conv1"], blk["norm1"]))
+        convs.append(_fold_bn(blk["conv2"], blk["norm2"]))
+    x294 = stem_patches_packed(x)
+    return _trunk_passes(x294, convs, hh, width, dtype, instance=False)
 
 
 def fused_in_stem_layer1_impl(p: dict, x: jax.Array):
@@ -325,22 +540,25 @@ def fused_in_stem_layer1_impl(p: dict, x: jax.Array):
     blk1, blk2 = p["layer1"]
 
     def cb(conv):
-        return conv["w"].astype(dtype), conv["b"].reshape(1, -1)
+        return conv["w"], conv["b"]
 
-    convs = [(_stem7_weights(p["conv1"]["w"], dtype),
-              p["conv1"]["b"].reshape(1, -1)),
-             cb(blk1["conv1"]), cb(blk1["conv2"]),
+    convs = [cb(p["conv1"]), cb(blk1["conv1"]), cb(blk1["conv2"]),
              cb(blk2["conv1"]), cb(blk2["conv2"])]
-    x4 = jnp.pad(x, ((0, 0), (0, 0), (0, 0), (0, 1)))[0]
-    return _trunk_passes(x4, convs, hh, width, dtype, instance=True)
+    x294 = stem_patches_packed(x)
+    return _trunk_passes(x294, convs, hh, width, dtype, instance=True)
 
 
 def _fusable(p: dict, x, stride: int) -> bool:
     from raft_stereo_tpu.ops.pallas_stream import _dtype_ok
     if not ENABLE:
         return False
+    if x.ndim != 4 or x.shape[2] % 2:
+        return False
+    wb = _strip_wb(x.shape[2])
     if not (_dtype_ok(x) and x.shape[0] == 1 and stride == 1
-            and x.shape[1] >= 24 and _enc_th(x.shape[1], x.shape[2]) > 0):
+            and x.shape[1] >= 16 and wb > 0 and wb % 2 == 0
+            and _enc_th(x.shape[1], wb // 2) > 0
+            and _stem_th(x.shape[1], x.shape[2] // 2, 294) > 0):
         return False
     blk1, blk2 = p["layer1"]
     # Identity shortcuts only (stride-1 equal-channel layer1 blocks).

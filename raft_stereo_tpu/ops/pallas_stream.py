@@ -144,7 +144,7 @@ def _gru_kernel(h_ref, czrq_ref, *rest, np_: int, th: int, nb: int,
     if head:
         scr_hn, scr_f1 = rest[k:k + 2]
 
-    i = pl.program_id(0)
+    i = pl.program_id(1)  # row step; program_id(0) is the batch sample
     dtype = h_ref.dtype
 
     @pl.when(i == 0)
@@ -164,9 +164,9 @@ def _gru_kernel(h_ref, czrq_ref, *rest, np_: int, th: int, nb: int,
 
     @pl.when(i < nb)
     def _place():
-        scr_h[3:3 + th, 1:width + 1] = h_ref[...]
+        scr_h[3:3 + th, 1:width + 1] = h_ref[0]
         for p, c0, c1 in zip(part_refs, coffs[:-1], coffs[1:]):
-            scr_x[2:2 + th, 1:width + 1, c0:c1] = p[...]
+            scr_x[2:2 + th, 1:width + 1, c0:c1] = p[0]
 
     @pl.when(i >= nb)
     def _flush():
@@ -176,7 +176,7 @@ def _gru_kernel(h_ref, czrq_ref, *rest, np_: int, th: int, nb: int,
     # ---- preact rows [i*TH-1, (i+1)*TH-1): all-gate x-side conv, z/r
     # h-side conv, nonlinearities (czrq arrives pre-shifted to these rows).
     acc_x = _conv_rows(scr_x, wx_ref, th, width)
-    acc_x = acc_x + czrq_ref[...].astype(jnp.float32)
+    acc_x = acc_x + czrq_ref[0].astype(jnp.float32)
     acc_h = _conv_rows(scr_h[1:], whzr_ref, th, width)
 
     z_new = jax.nn.sigmoid(acc_h[..., :ch] + acc_x[..., :ch]).astype(dtype)
@@ -195,7 +195,7 @@ def _gru_kernel(h_ref, czrq_ref, *rest, np_: int, th: int, nb: int,
     q = jnp.tanh(acc_q).astype(dtype)
     z = scr_z[0:th]
     h_new = (1 - z) * scr_h[0:th, 1:width + 1] + z * q
-    out_ref[...] = h_new
+    out_ref[0] = h_new
 
     if head:
         # ---- FlowHead chained on h': conv1+relu rows [i*TH-4, ...),
@@ -209,82 +209,103 @@ def _gru_kernel(h_ref, czrq_ref, *rest, np_: int, th: int, nb: int,
         scr_f1[2:2 + th, 1:width + 1] = _row_mask(i, -4, th, hh,
                                                   f1.astype(dtype))
         dx = _conv_rows(scr_f1, w2_ref, th, width)
-        dx_ref[...] = dx[..., 0].astype(dx_ref.dtype)
+        dx_ref[0] = dx[..., 0].astype(dx_ref.dtype)
 
 
 def _gru_pallas(h, parts, czrq, whzr, whq, wx_full, th: int, head):
+    """Batch rides as the OUTER grid dimension: the row stream restarts
+    (ring scratch re-zeroed at row step 0) for every sample, so training
+    batches get the same fused scan body as B=1 eval (r3 fenced them to
+    the XLA chain; reference analog: the CUDA sampler serving training
+    at batch 8, ``README.md:106``)."""
     b, hh, width, ch = h.shape
-    assert b == 1, "streaming kernel is per-sample (B folded by caller)"
     nb = hh // th
     lag = 5 if head else 3
     grid = pl.cdiv(hh + lag, th)
-    h3 = h[0]
-    parts3 = [p[0] for p in parts]
-    np_ = len(parts3)
+    np_ = len(parts)
     # czrq arrives pre-shifted/pre-padded from prepare_gru_context (hoisted
     # out of the scan — padding it here would re-run a 300 MB pass per
     # iteration).
-    czrq3 = czrq[0]
-    assert czrq3.shape[0] >= grid * th, (czrq3.shape, grid, th)
+    assert czrq.shape[1] >= grid * th, (czrq.shape, grid, th)
 
-    def idx_in(i):
-        return (jnp.minimum(i, nb - 1), 0, 0)
+    def idx_in(bi, i):
+        return (bi, jnp.minimum(i, nb - 1), 0, 0)
 
     coffs = [0]
-    for p in parts3:
+    for p in parts:
         coffs.append(coffs[-1] + p.shape[-1])
     kernel = functools.partial(_gru_kernel, np_=np_, th=th, nb=nb,
                                width=width, ch=ch, head=head is not None,
                                hh=hh, coffs=tuple(coffs))
     in_specs = (
-        [pl.BlockSpec((th, width, ch), idx_in, memory_space=pltpu.VMEM),
-         pl.BlockSpec((th, width, 3 * ch), lambda i: (i, 0, 0),
+        [pl.BlockSpec((1, th, width, ch), idx_in, memory_space=pltpu.VMEM),
+         pl.BlockSpec((1, th, width, 3 * ch), lambda bi, i: (bi, i, 0, 0),
                       memory_space=pltpu.VMEM)] +
-        [pl.BlockSpec((th, width, p.shape[-1]), idx_in,
-                      memory_space=pltpu.VMEM) for p in parts3] +
-        [pl.BlockSpec(w.shape, lambda i, nd=w.ndim: (0,) * nd,
+        [pl.BlockSpec((1, th, width, p.shape[-1]), idx_in,
+                      memory_space=pltpu.VMEM) for p in parts] +
+        [pl.BlockSpec(w.shape, lambda bi, i, nd=w.ndim: (0,) * nd,
                       memory_space=pltpu.VMEM)
          for w in [whzr, whq, wx_full]])
-    out_specs = [pl.BlockSpec((th, width, ch), lambda i: (i, 0, 0),
+    out_specs = [pl.BlockSpec((1, th, width, ch),
+                              lambda bi, i: (bi, i, 0, 0),
                               memory_space=pltpu.VMEM)]
-    out_shape = [jax.ShapeDtypeStruct((grid * th, width, ch), h.dtype)]
+    out_shape = [jax.ShapeDtypeStruct((b, grid * th, width, ch), h.dtype)]
     scratch = [pltpu.VMEM((th + 3, width + 2, ch), h.dtype),     # h window
                pltpu.VMEM((th + 3, width + 2, ch), h.dtype),     # r*h window
                pltpu.VMEM((th + 2, width, ch), h.dtype),         # z ring
                pltpu.VMEM((th + 2, width, ch), jnp.float32),     # aq_x ring
                pltpu.VMEM((th + 2, width + 2, coffs[-1]), h.dtype)]  # x parts
-    inputs = [h3, czrq3, *parts3, whzr, whq, wx_full]
+    inputs = [h, czrq, *parts, whzr, whq, wx_full]
     if head is not None:
         w1, b1, w2 = head
-        in_specs += [pl.BlockSpec(w1.shape, lambda i: (0,) * 4,
+        in_specs += [pl.BlockSpec(w1.shape, lambda bi, i: (0,) * 4,
                                   memory_space=pltpu.VMEM),
-                     pl.BlockSpec(b1.shape, lambda i: (0, 0),
+                     pl.BlockSpec(b1.shape, lambda bi, i: (0, 0),
                                   memory_space=pltpu.VMEM),
-                     pl.BlockSpec(w2.shape, lambda i: (0,) * 4,
+                     pl.BlockSpec(w2.shape, lambda bi, i: (0,) * 4,
                                   memory_space=pltpu.VMEM)]
-        out_specs.append(pl.BlockSpec((th, width), lambda i: (i, 0),
+        out_specs.append(pl.BlockSpec((1, th, width),
+                                      lambda bi, i: (bi, i, 0),
                                       memory_space=pltpu.VMEM))
         out_shape.append(
-            jax.ShapeDtypeStruct((grid * th, width), jnp.float32))
+            jax.ShapeDtypeStruct((b, grid * th, width), jnp.float32))
         scratch += [pltpu.VMEM((th + 2, width + 2, ch), h.dtype),  # h' window
                     pltpu.VMEM((th + 2, width + 2, w1.shape[-1]), h.dtype)]
         inputs += [w1, b1, w2]
 
-    outs = pl.pallas_call(
-        kernel,
-        grid=(grid,),
-        in_specs=in_specs,
-        out_specs=tuple(out_specs) if head is not None else out_specs[0],
-        out_shape=tuple(out_shape) if head is not None else out_shape[0],
-        scratch_shapes=scratch,
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
-        interpret=_interpret(),
-    )(*inputs)
+    def call(*arrs):
+        return pl.pallas_call(
+            kernel,
+            grid=(arrs[0].shape[0], grid),
+            in_specs=in_specs,
+            out_specs=tuple(out_specs) if head is not None else out_specs[0],
+            out_shape=(
+                tuple(jax.ShapeDtypeStruct((arrs[0].shape[0],) + o.shape[1:],
+                                           o.dtype) for o in out_shape)
+                if head is not None else
+                jax.ShapeDtypeStruct((arrs[0].shape[0],) + out_shape[0]
+                                     .shape[1:], out_shape[0].dtype)),
+            scratch_shapes=scratch,
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=_VMEM_LIMIT),
+            interpret=_interpret(),
+        )(*arrs)
+
+    # Batch is the kernel's outer grid dim, so a data-sharded batch runs
+    # per-shard — the partitioning rule that lets fused training ride a
+    # multi-chip data mesh (weights replicate).
+    from raft_stereo_tpu.corr.pallas_reg import make_batch_partitioned
+    batched_in = [True, True] + [True] * np_ + [False] * (len(inputs) - 2
+                                                          - np_)
+    call_p = make_batch_partitioned(
+        call, batched_in, [a.ndim for a in inputs],
+        [True] * len(out_shape), [o.ndim for o in out_shape])
+    outs = call_p(*inputs)
     if head is None:
-        return outs[3:3 + hh][None], None
+        return outs[:, 3:3 + hh], None
     # h' streams at lag 3; the chained FlowHead delta trails 2 convs behind.
     h_out, dx_out = outs
-    return h_out[3:3 + hh][None], dx_out[5:5 + hh][None, ..., None]
+    return h_out[:, 3:3 + hh], dx_out[:, 5:5 + hh][..., None]
 
 
 def gru_weights(p: dict, ch: int):
@@ -414,11 +435,11 @@ fused_gru_head.defvjp(_fused_gru_head_fwd, _fused_gru_head_bwd)
 
 
 def gru_is_fusable(h, *x_list) -> bool:
-    """Shapes/dtype the streaming kernel supports; callers fall back to the
-    XLA path otherwise (fp32 runs exceed the VMEM budget at full res; B>1
-    would turn the batch into an outer Pallas grid dim and break the
-    ``program_id(0)`` streaming logic, so training batches stay on XLA)."""
-    return (_dtype_ok(h) and h.shape[0] == 1
+    """Shapes/dtype the streaming kernel supports; callers fall back to
+    the XLA path otherwise (fp32 runs exceed the VMEM budget at full
+    res). Batch rides as the outer grid dimension since r4, so training
+    batches fuse too."""
+    return (_dtype_ok(h)
             and pick_th(h.shape[1], h.shape[2]) > 0 and h.shape[1] >= 8)
 
 
@@ -439,7 +460,7 @@ def _motion_kernel(corr_ref, pat_ref, flow_ref, w1_ref, b1_ref, w2_ref,
                    b2_ref, wf_ref, bf_ref, out_ref, scr_s1, scr_s2, scr_fl,
                    *, th: int, nb: int, width: int, cfused: int, hh: int,
                    ncorr: int):
-    i = pl.program_id(0)
+    i = pl.program_id(1)  # row step; program_id(0) is the batch sample
     dtype = corr_ref.dtype
 
     @pl.when(i == 0)
@@ -455,14 +476,14 @@ def _motion_kernel(corr_ref, pat_ref, flow_ref, w1_ref, b1_ref, w2_ref,
     # computes both branches — [c1 | f1] = relu([corr | patches] @
     # blockdiag(wc1, wf1) + [bc1 | bf1]). The two inputs stay separate
     # refs; their dots accumulate into one fp32 buffer.
-    acc1 = _dot(corr_ref[...], w1_ref[0:ncorr])
-    acc1 = acc1 + _dot(pat_ref[...], w1_ref[ncorr:])
+    acc1 = _dot(corr_ref[0], w1_ref[0:ncorr])
+    acc1 = acc1 + _dot(pat_ref[0], w1_ref[ncorr:])
     s1v = jax.nn.relu(acc1 + b1_ref[...].astype(jnp.float32)).astype(dtype)
 
     @pl.when(i < nb)
     def _place():
         scr_s1[2:2 + th, 1:width + 1] = s1v
-        scr_fl[2:2 + th] = flow_ref[...]
+        scr_fl[2:2 + th] = flow_ref[0]
 
     @pl.when(i >= nb)
     def _flush():
@@ -482,8 +503,8 @@ def _motion_kernel(corr_ref, pat_ref, flow_ref, w1_ref, b1_ref, w2_ref,
     # channels 126:128.
     acc = _conv_rows(scr_s2, wf_ref, th, width)
     fused = jax.nn.relu(acc + bf_ref[...].astype(jnp.float32)).astype(dtype)
-    out_ref[:, :, :cfused] = fused
-    out_ref[:, :, cfused:] = scr_fl[0:th]
+    out_ref[0, :, :, :cfused] = fused
+    out_ref[0, :, :, cfused:] = scr_fl[0:th]
 
 
 def flow_patches(flow, dtype):
@@ -508,7 +529,6 @@ def _blockdiag3x3(wa, wb):
 
 def fused_motion_fwd_impl(p: dict, flow, corr):
     b, hh, width, ccorr = corr.shape
-    assert b == 1
     dtype = corr.dtype
     th = pick_th(hh, width)
     nb = hh // th
@@ -538,43 +558,58 @@ def fused_motion_fwd_impl(p: dict, flow, corr):
     wf = p["conv"]["w"].astype(dtype)  # verbatim: input order [c2 ; f2]
     bf = p["conv"]["b"].reshape(1, -1)
     cfused = wf.shape[-1]
-    pat = flow_patches(flow[..., :1], dtype)[0]
+    pat = flow_patches(flow[..., :1], dtype)
     npat = pat.shape[-1]
     ns1 = 2 * n1
 
-    def idx_in(i):
-        return (jnp.minimum(i, nb - 1), 0, 0)
+    def idx_in(bi, i):
+        return (bi, jnp.minimum(i, nb - 1), 0, 0)
 
     kernel = functools.partial(_motion_kernel, th=th, nb=nb, width=width,
                                cfused=cfused, hh=hh, ncorr=ccorr)
     weights = (w1, b1, w2, b2, wf, bf)
-    out = pl.pallas_call(
-        kernel,
-        grid=(grid,),
-        in_specs=[pl.BlockSpec((th, width, ccorr), idx_in,
-                               memory_space=pltpu.VMEM),
-                  pl.BlockSpec((th, width, npat), idx_in,
-                               memory_space=pltpu.VMEM),
-                  pl.BlockSpec((th, width, flow.shape[-1]), idx_in,
-                               memory_space=pltpu.VMEM)] +
-                 [pl.BlockSpec(w.shape, lambda i, nd=w.ndim: (0,) * nd,
-                               memory_space=pltpu.VMEM)
-                  for w in weights],
-        out_specs=pl.BlockSpec((th, width, cfused + 2),
-                               lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((grid * th, width, cfused + 2), dtype),
-        scratch_shapes=[
-            pltpu.VMEM((th + 2, width + 2, ns1), dtype),
-            pltpu.VMEM((th + 2, width + 2, ns1), dtype),
-            pltpu.VMEM((th + 2, width, flow.shape[-1]), dtype)],
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
-        interpret=_interpret(),
-    )(corr[0], pat, flow.astype(dtype)[0], *weights)
-    return out[lag:lag + hh][None]
+
+    def call(*arrs):
+        return pl.pallas_call(
+            kernel,
+            grid=(arrs[0].shape[0], grid),
+            in_specs=[pl.BlockSpec((1, th, width, ccorr), idx_in,
+                                   memory_space=pltpu.VMEM),
+                      pl.BlockSpec((1, th, width, npat), idx_in,
+                                   memory_space=pltpu.VMEM),
+                      pl.BlockSpec((1, th, width, flow.shape[-1]), idx_in,
+                                   memory_space=pltpu.VMEM)] +
+                     [pl.BlockSpec(w.shape,
+                                   lambda bi, i, nd=w.ndim: (0,) * nd,
+                                   memory_space=pltpu.VMEM)
+                      for w in weights],
+            out_specs=pl.BlockSpec((1, th, width, cfused + 2),
+                                   lambda bi, i: (bi, i, 0, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct(
+                (arrs[0].shape[0], grid * th, width, cfused + 2), dtype),
+            scratch_shapes=[
+                pltpu.VMEM((th + 2, width + 2, ns1), dtype),
+                pltpu.VMEM((th + 2, width + 2, ns1), dtype),
+                pltpu.VMEM((th + 2, width, flow.shape[-1]), dtype)],
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=_VMEM_LIMIT),
+            interpret=_interpret(),
+        )(*arrs)
+
+    # Same batch-axis partitioning rule as the GRU kernel (grid dim 0 is
+    # the sample): data-sharded batches run per-shard.
+    from raft_stereo_tpu.corr.pallas_reg import make_batch_partitioned
+    args = [corr, pat, flow.astype(dtype), *weights]
+    call_p = make_batch_partitioned(
+        call, [True, True, True] + [False] * len(weights),
+        [a.ndim for a in args], [True], [4])
+    out = call_p(*args)
+    return out[:, lag:lag + hh]
 
 
 def motion_is_fusable(corr) -> bool:
-    return (_dtype_ok(corr) and corr.shape[0] == 1
+    return (_dtype_ok(corr)
             and pick_th(corr.shape[1], corr.shape[2]) > 0 and corr.shape[1] >= 8)
 
 

@@ -118,30 +118,27 @@ def replicated(mesh: Mesh) -> NamedSharding:
 def mesh_config_overrides(cfg, mesh: Optional[Mesh]) -> dict:
     """Config overrides required to run ``cfg`` under ``mesh``.
 
-    Compiled Mosaic/Pallas kernels have no SPMD partitioning rule, so a jit
-    sharded over a real multi-chip ``space`` axis cannot split a
-    ``pallas_call``; the XLA twins are row-parallel and partition fine.
-    Shared by the eval AND train paths (a spatially-sharded train step with
-    ``fused_update`` left on would trace the Pallas scan-body kernels inside
-    the height-sharded jit — compile failure or forced replication).
-    Returns {} when nothing needs changing; warns when it does change
-    something, because the swap is a silent perf cliff otherwise.
+    The correlation kernels carry their own SPMD partitioning rule
+    (``corr/pallas_reg.py:_make_partitioned`` — row-parallel along batch
+    and height, the analog of the reference's CUDA sampler under
+    DataParallel), so every ``corr_implementation`` now survives any
+    mesh unchanged. The streaming scan-body kernels
+    (``ops/pallas_stream.py``) are row-sequential with ring-carried conv
+    halos, which a height shard cannot cut; under a real ``space`` axis
+    the update chain falls back to its partitionable XLA twin. Shared by
+    the eval AND train paths; warns when it changes something, because
+    the swap is a perf cliff otherwise.
     """
     if mesh is None or mesh.shape.get("space", 1) <= 1:
         return {}
     overrides = {}
     if getattr(cfg, "fused_update", False):
         overrides["fused_update"] = False
-    swap = {"reg_tpu": "reg", "alt_tpu": "alt",
-            "reg_cuda": "reg", "alt_cuda": "alt"}
-    impl = getattr(cfg, "corr_implementation", None)
-    if impl in swap:
-        overrides["corr_implementation"] = swap[impl]
     if overrides:
         import logging
         logging.getLogger(__name__).warning(
-            "spatial sharding cannot partition the Pallas kernels; "
-            "applying config overrides %s", overrides)
+            "spatial sharding cannot split the streaming scan-body "
+            "kernels; applying config overrides %s", overrides)
     return overrides
 
 

@@ -1,0 +1,58 @@
+"""Probe the fused-encoder compile wall: compile+run one fnet trunk pass
+chain and the cnet trunk at a given shape, timing compile vs run.
+
+  ENC_H/ENC_W  shape (default 1024x1504)
+  ENC_CACHE    set to a dir to enable the persistent compilation cache
+"""
+import sys; sys.path.insert(0, "/root/repo")
+import os, time
+os.environ["RAFT_FUSED_ENCODERS"] = "1"
+
+import numpy as np
+import jax, jax.numpy as jnp
+
+cache = os.environ.get("ENC_CACHE")
+if cache:
+    jax.config.update("jax_compilation_cache_dir", cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+from raft_stereo_tpu.models.extractor import (init_basic_encoder,
+                                              init_multi_basic_encoder)
+from raft_stereo_tpu.ops.pallas_encoder import (fused_in_stem_layer1,
+                                                fused_stem_layer1)
+
+h = int(os.environ.get("ENC_H", 1024))
+w = int(os.environ.get("ENC_W", 1504))
+which = os.environ.get("ENC_WHICH", "both")
+
+rng = np.random.default_rng(0)
+x = jnp.asarray(rng.uniform(-1, 1, (1, h, w, 3)), jnp.bfloat16)
+
+if which in ("both", "cnet"):
+    pc = init_multi_basic_encoder(jax.random.PRNGKey(0), [[128] * 3] * 2,
+                                  norm_fn="batch", downsample=2)
+    f = jax.jit(lambda p, x: jnp.sum(fused_stem_layer1(p, x)
+                                     .astype(jnp.float32)))
+    t0 = time.perf_counter()
+    out = f(pc, x)
+    v = float(out)
+    t1 = time.perf_counter()
+    print(f"cnet trunk {h}x{w}: compile+first-run {t1-t0:.1f}s sum={v:.1f}",
+          flush=True)
+    t0 = time.perf_counter()
+    float(f(pc, x))
+    print(f"  steady run {time.perf_counter()-t0:.3f}s", flush=True)
+
+if which in ("both", "fnet"):
+    pf = init_basic_encoder(jax.random.PRNGKey(1), 256, "instance", 2)
+    f = jax.jit(lambda p, x: jnp.sum(fused_in_stem_layer1(p, x)
+                                     .astype(jnp.float32)))
+    t0 = time.perf_counter()
+    v = float(f(pf, x))
+    t1 = time.perf_counter()
+    print(f"fnet trunk {h}x{w}: compile+first-run {t1-t0:.1f}s sum={v:.1f}",
+          flush=True)
+    t0 = time.perf_counter()
+    float(f(pf, x))
+    print(f"  steady run {time.perf_counter()-t0:.3f}s", flush=True)

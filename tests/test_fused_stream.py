@@ -64,6 +64,40 @@ def test_fused_gru_matches_oracle(h_, w_, ch, parts_c, dtype, tol):
     assert derr < 3 * tol, derr
 
 
+def test_fused_gru_and_motion_batched_match_per_sample():
+    """B>1 rides as the outer Pallas grid dim (r4): every sample's row
+    stream must restart cleanly — asserted by BIT-equality between the
+    batched run and per-sample B=1 runs (a ring that leaks rows across
+    the sample boundary shows up immediately), plus the oracle check."""
+    key = jax.random.PRNGKey(0)
+    B, h_, w_, ch = 3, 16, 24, 64
+    p = init_conv_gru(key, ch, 2 * ch)
+    ks = jax.random.split(key, 8)
+    h = jax.random.normal(ks[0], (B, h_, w_, ch)) * 0.5
+    xs = [jax.random.normal(k, (B, h_, w_, ch)) for k in ks[1:3]]
+    ctx = tuple(jax.random.normal(k, (B, h_, w_, ch)) * 0.3
+                for k in ks[3:6])
+    czrq = prepare_gru_context(p, ctx, jnp.float32)
+    ref = apply_conv_gru(p, h, ctx, *xs)
+    got, _ = fused_conv_gru_fwd_impl(p, h, czrq, *xs)
+    assert float(jnp.abs(got - ref).max()) < 1e-4
+    for b in range(B):
+        g1, _ = fused_conv_gru_fwd_impl(p, h[b:b + 1], czrq[b:b + 1],
+                                        *[x[b:b + 1] for x in xs])
+        assert float(jnp.abs(got[b:b + 1] - g1).max()) == 0.0
+
+    cfg = RAFTStereoConfig()
+    pm = init_motion_encoder(key, cfg)
+    corr = jax.random.normal(key, (B, h_, w_, cfg.cor_planes))
+    flow = jax.random.normal(key, (B, h_, w_, 2)).at[..., 1].set(0.0)
+    refm = apply_motion_encoder(pm, flow, corr)
+    gotm = fused_motion_fwd_impl(pm, flow, corr)
+    assert float(jnp.abs(gotm - refm).max()) < 1e-3
+    for b in range(B):
+        g1 = fused_motion_fwd_impl(pm, flow[b:b + 1], corr[b:b + 1])
+        assert float(jnp.abs(gotm[b:b + 1] - g1).max()) == 0.0
+
+
 def test_fused_motion_integer_exact():
     cfg = RAFTStereoConfig()
     rng = np.random.default_rng(0)
@@ -138,15 +172,23 @@ def test_bf16_test_mode_fused_runs(rng):
     assert np.isfinite(np.asarray(up3, dtype=np.float32)).all()
 
 
-def test_fused_cnet_stem_layer1_matches_oracle():
-    """Streaming frozen-BN stem+layer1 (ops/pallas_encoder.py) vs XLA."""
+@pytest.mark.parametrize("hw", [(48, 24), (16, 800)])
+def test_fused_cnet_stem_layer1_matches_oracle(hw):
+    """Streaming frozen-BN stem+layer1 (ops/pallas_encoder.py) vs XLA.
+
+    (16, 800) exercises the MULTI-strip path (nwb=2): the 8-aligned
+    dynamic strip placement, the strip-delayed conv with its cross-strip
+    halo columns, the per-strip delay rings and the trash-block output
+    index maps — none of which the single-strip width 24 touches."""
     from raft_stereo_tpu.models.extractor import init_multi_basic_encoder
     from raft_stereo_tpu.ops.pallas_encoder import (
-        fused_stem_layer1_impl, _oracle)
+        fused_stem_layer1_impl, _oracle, _strip_wb)
+    h_, w_ = hw
+    assert (_strip_wb(w_) < w_) == (w_ == 800)  # (16,800) is multi-strip
     key = jax.random.PRNGKey(0)
     p = init_multi_basic_encoder(key, output_dim=[[128] * 3, [128] * 3],
                                  norm_fn="batch", downsample=2)
-    x = jax.random.normal(key, (1, 48, 24, 3))
+    x = jax.random.normal(key, (1, h_, w_, 3))
     ref = np.asarray(_oracle(p, x))
     got = np.asarray(fused_stem_layer1_impl(p, x))
     d = np.abs(got - ref)
@@ -156,15 +198,17 @@ def test_fused_cnet_stem_layer1_matches_oracle():
     assert d[0].max(axis=(1, 2)).std() < d.max()  # no row stands out
 
 
-def test_fused_fnet_stem_layer1_matches_oracle():
+@pytest.mark.parametrize("hw", [(48, 24), (16, 800)])
+def test_fused_fnet_stem_layer1_matches_oracle(hw):
     """Streamed one-pass-per-conv instance-norm stem+layer1 vs XLA."""
     from raft_stereo_tpu.models.extractor import init_basic_encoder
     from raft_stereo_tpu.ops.pallas_encoder import (
         fused_in_stem_layer1_impl, _in_oracle)
+    h_, w_ = hw
     key = jax.random.PRNGKey(0)
     p = init_basic_encoder(key, output_dim=256, norm_fn="instance",
                            downsample=2)
-    x = jax.random.normal(key, (1, 48, 24, 3))
+    x = jax.random.normal(key, (1, h_, w_, 3))
     ref = np.asarray(_in_oracle(p, x))
     got = np.asarray(fused_in_stem_layer1_impl(p, x))
     assert np.abs(got - ref).max() < 5e-2, np.abs(got - ref).max()

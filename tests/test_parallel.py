@@ -137,20 +137,20 @@ def test_spatial_sharded_train_step_matches_single(rng):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
-def test_spatial_train_step_strips_pallas_kernels(rng):
+def test_spatial_train_step_strips_stream_kernels(rng):
     """ADVICE r3 (medium): a spatially-sharded TRAIN step with
-    fused_update/reg_tpu requested must strip the Pallas kernels exactly
-    like the eval path. The stripping is asserted directly on the shared
-    guard (running the step alone proves nothing — interpret-mode Pallas
-    happens to partition on the CPU mesh, unlike compiled Mosaic), then the
-    stripped step is run end-to-end."""
+    fused_update requested must strip the streaming scan-body kernels
+    exactly like the eval path (their ring-carried conv halos cannot be
+    cut by a height shard). The correlation kernels carry their own SPMD
+    partitioning rule since r4 and must NOT be stripped. Asserted
+    directly on the shared guard, then the stripped step is run
+    end-to-end with the partitioned reg_tpu kernel."""
     from raft_stereo_tpu.parallel.mesh import mesh_config_overrides
     cfg = RAFTStereoConfig(n_gru_layers=1, fused_update=True,
                            corr_implementation="reg_tpu",
                            mixed_precision=True)
     mesh = make_mesh(n_data=1, n_space=8)
-    assert mesh_config_overrides(cfg, mesh) == {
-        "fused_update": False, "corr_implementation": "reg"}
+    assert mesh_config_overrides(cfg, mesh) == {"fused_update": False}
     assert mesh_config_overrides(cfg, None) == {}
     assert mesh_config_overrides(cfg, make_mesh(n_data=8, n_space=1)) == {}
 
@@ -161,6 +161,56 @@ def test_spatial_train_step_strips_pallas_kernels(rng):
     _, _, metrics = step(jax.tree.map(jnp.copy, params), tx.init(params),
                          shard_batch(batch, mesh, spatial=True))
     assert np.isfinite(float(metrics["loss"]))
+
+
+@pytest.mark.parametrize("impl", ["reg_tpu", "alt_tpu"])
+@pytest.mark.parametrize("n_data,n_space", [(8, 1), (2, 4), (1, 8)])
+def test_partitioned_corr_kernels_match_reg(rng, impl, n_data, n_space):
+    """The Pallas correlation kernels run UNDER the mesh (VERDICT r3 #2):
+    equality with the XLA ``reg`` oracle for data-only, mixed and
+    space-only shardings, with zero collectives in the compiled program
+    (the custom_partitioning row rule splits them; nothing is gathered).
+
+    Interpret mode on CPU pins the partitioning semantics; the kernel
+    body itself is oracled on-chip by tests/test_corr_tpu.py."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from raft_stereo_tpu.corr import make_corr_fn
+
+    b, h, w, d = 8, 16, 32, 16
+    f1 = jnp.asarray(rng.standard_normal((b, h, w, d)).astype(np.float32))
+    f2 = jnp.asarray(rng.standard_normal((b, h, w, d)).astype(np.float32))
+    coords = jnp.asarray(
+        rng.uniform(-3, w + 3, (b, h, w)).astype(np.float32))
+    ref = make_corr_fn("reg", f1, f2, num_levels=4, radius=4)(coords)
+
+    mesh = make_mesh(n_data=n_data, n_space=n_space)
+    sh = NamedSharding(mesh, P("data", "space"))
+
+    def fwd(f1, f2, c):
+        return make_corr_fn(impl, f1, f2, num_levels=4, radius=4)(c)
+
+    jf = jax.jit(fwd, in_shardings=(sh, sh, sh), out_shardings=sh)
+    args = [jax.device_put(x, sh) for x in (f1, f2, coords)]
+    out = jf(*args)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    txt = jf.lower(*args).compile().as_text()
+    assert "all-gather" not in txt and "all-reduce" not in txt
+
+    # Gradients flow per-shard through the custom_vjp too.
+    def loss(f1, f2, c):
+        return jnp.sum(fwd(f1, f2, c) ** 2)
+
+    g = jax.jit(jax.grad(loss, argnums=(0, 1)),
+                in_shardings=(sh, sh, sh))(*args)
+    g_ref = jax.grad(
+        lambda a, b2: jnp.sum(
+            make_corr_fn("reg", a, b2, num_levels=4, radius=4)(coords) ** 2),
+        argnums=(0, 1))(f1, f2)
+    np.testing.assert_allclose(np.asarray(g[0]), np.asarray(g_ref[0]),
+                               atol=2e-4)
+    np.testing.assert_allclose(np.asarray(g[1]), np.asarray(g_ref[1]),
+                               atol=2e-4)
 
 
 def test_eval_step_sharded(rng):
