@@ -137,9 +137,41 @@ def main() -> None:
 
     flops = None
     try:
-        cost = forward.lower(params, img1, img2).compile().cost_analysis()
-        if cost:
-            flops = float(cost.get("flops", 0.0)) or None
+        # Algorithmic flops from the XLA-twin program (fused_update off,
+        # XLA corr): the production path's Pallas custom calls are
+        # invisible to XLA cost analysis, which would understate MFU.
+        # Computed by a CPU-platform subprocess — lowered (uncompiled)
+        # HLO analysis takes ~2 s there, while the axon platform's
+        # lowering path measured minutes.
+        import subprocess
+        import sys
+        code = (
+            "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+            "import jax.numpy as jnp\n"
+            "from raft_stereo_tpu.config import RAFTStereoConfig\n"
+            "from raft_stereo_tpu.models import init_raft_stereo, "
+            "raft_stereo_forward\n"
+            f"cfg = RAFTStereoConfig(corr_implementation='reg', "
+            f"mixed_precision={mixed}, fused_update=False, "
+            f"shared_backbone={cfg.shared_backbone}, "
+            f"n_downsample={cfg.n_downsample}, "
+            f"n_gru_layers={cfg.n_gru_layers}, "
+            f"slow_fast_gru={cfg.slow_fast_gru})\n"
+            "params = init_raft_stereo(jax.random.PRNGKey(0), cfg)\n"
+            f"img = jnp.zeros(({batch}, {h}, {w}, 3), jnp.float32)\n"
+            "def fwd(p, a, b):\n"
+            "    _, up = raft_stereo_forward(p, cfg, a, b, "
+            f"iters={iters}, test_mode=True)\n"
+            "    return up\n"
+            "ca = jax.jit(fwd).lower(params, img, img).cost_analysis()\n"
+            "print('FLOPS', ca.get('flops', 0.0) if ca else 0.0)\n")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        out = subprocess.run([sys.executable, "-c", code], env=env,
+                             cwd=os.path.dirname(os.path.abspath(__file__)),
+                             capture_output=True, text=True, timeout=300)
+        for line in out.stdout.splitlines():
+            if line.startswith("FLOPS "):
+                flops = float(line.split()[1]) or None
     except Exception:  # noqa: BLE001 - diagnostics only
         pass
 

@@ -119,12 +119,12 @@ def gather_lerp_taps(vol, cl, radius: int, w2: int):
         for s in range(1, nslab - 1):
             win_b = jnp.where(slab_b == s, vol[:, s * LANE:(s + 1) * LANE],
                               win_b)
-        # Fine: Mosaic's take_along_axis works on exactly one 128-lane vreg;
-        # the 2r+2-tap window may straddle the slab boundary, so gather both
-        # slabs and select per tap. Lane t then holds tap t. The gather
-        # operands upcast to fp32 HERE — Mosaic's dynamic_gather requires
-        # the index and result bitwidths to match (i32 indices), so only
-        # the two selected slabs pay the conversion, not the whole row.
+        # Fine: Mosaic's take_along_axis works on exactly one 128-lane vreg
+        # AND only in 32-bit (index/result bitwidths must match, indices
+        # are i32 — a bf16 gather was tried in r4 and rejected by Mosaic),
+        # so the two selected slabs upcast here; the 2r+2-tap window may
+        # straddle the slab boundary, so gather both slabs and select per
+        # tap. Lane t then holds tap t.
         rel = base - slab * LANE + lane  # [0, 128+2r+1] when in range
         g_a = jnp.take_along_axis(win_a.astype(jnp.float32),
                                   jnp.clip(rel, 0, LANE - 1), axis=-1)
@@ -182,13 +182,13 @@ def _make_partitioned(impl, ndims: Sequence[int], rule: str,
     return fn
 
 
-def make_batch_partitioned(impl, batched_in: Sequence[bool],
+def make_batch_partitioned(impl, batch_in_axes: Sequence,
                            in_ndims: Sequence[int],
-                           batched_out: Sequence[bool],
+                           batch_out_axes: Sequence,
                            out_ndims: Sequence[int]):
-    """custom_partitioning that splits ONLY the leading batch axis of the
-    flagged operands/results (weights and other replicated small arrays
-    ride along unflagged). Used by the streaming scan-body kernels
+    """custom_partitioning that splits ONLY the batch axis (given per
+    operand/result; None = fully replicated — weights and other small
+    arrays ride along). Used by the streaming scan-body kernels
     (``ops/pallas_stream.py``), whose outer grid dimension IS the batch
     sample — so a data-sharded training step runs them per-shard instead
     of hitting an unpartitionable ``pallas_call``."""
@@ -198,30 +198,38 @@ def make_batch_partitioned(impl, batched_in: Sequence[bool],
     fn = custom_partitioning(impl)
     ops_, results, repl = [], [], []
     fresh = iter(f"f{i}" for i in range(10000))
-    for flag, nd in zip(batched_in, in_ndims):
-        fs = [next(fresh) for _ in range(nd - 1 if flag else nd)]
-        repl += fs
-        ops_.append(("b " if flag else "") + " ".join(fs))
-    for flag, nd in zip(batched_out, out_ndims):
-        fs = [next(fresh) for _ in range(nd - 1 if flag else nd)]
-        repl += fs
-        results.append(("b " if flag else "") + " ".join(fs))
+
+    def mapping(ax, nd):
+        names = []
+        for d in range(nd):
+            if d == ax:
+                names.append("b")
+            else:
+                names.append(next(fresh))
+                repl.append(names[-1])
+        return " ".join(names)
+
+    for ax, nd in zip(batch_in_axes, in_ndims):
+        ops_.append(mapping(ax, nd))
+    for ax, nd in zip(batch_out_axes, out_ndims):
+        results.append(mapping(ax, nd))
     rule = ", ".join(ops_) + " -> " + ", ".join(results)
 
     def _shardings(mesh, arg_shapes):
         b_axis = None
-        for flag, s in zip(batched_in, arg_shapes):
-            if flag and len(s.sharding.spec) > 0:
-                b_axis = s.sharding.spec[0]
+        for ax, s in zip(batch_in_axes, arg_shapes):
+            if ax is not None and len(s.sharding.spec) > ax:
+                b_axis = s.sharding.spec[ax]
                 break
-        ins = tuple(
-            NamedSharding(mesh, P(*((b_axis,) if flag else ())
-                                  + (None,) * (nd - (1 if flag else 0))))
-            for flag, nd in zip(batched_in, in_ndims))
-        outs = [
-            NamedSharding(mesh, P(*((b_axis,) if flag else ())
-                                  + (None,) * (nd - (1 if flag else 0))))
-            for flag, nd in zip(batched_out, out_ndims)]
+
+        def sh(ax, nd):
+            spec = [None] * nd
+            if ax is not None:
+                spec[ax] = b_axis
+            return NamedSharding(mesh, P(*spec))
+
+        ins = tuple(sh(ax, nd) for ax, nd in zip(batch_in_axes, in_ndims))
+        outs = [sh(ax, nd) for ax, nd in zip(batch_out_axes, out_ndims)]
         return ins, (outs[0] if len(outs) == 1 else tuple(outs))
 
     def infer(mesh, arg_shapes, result_shape):

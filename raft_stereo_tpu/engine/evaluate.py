@@ -65,13 +65,16 @@ def make_eval_forward(params, cfg: RAFTStereoConfig, iters: int,
         # per call would reshard the whole pytree every frame, inside the
         # timed region.
         params = jax.device_put(params, repl)
-    run_cfg = mesh_safe_cfg(cfg, mesh, **extra)  # warns if kernels stripped
+    run_cfg = mesh_safe_cfg(cfg, mesh, **extra)
+    from raft_stereo_tpu.parallel.mesh import space_mesh_of
+    space_mesh = space_mesh_of(mesh)
 
     @functools.lru_cache(maxsize=None)
     def compiled(h: int, w: int):
         def fwd(p, image1, image2):
             _, flow_up = raft_stereo_forward(p, run_cfg, image1, image2,
-                                             iters=iters, test_mode=True)
+                                             iters=iters, test_mode=True,
+                                             space_mesh=space_mesh)
             return flow_up, jnp.sum(flow_up.astype(jnp.float32))
         if mesh is None:
             return jax.jit(fwd)

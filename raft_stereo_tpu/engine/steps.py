@@ -33,10 +33,12 @@ def make_train_step(cfg: RAFTStereoConfig, tx: optax.GradientTransformation,
     ``valid`` (B,H,W).
     """
     cfg = mesh_safe_cfg(cfg, mesh)
+    from raft_stereo_tpu.parallel.mesh import space_mesh_of
+    space_mesh = space_mesh_of(mesh)
 
     def loss_fn(params, batch):
         preds = raft_stereo_forward(params, cfg, batch["image1"], batch["image2"],
-                                    iters=train_iters)
+                                    iters=train_iters, space_mesh=space_mesh)
         return sequence_loss(preds, batch["flow"], batch["valid"])
 
     def step(params, opt_state, batch):
@@ -63,10 +65,13 @@ def make_eval_step(cfg: RAFTStereoConfig, valid_iters: int,
                    mesh: Optional[Mesh] = None):
     """Returns ``eval_step(params, image1, image2) -> (flow_lr, flow_up)``."""
     cfg = mesh_safe_cfg(cfg, mesh)
+    from raft_stereo_tpu.parallel.mesh import space_mesh_of
+    space_mesh = space_mesh_of(mesh)
 
     def step(params, image1, image2):
         return raft_stereo_forward(params, cfg, image1, image2,
-                                   iters=valid_iters, test_mode=True)
+                                   iters=valid_iters, test_mode=True,
+                                   space_mesh=space_mesh)
 
     if mesh is None:
         return jax.jit(step)

@@ -65,7 +65,8 @@ def init_raft_stereo(key: jax.Array, cfg: RAFTStereoConfig) -> Params:
 
 def _context_and_features(params: Params, cfg: RAFTStereoConfig,
                           image1: jax.Array, image2: jax.Array,
-                          compute_dtype) -> Tuple[list, list, jax.Array, jax.Array]:
+                          compute_dtype,
+                          fused: bool = True) -> Tuple[list, list, jax.Array, jax.Array]:
     """Run context + feature networks (reference forward :76-88)."""
     image1 = (2 * (image1 / 255.0) - 1.0).astype(compute_dtype)
     image2 = (2 * (image2 / 255.0) - 1.0).astype(compute_dtype)
@@ -85,7 +86,7 @@ def _context_and_features(params: Params, cfg: RAFTStereoConfig,
     else:
         cnet_list = apply_multi_basic_encoder(
             params["cnet"], image1, norm_fn="batch", downsample=cfg.n_downsample,
-            num_layers=cfg.n_gru_layers, fused=cfg.fused_update)
+            num_layers=cfg.n_gru_layers, fused=fused)
         if image1.shape[1] * image1.shape[2] >= FNET_SEQUENTIAL_MIN_PIXELS:
             # Full-resolution inputs (>=2M px): run the two images through
             # the feature net SEQUENTIALLY (lax.map reuses the stem buffers
@@ -98,7 +99,7 @@ def _context_and_features(params: Params, cfg: RAFTStereoConfig,
             fmaps = lax.map(
                 lambda im: apply_basic_encoder(
                     params["fnet"], im, norm_fn="instance",
-                    downsample=cfg.n_downsample, fused=cfg.fused_update),
+                    downsample=cfg.n_downsample, fused=fused),
                 jnp.stack([image1, image2]))
             fmap1, fmap2 = fmaps[0], fmaps[1]
         else:
@@ -121,17 +122,24 @@ def raft_stereo_forward(params: Params, cfg: RAFTStereoConfig,
                         iters: int = 12,
                         flow_init: Optional[jax.Array] = None,
                         test_mode: bool = False,
-                        unroll: bool = False):
+                        unroll: bool = False,
+                        space_mesh=None):
     """Estimate disparity for a rectified stereo pair.
 
     image1/image2: (B, H, W, 3) in [0, 255].
     Train mode returns per-iteration upsampled predictions
     ``(iters, B, H, W, 1)``; test mode returns ``(low_res_flow, final_up)``
     (reference :126-141). Disparity is ``-flow[..., 0]``.
+
+    ``space_mesh``: the mesh whose ``space`` axis shards image height in
+    the enclosing jit. The streaming scan-body kernels then run their
+    halo-exchange shard_map variants (the encoder kernels stay XLA —
+    their global instance-norm stats and full-H row streams do not cut).
     """
     compute_dtype = jnp.bfloat16 if cfg.mixed_precision else jnp.float32
     net_list, inp_list, fmap1, fmap2 = _context_and_features(
-        params, cfg, image1, image2, compute_dtype)
+        params, cfg, image1, image2, compute_dtype,
+        fused=cfg.fused_update and space_mesh is None)
 
     corr_fp32 = cfg.corr_implementation in ("reg", "alt")
     corr_dtype = jnp.float32 if corr_fp32 else compute_dtype
@@ -158,13 +166,35 @@ def raft_stereo_forward(params: Params, cfg: RAFTStereoConfig,
     # cfg.fused_update=False (spatially-sharded eval) leaves every entry
     # None, keeping the whole scan body on partitionable XLA ops.
     from raft_stereo_tpu.ops.pallas_stream import (
-        gru_is_fusable, prepare_gru_context)
-    fused_ctx = [
-        prepare_gru_context(
-            params["update_block"][("gru08", "gru16", "gru32")[i]],
-            inp[i], compute_dtype)
-        if cfg.fused_update and gru_is_fusable(net[i]) else None
-        for i in range(cfg.n_gru_layers)]
+        gru_is_fusable, prepare_gru_context, spatial_gru_is_fusable)
+    # The streaming kernels engage in TEST MODE only. Training was
+    # measured (r4, batch-6 320x720 crops on the v5e): the remat'd scan
+    # runs each kernel forward twice while the backward still pays the
+    # full XLA oracle, and at crop shapes the row streams are too short
+    # to amortize — 0.64 -> 0.13 steps/s. Inference is where they earn
+    # their keep (tall full-frame streams, no backward).
+    fuse = cfg.fused_update and test_mode
+    if space_mesh is not None:
+        # Per-shard czrq (halo-exchanged, bias-folded, pre-padded) —
+        # hoisted out of the scan exactly like the unsharded entries.
+        from raft_stereo_tpu.ops.pallas_stream import (
+            spatial_prepare_gru_context)
+        ns = space_mesh.shape.get("space", 1)
+        fused_ctx = [
+            spatial_prepare_gru_context(
+                space_mesh,
+                params["update_block"][("gru08", "gru16", "gru32")[i]],
+                inp[i])
+            if (fuse and ns > 1 and spatial_gru_is_fusable(net[i], ns))
+            else None
+            for i in range(cfg.n_gru_layers)]
+    else:
+        fused_ctx = [
+            prepare_gru_context(
+                params["update_block"][("gru08", "gru16", "gru32")[i]],
+                inp[i], compute_dtype)
+            if fuse and gru_is_fusable(net[i]) else None
+            for i in range(cfg.n_gru_layers)]
 
     def one_iteration(net, coords1, compute_mask=True):
         coords1 = lax.stop_gradient(coords1)  # truncated BPTT (:109)
@@ -173,17 +203,19 @@ def raft_stereo_forward(params: Params, cfg: RAFTStereoConfig,
         if cfg.n_gru_layers == 3 and cfg.slow_fast_gru:  # low-res GRU only
             net = apply_update_block(params["update_block"], cfg, net, inp,
                                      iter32=True, iter16=False, iter08=False,
-                                     update=False, fused_ctx=fused_ctx)
+                                     update=False, fused_ctx=fused_ctx,
+                                     space_mesh=space_mesh)
         if cfg.n_gru_layers >= 2 and cfg.slow_fast_gru:  # low+mid-res GRUs
             net = apply_update_block(params["update_block"], cfg, net, inp,
                                      iter32=cfg.n_gru_layers == 3, iter16=True,
                                      iter08=False, update=False,
-                                     fused_ctx=fused_ctx)
+                                     fused_ctx=fused_ctx,
+                                     space_mesh=space_mesh)
         net, up_mask, delta_flow = apply_update_block(
             params["update_block"], cfg, net, inp, corr, flow,
             iter32=cfg.n_gru_layers == 3, iter16=cfg.n_gru_layers >= 2,
             compute_mask=compute_mask, fused_ctx=fused_ctx,
-            fuse_motion=flow_init is None)
+            fuse_motion=flow_init is None, space_mesh=space_mesh)
         # Stereo: project the update onto the epipolar line (:120).
         delta_flow = delta_flow.astype(jnp.float32).at[..., 1].set(0.0)
         coords1 = coords1 + delta_flow

@@ -192,7 +192,8 @@ def apply_update_block(p: Params, cfg: RAFTStereoConfig,
                        iter08: bool = True, iter16: bool = True, iter32: bool = True,
                        update: bool = True, compute_mask: bool = True,
                        fused_ctx: Sequence | None = None,
-                       fuse_motion: bool = True):
+                       fuse_motion: bool = True,
+                       space_mesh=None):
     """Reference ``BasicMultiUpdateBlock.forward`` (``core/update.py:115-138``).
 
     net: per-scale hidden states, finest first. inp: per-scale (cz, cr, cq).
@@ -210,10 +211,15 @@ def apply_update_block(p: Params, cfg: RAFTStereoConfig,
     non-None entries route that level through the streaming Pallas GRU
     kernel. In the test-mode scan (``compute_mask=False``) the FlowHead is
     chained into the finest kernel and the x-delta comes back with it.
+    ``space_mesh``: when the jit is sharded over a mesh ``space`` axis,
+    non-None entries instead route through the halo-exchange shard_map
+    variants (fused_ctx then holds True flags — the gate context is
+    folded per shard).
     """
     from raft_stereo_tpu.ops.pallas_stream import (
-        fused_conv_gru, fused_gru_head, fused_motion, gru_is_fusable,
-        motion_is_fusable)
+        fused_conv_gru, fused_conv_gru_spatial, fused_gru_head,
+        fused_gru_head_spatial, fused_motion, fused_motion_spatial,
+        gru_is_fusable, motion_is_fusable, spatial_motion_is_fusable)
     fc = list(fused_ctx) if fused_ctx is not None else []
     fc += [None] * (3 - len(fc))
 
@@ -222,6 +228,9 @@ def apply_update_block(p: Params, cfg: RAFTStereoConfig,
         # bf16 single-sample steps run the streaming Pallas kernel (gate
         # convs + nonlinearities + state update fused in VMEM); other
         # shapes/dtypes use the XLA formulation.
+        if fc[idx] is not None and space_mesh is not None:
+            return fused_conv_gru_spatial(space_mesh, gp, h, fc[idx], ctx,
+                                          *xs)
         if fc[idx] is not None and gru_is_fusable(h, *xs):
             return fused_conv_gru(gp, h, fc[idx], ctx, *xs)
         return apply_conv_gru(gp, h, ctx, *xs)
@@ -242,13 +251,23 @@ def apply_update_block(p: Params, cfg: RAFTStereoConfig,
         # nonzero y component — the fused motion encoder drops convf1's
         # flow-y weights on the strength of the y==0 invariant, which only
         # the default zero-init coords guarantee.
-        if fuse_motion and fc[0] is not None and motion_is_fusable(corr):
+        if (fuse_motion and fc[0] is not None and space_mesh is not None
+                and spatial_motion_is_fusable(
+                    corr, space_mesh.shape.get("space", 1))):
+            motion = fused_motion_spatial(space_mesh, p["encoder"], flow,
+                                          corr)
+        elif fuse_motion and fc[0] is not None and motion_is_fusable(corr):
             motion = fused_motion(p["encoder"], flow, corr)
         else:
             motion = apply_motion_encoder(p["encoder"], flow, corr)
         xs = (motion, interp_align_corners(net[1], net[0].shape[1:3])) \
             if n > 1 else (motion,)
         if (update and not compute_mask and fc[0] is not None
+                and space_mesh is not None):
+            net[0], delta_x = fused_gru_head_spatial(
+                space_mesh, p["gru08"], p["flow_head"], net[0], fc[0],
+                inp[0], *xs)
+        elif (update and not compute_mask and fc[0] is not None
                 and gru_is_fusable(net[0], *xs)):
             net[0], delta_x = fused_gru_head(
                 p["gru08"], p["flow_head"], net[0], fc[0], inp[0], *xs)

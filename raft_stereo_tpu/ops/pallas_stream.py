@@ -295,11 +295,10 @@ def _gru_pallas(h, parts, czrq, whzr, whq, wx_full, th: int, head):
     # per-shard — the partitioning rule that lets fused training ride a
     # multi-chip data mesh (weights replicate).
     from raft_stereo_tpu.corr.pallas_reg import make_batch_partitioned
-    batched_in = [True, True] + [True] * np_ + [False] * (len(inputs) - 2
-                                                          - np_)
+    axes_in = [0, 0] + [0] * np_ + [None] * (len(inputs) - 2 - np_)
     call_p = make_batch_partitioned(
-        call, batched_in, [a.ndim for a in inputs],
-        [True] * len(out_shape), [o.ndim for o in out_shape])
+        call, axes_in, [a.ndim for a in inputs],
+        [0] * len(out_shape), [o.ndim for o in out_shape])
     outs = call_p(*inputs)
     if head is None:
         return outs[:, 3:3 + hh], None
@@ -434,13 +433,215 @@ def _fused_gru_head_bwd(res, g):
 fused_gru_head.defvjp(_fused_gru_head_fwd, _fused_gru_head_bwd)
 
 
+def _batch_worthwhile(t) -> bool:
+    """B>1 engages the kernels only for big per-sample frames: at small
+    shapes the per-sample ring flush/fixed costs beat the fusion win —
+    measured r4: batch-16 realtime eval (48x156/sample) regressed 129 ->
+    83 fps fused, while B=1 Middlebury (504x744) is the kernels' +9%
+    headline. 200k pixels ~= half of Middlebury-F's 1/4-res plane."""
+    return t.shape[0] == 1 or t.shape[1] * t.shape[2] >= 200_000
+
+
 def gru_is_fusable(h, *x_list) -> bool:
     """Shapes/dtype the streaming kernel supports; callers fall back to
     the XLA path otherwise (fp32 runs exceed the VMEM budget at full
-    res). Batch rides as the outer grid dimension since r4, so training
-    batches fuse too."""
-    return (_dtype_ok(h)
+    res). Batch rides as the outer grid dimension since r4 (big frames
+    only — see ``_batch_worthwhile``)."""
+    return (_dtype_ok(h) and _batch_worthwhile(h)
             and pick_th(h.shape[1], h.shape[2]) > 0 and h.shape[1] >= 8)
+
+
+# ---------------------------------------------------------------------------
+# Height-sharded (``space`` mesh axis) execution: the row streams cannot
+# cross a shard cut, so each shard runs the SAME kernels over its rows
+# plus an 8-row halo fetched from its neighbors (ppermute fills
+# non-participating edges with zeros — exactly the kernels' top/bottom
+# zero conv padding), and the halo rows of the output are discarded.
+# This is what lets ``fused_update`` survive ``--spatial_shard`` (r3
+# silently swapped the whole scan body to XLA under space>1). 8 rows
+# cover the deepest chain (GRU+FlowHead reads 4 rows each side).
+# ---------------------------------------------------------------------------
+
+_HALO = 8
+
+
+def _sharded_rows(hh: int, ns: int):
+    """(local rows, extended rows padded to /8) for an ns-way H shard."""
+    hl = hh // ns
+    ext = hl + 2 * _HALO
+    return hl, ext + (-ext % 8)
+
+
+def spatial_gru_is_fusable(h, ns: int) -> bool:
+    if not (_dtype_ok(h) and h.shape[1] % ns == 0):
+        return False
+    hl, ext = _sharded_rows(h.shape[1], ns)
+    return hl >= _HALO and pick_th(ext, h.shape[2]) > 0
+
+
+def _exchange_halo(x, pad_rows: int):
+    """(B, H_loc, W, C) -> (B, H_ext(+pad), W, C): neighbours' edge rows
+    on both sides over the ``space`` axis (zeros at the image edges),
+    plus bottom zero-pad rows to reach a row-block multiple (they sit
+    beyond the halo, so no in-range output depends on them)."""
+    ns = jax.lax.axis_size("space")
+    up = jax.lax.ppermute(x[:, -_HALO:], "space",
+                          [(i, i + 1) for i in range(ns - 1)])
+    dn = jax.lax.ppermute(x[:, :_HALO], "space",
+                          [(i + 1, i) for i in range(ns - 1)])
+    out = jnp.concatenate([up, x, dn], axis=1)
+    if pad_rows:
+        out = jnp.pad(out, ((0, 0), (0, pad_rows), (0, 0), (0, 0)))
+    return out
+
+
+@functools.lru_cache(maxsize=None)
+def _spatial_prepare(mesh):
+    """shard_map'd ``prepare_gru_context`` twin: halo-exchange the raw
+    per-level context ONCE PER FRAME and emit each shard's pre-shifted,
+    pre-padded czrq block — hoisted outside the scan exactly like the
+    unsharded path (refolding per iteration would re-run the exchange +
+    bias-fold ~100x per frame)."""
+    from jax.sharding import PartitionSpec as P
+    row = P("data", "space")
+    ns = mesh.shape["space"]
+
+    def per_shard(p, context):
+        hl = context[0].shape[1]
+        _, ext = _sharded_rows(hl * ns, ns)
+        pad = ext - (hl + 2 * _HALO)
+        ctx_e = tuple(_exchange_halo(c, pad) for c in context)
+        return prepare_gru_context(p, ctx_e, context[0].dtype)
+
+    return jax.shard_map(per_shard, mesh=mesh,
+                         in_specs=(P(), (row,) * 3), out_specs=row,
+                         check_vma=False)
+
+
+def spatial_prepare_gru_context(mesh, p: dict, context):
+    """Per-shard czrq (global rows = ns * per-shard padded rows)."""
+    return _spatial_prepare(mesh)(p, tuple(context))
+
+
+@functools.lru_cache(maxsize=None)
+def _spatial_gru(mesh, head: bool, n_x: int):
+    from jax.sharding import PartitionSpec as P
+    row = P("data", "space")
+    ns = mesh.shape["space"]
+
+    def per_shard(p, head_p, h, czrq, *x_list):
+        hl = h.shape[1]
+        _, ext = _sharded_rows(hl * ns, ns)
+        pad = ext - (hl + 2 * _HALO)
+        h_e = _exchange_halo(h, pad)
+        xs_e = [_exchange_halo(x, pad) for x in x_list]
+        out, dx = fused_conv_gru_fwd_impl(
+            p, h_e, czrq, *xs_e, head_p=head_p if head else None)
+        out = out[:, _HALO:_HALO + hl]
+        if not head:
+            return out
+        return out, dx[:, _HALO:_HALO + hl]
+
+    return jax.shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(P(), P(), row, row) + (row,) * n_x,
+        out_specs=(row, row) if head else row, check_vma=False)
+
+
+def fused_conv_gru_spatial(mesh, p: dict, h, czrq, context, *x_list):
+    """ConvGRU step with H sharded over the mesh ``space`` axis: halo
+    exchange + the streaming kernel per shard. ``czrq`` from
+    ``spatial_prepare_gru_context`` (hoisted per frame); ``context``
+    rides along for the XLA-oracle backward (GSPMD partitions it
+    natively)."""
+    return _spatial_call(mesh, False, p, None, h, czrq, context, *x_list)
+
+
+def fused_gru_head_spatial(mesh, p: dict, head_p: dict, h, czrq, context,
+                           *x_list):
+    """ConvGRU + FlowHead under a ``space`` shard (test-mode scan body);
+    delta-x excludes conv2.b[0], like ``fused_gru_head``."""
+    return _spatial_call(mesh, True, p, head_p, h, czrq, context, *x_list)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _spatial_call(mesh, head: bool, p, head_p, h, czrq, context, *x_list):
+    fn = _spatial_gru(mesh, head, len(x_list))
+    return fn(p, head_p, h, czrq, *x_list)
+
+
+def _spatial_fwd(mesh, head, p, head_p, h, czrq, context, *x_list):
+    return (_spatial_call(mesh, head, p, head_p, h, czrq, context,
+                          *x_list),
+            (p, head_p, h, czrq, context, x_list))
+
+
+def _spatial_bwd(mesh, head, res, g):
+    # czrq is derived from context, so its cotangent is zero — no double
+    # counting, exactly like the unsharded kernels.
+    p, head_p, h, czrq, context, x_list = res
+    if head:
+        (h2, _), vjp = jax.vjp(
+            lambda *a: _gru_head_oracle(a[0], a[1], a[2], a[3], *a[4:]),
+            p, head_p, h, context, *x_list)
+        gh, gdx = g
+        dp, dhead, dh, dctx, *dxs = vjp((gh.astype(h2.dtype),
+                                         gdx.astype(jnp.float32)))
+        return (dp, dhead, dh, jnp.zeros_like(czrq), dctx, *dxs)
+    out, vjp = jax.vjp(lambda *a: _gru_oracle(a[0], a[1], a[2], *a[3:]),
+                       p, h, context, *x_list)
+    dp, dh, dctx, *dxs = vjp(g.astype(out.dtype))
+    return (dp, None, dh, jnp.zeros_like(czrq), dctx, *dxs)
+
+
+_spatial_call.defvjp(_spatial_fwd, _spatial_bwd)
+
+
+def spatial_motion_is_fusable(corr, ns: int) -> bool:
+    if not (_dtype_ok(corr) and corr.shape[1] % ns == 0):
+        return False
+    hl, ext = _sharded_rows(corr.shape[1], ns)
+    return hl >= _HALO and pick_th(ext, corr.shape[2]) > 0
+
+
+@functools.lru_cache(maxsize=None)
+def _spatial_motion_map(mesh):
+    from jax.sharding import PartitionSpec as P
+    row = P("data", "space")
+    ns = mesh.shape["space"]
+
+    def per_shard(p, flow, corr):
+        hl = corr.shape[1]
+        _, ext = _sharded_rows(hl * ns, ns)
+        pad = ext - (hl + 2 * _HALO)
+        out = fused_motion_fwd_impl(p, _exchange_halo(flow, pad),
+                                    _exchange_halo(corr, pad))
+        return out[:, _HALO:_HALO + hl]
+
+    return jax.shard_map(per_shard, mesh=mesh,
+                         in_specs=(P(), row, row), out_specs=row,
+                         check_vma=False)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def fused_motion_spatial(mesh, p: dict, flow, corr):
+    """BasicMotionEncoder under a ``space`` shard: halo exchange + the
+    streaming kernel per shard; backward via the XLA oracle."""
+    return _spatial_motion_map(mesh)(p, flow, corr)
+
+
+def _spatial_motion_fwd(mesh, p, flow, corr):
+    return fused_motion_spatial(mesh, p, flow, corr), (p, flow, corr)
+
+
+def _spatial_motion_bwd(mesh, res, g):
+    p, flow, corr = res
+    from raft_stereo_tpu.models.update import apply_motion_encoder
+    out, vjp = jax.vjp(apply_motion_encoder, p, flow, corr)
+    return vjp(g.astype(out.dtype))
+
+
+fused_motion_spatial.defvjp(_spatial_motion_fwd, _spatial_motion_bwd)
 
 
 # ---------------------------------------------------------------------------
@@ -456,10 +657,10 @@ def gru_is_fusable(h, *x_list) -> bool:
 # ---------------------------------------------------------------------------
 
 
-def _motion_kernel(corr_ref, pat_ref, flow_ref, w1_ref, b1_ref, w2_ref,
-                   b2_ref, wf_ref, bf_ref, out_ref, scr_s1, scr_s2, scr_fl,
-                   *, th: int, nb: int, width: int, cfused: int, hh: int,
-                   ncorr: int):
+def _motion_kernel(corr_ref, pat_ref, flow_ref, wc1_ref, wf1_ref, b1_ref,
+                   w2_ref, b2_ref, wf_ref, bf_ref, out_ref, scr_s1, scr_s2,
+                   scr_fl, *, th: int, nb: int, width: int, cfused: int,
+                   hh: int):
     i = pl.program_id(1)  # row step; program_id(0) is the batch sample
     dtype = corr_ref.dtype
 
@@ -472,13 +673,22 @@ def _motion_kernel(corr_ref, pat_ref, flow_ref, w1_ref, b1_ref, w2_ref,
         _shift(s, 2)
     _shift(scr_fl, 2)
 
-    # Stage 1 (pointwise, rows [i*TH, (i+1)*TH)): ONE block-diagonal dot
-    # computes both branches — [c1 | f1] = relu([corr | patches] @
-    # blockdiag(wc1, wf1) + [bc1 | bf1]). The two inputs stay separate
-    # refs; their dots accumulate into one fp32 buffer.
-    acc1 = _dot(corr_ref[0], w1_ref[0:ncorr])
-    acc1 = acc1 + _dot(pat_ref[0], w1_ref[ncorr:])
-    s1v = jax.nn.relu(acc1 + b1_ref[...].astype(jnp.float32)).astype(dtype)
+    # Stage 1 (pointwise, rows [i*TH, (i+1)*TH)): c1 from the corr taps,
+    # f1 from the TAP-MAJOR flow patches — per image row one
+    # transposed-lhs dot contracts the 49-tap dim (the patches arrive as
+    # (49, rows, W) so no channel-minor tensor ever exists; the XLA
+    # patches op measured ~2.4 ms/iter of pathological-layout conv plus
+    # a relayout copy). Both land in one [c1 | f1] buffer.
+    acc_c = _dot(corr_ref[0], wc1_ref[...])
+    n1 = wc1_ref.shape[-1]
+    f1_rows = [jax.lax.dot_general(
+        pat_ref[:, 0, r], wf1_ref[...], (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) for r in range(th)]
+    acc_f = jnp.stack(f1_rows)
+    bias1 = b1_ref[...].astype(jnp.float32)
+    s1v = jnp.concatenate(
+        [jax.nn.relu(acc_c + bias1[:, :n1]),
+         jax.nn.relu(acc_f + bias1[:, n1:])], axis=-1).astype(dtype)
 
     @pl.when(i < nb)
     def _place():
@@ -507,15 +717,19 @@ def _motion_kernel(corr_ref, pat_ref, flow_ref, w1_ref, b1_ref, w2_ref,
     out_ref[0, :, :, cfused:] = scr_fl[0:th]
 
 
-def flow_patches(flow, dtype):
-    """(1, H, W, C) flow -> (1, H, W, C*49) 7x7 zero-padded patches.
+def flow_patches(flow_x, dtype):
+    """(B, H, W) flow-x -> (49, B, H, W) tap-major 7x7 zero-padded
+    patches, row dy*7 + dx.
 
-    Channel order is feature-major — patch channel c*49 + dy*7 + dx — per
-    ``lax.conv_general_dilated_patches``; the kernel's f1 weight matrix is
-    reshaped to match."""
-    return jax.lax.conv_general_dilated_patches(
-        flow.astype(dtype), (7, 7), (1, 1), [(3, 3), (3, 3)],
-        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    Taps OUTER-most from contiguous slices of the padded map: W stays
+    the minor dim everywhere, so the build is one cheap stack fusion
+    (``conv_general_dilated_patches`` lowers to a T(2,128)-layout conv —
+    measured ~2.4 ms/iteration at Middlebury-F plus a relayout copy —
+    and a channel-minor 49-wide tensor pads 128/49 in HBM)."""
+    b, hh, ww = flow_x.shape
+    fp = jnp.pad(flow_x.astype(dtype), ((0, 0), (3, 3), (3, 3)))
+    return jnp.stack([fp[:, dy:dy + hh, dx:dx + ww]
+                      for dy in range(7) for dx in range(7)], axis=0)
 
 
 def _blockdiag3x3(wa, wb):
@@ -535,39 +749,31 @@ def fused_motion_fwd_impl(p: dict, flow, corr):
     lag = 2
     grid = pl.cdiv(hh + lag, th)
     n1 = p["convc1"]["w"].shape[-1]
-    # Stage-1 weight: rows 0:ccorr act on corr (convc1 1x1), the rest on
-    # the flow patches (convf1 reshaped feature-major); columns are
-    # [c1 | f1]. Stage-2: block-diagonal (convc2, convf2).
-    #
-    # The patches cover ONLY flow-x: the model's flow y-component is
-    # identically zero (the epipolar projection zeroes every y-delta,
-    # raft_stereo.py:120, and warm-start inits come from prior disparity
-    # runs with equal y-coords), so convf1's y-channel weights multiply
-    # zeros and are dropped — halving the per-iteration patches pass. The
-    # raw flow concat below still carries both channels.
-    wc1 = p["convc1"]["w"].reshape(p["convc1"]["w"].shape[2:])
-    wf1 = p["convf1"]["w"].transpose(2, 0, 1, 3)[:1].reshape(-1, n1)
-    z12 = jnp.zeros((ccorr, n1), wc1.dtype)
-    z21 = jnp.zeros((wf1.shape[0], n1), wc1.dtype)
-    w1 = jnp.concatenate(
-        [jnp.concatenate([wc1, z12], axis=1),
-         jnp.concatenate([z21, wf1], axis=1)], axis=0).astype(dtype)
+    # Stage-1 weights: convc1 (1x1) on the corr taps; convf1's x-channel
+    # rows on the tap-major flow patches. The patches cover ONLY flow-x:
+    # the model's flow y-component is identically zero (the epipolar
+    # projection zeroes every y-delta, raft_stereo.py:120, and
+    # warm-start inits come from prior disparity runs with equal
+    # y-coords), so convf1's y-channel weights multiply zeros and are
+    # dropped. Stage-2: block-diagonal (convc2, convf2); the raw 2-ch
+    # flow rides along as output channels 126:128.
+    wc1 = p["convc1"]["w"].reshape(p["convc1"]["w"].shape[2:]).astype(dtype)
+    wf1 = p["convf1"]["w"][:, :, 0].reshape(-1, n1).astype(dtype)  # dy*7+dx
     b1 = jnp.concatenate([p["convc1"]["b"], p["convf1"]["b"]]).reshape(1, -1)
     w2 = _blockdiag3x3(p["convc2"]["w"], p["convf2"]["w"]).astype(dtype)
     b2 = jnp.concatenate([p["convc2"]["b"], p["convf2"]["b"]]).reshape(1, -1)
     wf = p["conv"]["w"].astype(dtype)  # verbatim: input order [c2 ; f2]
     bf = p["conv"]["b"].reshape(1, -1)
     cfused = wf.shape[-1]
-    pat = flow_patches(flow[..., :1], dtype)
-    npat = pat.shape[-1]
+    pat = flow_patches(flow[..., 0], dtype)  # (49, B, H, W)
     ns1 = 2 * n1
 
     def idx_in(bi, i):
         return (bi, jnp.minimum(i, nb - 1), 0, 0)
 
     kernel = functools.partial(_motion_kernel, th=th, nb=nb, width=width,
-                               cfused=cfused, hh=hh, ncorr=ccorr)
-    weights = (w1, b1, w2, b2, wf, bf)
+                               cfused=cfused, hh=hh)
+    weights = (wc1, wf1, b1, w2, b2, wf, bf)
 
     def call(*arrs):
         return pl.pallas_call(
@@ -575,7 +781,9 @@ def fused_motion_fwd_impl(p: dict, flow, corr):
             grid=(arrs[0].shape[0], grid),
             in_specs=[pl.BlockSpec((1, th, width, ccorr), idx_in,
                                    memory_space=pltpu.VMEM),
-                      pl.BlockSpec((1, th, width, npat), idx_in,
+                      pl.BlockSpec((49, 1, th, width),
+                                   lambda bi, i: (0, bi,
+                                                  jnp.minimum(i, nb - 1), 0),
                                    memory_space=pltpu.VMEM),
                       pl.BlockSpec((1, th, width, flow.shape[-1]), idx_in,
                                    memory_space=pltpu.VMEM)] +
@@ -598,18 +806,18 @@ def fused_motion_fwd_impl(p: dict, flow, corr):
         )(*arrs)
 
     # Same batch-axis partitioning rule as the GRU kernel (grid dim 0 is
-    # the sample): data-sharded batches run per-shard.
+    # the sample; the tap-major patches carry batch on axis 1).
     from raft_stereo_tpu.corr.pallas_reg import make_batch_partitioned
     args = [corr, pat, flow.astype(dtype), *weights]
     call_p = make_batch_partitioned(
-        call, [True, True, True] + [False] * len(weights),
-        [a.ndim for a in args], [True], [4])
+        call, [0, 1, 0] + [None] * len(weights),
+        [a.ndim for a in args], [0], [4])
     out = call_p(*args)
     return out[:, lag:lag + hh]
 
 
 def motion_is_fusable(corr) -> bool:
-    return (_dtype_ok(corr)
+    return (_dtype_ok(corr) and _batch_worthwhile(corr)
             and pick_th(corr.shape[1], corr.shape[2]) > 0 and corr.shape[1] >= 8)
 
 

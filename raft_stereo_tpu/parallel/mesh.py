@@ -116,30 +116,28 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 
 def mesh_config_overrides(cfg, mesh: Optional[Mesh]) -> dict:
-    """Config overrides required to run ``cfg`` under ``mesh``.
+    """Config overrides required to run ``cfg`` under ``mesh`` — none,
+    since r4. Every Pallas kernel now has an SPMD story: the correlation
+    kernels carry a custom_partitioning row rule
+    (``corr/pallas_reg.py``), the streaming scan-body kernels partition
+    along batch and run halo-exchange shard_map variants under a real
+    ``space`` axis (``ops/pallas_stream.py``), and the full-resolution
+    encoder kernels — whose global instance-norm stats and full-H row
+    streams genuinely cannot cut — are gated off per-trace via the
+    ``space_mesh`` argument to ``raft_stereo_forward``, not by config
+    mutation. Kept (returning {}) as the single place a future
+    kernel-vs-mesh incompatibility would live, and because the CLIs call
+    ``mesh_safe_cfg`` unconditionally."""
+    return {}
 
-    The correlation kernels carry their own SPMD partitioning rule
-    (``corr/pallas_reg.py:_make_partitioned`` — row-parallel along batch
-    and height, the analog of the reference's CUDA sampler under
-    DataParallel), so every ``corr_implementation`` now survives any
-    mesh unchanged. The streaming scan-body kernels
-    (``ops/pallas_stream.py``) are row-sequential with ring-carried conv
-    halos, which a height shard cannot cut; under a real ``space`` axis
-    the update chain falls back to its partitionable XLA twin. Shared by
-    the eval AND train paths; warns when it changes something, because
-    the swap is a perf cliff otherwise.
-    """
-    if mesh is None or mesh.shape.get("space", 1) <= 1:
-        return {}
-    overrides = {}
-    if getattr(cfg, "fused_update", False):
-        overrides["fused_update"] = False
-    if overrides:
-        import logging
-        logging.getLogger(__name__).warning(
-            "spatial sharding cannot split the streaming scan-body "
-            "kernels; applying config overrides %s", overrides)
-    return overrides
+
+def space_mesh_of(mesh: Optional[Mesh]) -> Optional[Mesh]:
+    """``mesh`` when it has a real (>1) ``space`` axis, else None — the
+    single gate every engine passes to ``raft_stereo_forward`` as
+    ``space_mesh``."""
+    if mesh is not None and mesh.shape.get("space", 1) > 1:
+        return mesh
+    return None
 
 
 def mesh_safe_cfg(cfg, mesh: Optional[Mesh], **extra):

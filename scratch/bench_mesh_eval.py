@@ -1,0 +1,47 @@
+"""Batched sharded eval WITH the Pallas kernels under a mesh, on the chip.
+
+BASELINE.json config 4 is batched eval over a data mesh; r3 could not
+measure it with the kernels (space/data meshes stripped them). r4's
+partitioned kernels make it well-defined: this runs make_eval_step under
+the chip's degenerate data=1 mesh (the same partitioned-kernel code path
+the 8-way CPU-mesh equality tests pin) at KITTI-ish shape, batched.
+
+  MESH_BATCH (default 8), MESH_H/W (384x1248), MESH_ITERS (32)
+"""
+import sys; sys.path.insert(0, "/root/repo")
+import os, time
+import numpy as np
+import jax, jax.numpy as jnp
+
+from raft_stereo_tpu.config import RAFTStereoConfig
+from raft_stereo_tpu.engine.steps import make_eval_step
+from raft_stereo_tpu.models import init_raft_stereo
+from raft_stereo_tpu.parallel.mesh import make_mesh, shard_batch
+
+b = int(os.environ.get("MESH_BATCH", 8))
+h = int(os.environ.get("MESH_H", 384))
+w = int(os.environ.get("MESH_W", 1248))
+iters = int(os.environ.get("MESH_ITERS", 32))
+
+cfg = RAFTStereoConfig(corr_implementation="reg_tpu", mixed_precision=True)
+params = init_raft_stereo(jax.random.PRNGKey(0), cfg)
+mesh = make_mesh(n_data=len(jax.devices()))
+step = make_eval_step(cfg, valid_iters=iters, mesh=mesh)
+
+rng = np.random.default_rng(0)
+im1 = jnp.asarray(rng.uniform(0, 255, (b, h, w, 3)), jnp.float32)
+im2 = jnp.asarray(rng.uniform(0, 255, (b, h, w, 3)), jnp.float32)
+im1, im2 = shard_batch([im1, im2], mesh)
+
+def run():
+    _, up = step(params, im1, im2)
+    return float(jnp.sum(up.astype(jnp.float32)))
+
+run(); run()  # compile + steady state
+t0 = time.perf_counter()
+n = 4
+cs = [run() for _ in range(n)]
+dt = (time.perf_counter() - t0) / n
+print({"mesh": dict(mesh.shape), "batch": b, "shape": f"{h}x{w}",
+       "iters": iters, "wall_fps_per_chip": round(b / dt / len(jax.devices()), 2),
+       "checksum": round(cs[-1], 1)})

@@ -17,7 +17,9 @@ from raft_stereo_tpu.models import init_raft_stereo
 
 corr = os.environ.get("TRAIN_BENCH_CORR", "reg_tpu")
 b, h, w, iters = 6, 320, 720, 22
-cfg = RAFTStereoConfig(corr_implementation=corr, mixed_precision=True)
+fused = os.environ.get("TRAIN_BENCH_FUSED", "1") not in ("0", "false")
+cfg = RAFTStereoConfig(corr_implementation=corr, mixed_precision=True,
+                       fused_update=fused)
 params = jax.jit(lambda k: init_raft_stereo(k, cfg))(jax.random.PRNGKey(0))
 tx, _ = make_optimizer(lr=2e-4, num_steps=1000)
 opt_state = jax.jit(tx.init)(params)

@@ -1,0 +1,54 @@
+"""Bisect the realtime-B16 device-time regression (129 -> 6.6 fps wall).
+
+Variants: current | resize-fp32 monkeypatch | corr=reg. Device time via
+profiler per one 16-frame dispatch.
+"""
+import sys; sys.path.insert(0, "/root/repo")
+import os, glob, gzip, json
+import numpy as np, jax, jax.numpy as jnp
+
+variant = sys.argv[1] if len(sys.argv) > 1 else "current"
+
+import raft_stereo_tpu.ops.resize as rz
+if variant == "fused_b16":
+    import raft_stereo_tpu.ops.pallas_stream as ps
+    ps._batch_worthwhile = lambda t: True
+if variant == "resize_fp32":
+    _orig = rz.interp_align_corners
+    def interp32(x, size):
+        return _orig(x.astype(jnp.float32), size).astype(x.dtype)
+    rz.interp_align_corners = interp32
+    import raft_stereo_tpu.models.update as upd
+    upd.interp_align_corners = interp32
+
+from raft_stereo_tpu.config import RAFTStereoConfig
+from raft_stereo_tpu.models import init_raft_stereo, raft_stereo_forward
+
+corr = "reg" if variant == "corr_reg" else "reg_tpu"
+cfg = RAFTStereoConfig(corr_implementation=corr, mixed_precision=True,
+                       shared_backbone=True, n_downsample=3,
+                       n_gru_layers=2, slow_fast_gru=True)
+params = init_raft_stereo(jax.random.PRNGKey(0), cfg)
+b, h, w = 16, 384, 1248
+rng = np.random.default_rng(0)
+i1 = jnp.asarray(rng.uniform(0, 255, (b, h, w, 3)), jnp.float32)
+i2 = jnp.asarray(rng.uniform(0, 255, (b, h, w, 3)), jnp.float32)
+
+@jax.jit
+def fwd(p, a, bb):
+    _, up = raft_stereo_forward(p, cfg, a, bb, iters=7, test_mode=True)
+    return jnp.sum(up)
+
+float(fwd(params, i1, i2)); float(fwd(params, i1, i2))
+tdir = f"/tmp/trace_rt_{variant}"
+os.system(f"rm -rf {tdir}")
+with jax.profiler.trace(tdir):
+    float(fwd(params, i1, i2))
+files = glob.glob(f"{tdir}/**/*.trace.json.gz", recursive=True)
+ev = json.load(gzip.open(sorted(files)[-1]))["traceEvents"]
+pids = {e["pid"]: e["args"]["name"] for e in ev
+        if e.get("ph") == "M" and e.get("name") == "process_name"}
+tot = sum(e["dur"] for e in ev if e.get("ph") == "X" and "dur" in e
+          and "TPU" in pids.get(e.get("pid"), "")
+          and not str(e.get("name", "")).startswith(("jit_", "while")))
+print(f"{variant}: device {tot/1e3:.1f} ms per 16-frame dispatch")

@@ -137,30 +137,68 @@ def test_spatial_sharded_train_step_matches_single(rng):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
-def test_spatial_train_step_strips_stream_kernels(rng):
-    """ADVICE r3 (medium): a spatially-sharded TRAIN step with
-    fused_update requested must strip the streaming scan-body kernels
-    exactly like the eval path (their ring-carried conv halos cannot be
-    cut by a height shard). The correlation kernels carry their own SPMD
-    partitioning rule since r4 and must NOT be stripped. Asserted
-    directly on the shared guard, then the stripped step is run
-    end-to-end with the partitioned reg_tpu kernel."""
+def test_spatial_fused_train_step_runs(rng):
+    """A spatially-sharded TRAIN step accepts fused_update untouched
+    (r4): no config is stripped any more — mesh_config_overrides is
+    empty by design — and the step matches single-device. (In train
+    mode the scan body itself stays on the partitionable XLA chain:
+    the kernels are test-mode-only by measurement, see
+    raft_stereo_forward; the halo-exchange kernel path under this mesh
+    is covered by test_spatial_sharded_fused_eval_matches_single.)"""
+    import raft_stereo_tpu.ops.pallas_stream as ps
     from raft_stereo_tpu.parallel.mesh import mesh_config_overrides
     cfg = RAFTStereoConfig(n_gru_layers=1, fused_update=True,
-                           corr_implementation="reg_tpu",
-                           mixed_precision=True)
+                           corr_implementation="reg_tpu")
     mesh = make_mesh(n_data=1, n_space=8)
-    assert mesh_config_overrides(cfg, mesh) == {"fused_update": False}
-    assert mesh_config_overrides(cfg, None) == {}
+    assert mesh_config_overrides(cfg, mesh) == {}
     assert mesh_config_overrides(cfg, make_mesh(n_data=8, n_space=1)) == {}
 
     params = init_raft_stereo(jax.random.key(0), cfg)
     tx, _ = make_optimizer(lr=1e-4, num_steps=100)
-    batch = _batch(rng, 1, 64, 64)
-    step = make_train_step(cfg, tx, train_iters=2, mesh=mesh)
-    _, _, metrics = step(jax.tree.map(jnp.copy, params), tx.init(params),
-                         shard_batch(batch, mesh, spatial=True))
-    assert np.isfinite(float(metrics["loss"]))
+    batch = _batch(rng, 1, 128, 64)
+    old = ps.FORCE_FUSABLE_DTYPE
+    ps.FORCE_FUSABLE_DTYPE = True
+    try:
+        step = make_train_step(cfg, tx, train_iters=2, mesh=mesh)
+        p_sp, _, metrics = step(jax.tree.map(jnp.copy, params),
+                                tx.init(params),
+                                shard_batch(batch, mesh, spatial=True))
+        step_1 = make_train_step(cfg, tx, train_iters=2)
+        p_1, _, m_1 = step_1(jax.tree.map(jnp.copy, params),
+                             tx.init(params), batch)
+    finally:
+        ps.FORCE_FUSABLE_DTYPE = old
+    np.testing.assert_allclose(float(metrics["loss"]), float(m_1["loss"]),
+                               rtol=1e-3)
+    for a, b in zip(jax.tree.leaves(p_sp), jax.tree.leaves(p_1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_spatial_sharded_fused_eval_matches_single(rng):
+    """fused_update SURVIVES space>1 (VERDICT r3 #2, the r3 perf cliff):
+    the streaming GRU/motion kernels run per-shard behind a ppermute
+    halo exchange (ops/pallas_stream.py spatial variants). Equality with
+    the unsharded fused run, within the same reassociation envelope the
+    XLA spatial path has (test_spatial_sharded_eval_matches_single)."""
+    import raft_stereo_tpu.ops.pallas_stream as ps
+    cfg = RAFTStereoConfig(n_gru_layers=3, corr_implementation="reg_tpu",
+                           fused_update=True)
+    params = init_raft_stereo(jax.random.key(0), cfg)
+    batch = _batch(rng, 1, 128, 64)
+
+    old = ps.FORCE_FUSABLE_DTYPE
+    ps.FORCE_FUSABLE_DTYPE = True  # engage the kernels in fp32 interpret
+    try:
+        mesh = make_mesh(n_data=1, n_space=8)
+        step_sp = make_eval_step(cfg, valid_iters=3, mesh=mesh)
+        _, up_sp = step_sp(params, *shard_batch(
+            [batch["image1"], batch["image2"]], mesh, spatial=True))
+        step_1 = make_eval_step(cfg, valid_iters=3)
+        _, up_1 = step_1(params, batch["image1"], batch["image2"])
+    finally:
+        ps.FORCE_FUSABLE_DTYPE = old
+    np.testing.assert_allclose(np.asarray(up_sp), np.asarray(up_1),
+                               atol=5e-3)
 
 
 @pytest.mark.parametrize("impl", ["reg_tpu", "alt_tpu"])
