@@ -20,11 +20,51 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+
+def _check_checksum_pin(key: str, checksum: float, here: str) -> None:
+    """Gate the disparity checksum against a recorded reference band.
+
+    Finiteness alone proved too weak (a wrong-but-finite kernel sails
+    through); each benched config pins its checksum in
+    ``bench_checksum_ref.json`` and numerics changes must consciously
+    re-baseline via ``RAFT_BENCH_REBASELINE=1``. Unpinned configs warn
+    rather than fail so ad-hoc shapes stay usable."""
+    path = os.path.join(here, "bench_checksum_ref.json")
+    refs = {}
+    if os.path.exists(path):
+        # A present-but-unparseable pin file must fail loudly: silently
+        # resetting it would drop every other config's pin on rebaseline
+        # and disable the numerics gate for them.
+        with open(path) as f:
+            refs = json.load(f)
+    if os.environ.get("RAFT_BENCH_REBASELINE"):
+        refs[key] = {"checksum": checksum, "rtol": 0.02, "atol": 100.0}
+        with open(path, "w") as f:
+            json.dump(refs, f, indent=1, sort_keys=True)
+        print(f"bench: re-baselined checksum for {key}: {checksum:.2f}",
+              file=sys.stderr)
+        return
+    ref = refs.get(key)
+    if ref is None:
+        print(f"bench: no pinned checksum for {key}; "
+              "RAFT_BENCH_REBASELINE=1 records one", file=sys.stderr)
+        return
+    # The absolute floor keeps a legitimately-near-zero pinned checksum
+    # (signed disparities canceling) from rejecting ordinary bf16 jitter.
+    tol = max(abs(ref["checksum"]) * ref.get("rtol", 0.02),
+              ref.get("atol", 100.0))
+    if abs(checksum - ref["checksum"]) > tol:
+        raise AssertionError(
+            f"disparity checksum {checksum:.2f} outside the pinned band "
+            f"{ref['checksum']:.2f} ±{tol:.2f} for {key}; if the numerics "
+            "change is intentional, re-baseline with RAFT_BENCH_REBASELINE=1")
 
 
 def _trace_device_seconds(trace_dir: str):
@@ -197,6 +237,12 @@ def main() -> None:
     elapsed = time.perf_counter() - t0
 
     fps = n_frames * batch / elapsed
+
+    pin_key = (f"{h}x{w}_i{iters}_{corr}_{'bf16' if mixed else 'fp32'}"
+               f"_b{batch}_sh{int(cfg.shared_backbone)}_d{cfg.n_downsample}"
+               f"_g{cfg.n_gru_layers}_sf{int(cfg.slow_fast_gru)}")
+    _check_checksum_pin(pin_key, checksum,
+                        os.path.dirname(os.path.abspath(__file__)))
 
     # Baseline preference: a published reference fps (none exists — the repo
     # publishes no numbers, BASELINE.md), else our measured torch-reference

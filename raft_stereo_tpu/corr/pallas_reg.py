@@ -76,9 +76,9 @@ def _interpret() -> bool:
     return jax.default_backend() not in ("tpu",)
 
 
-def pad_width(w: int) -> int:
-    """Smallest vreg-width (128) multiple >= w."""
-    return -(-w // LANE) * LANE
+def pad_width(w: int, align: int = LANE) -> int:
+    """Smallest ``align`` (vreg-width 128 by default) multiple >= w."""
+    return -(-w // align) * align
 
 
 def gather_lerp_taps(vol, cl, radius: int, w2: int):
@@ -138,6 +138,97 @@ def gather_lerp_taps(vol, cl, radius: int, w2: int):
                                 jnp.clip(xpos, 0, LANE - 1), axis=-1)
     g = jnp.where((xpos >= 0) & (xpos < w2), g, 0.0)
     return g[:, :k] * (1.0 - frac) + g[:, 1:k + 1] * frac
+
+
+def gather_lerp_taps_packed(vol, cl, radius: int, w2: int):
+    """Pair-packed variant of ``gather_lerp_taps`` for bf16 pyramids.
+
+    vol: (P, W2p/2) fp32-CONTAINER rows — each 32-bit lane carries the two
+    bf16 taps at true positions (2j, 2j+1), low half = even position (XLA
+    bitcast semantics: trailing-dim element 0 is the low-order bits).
+    Why: Mosaic's ``take_along_axis`` is 32-bit-only, so the unpacked bf16
+    path must upcast both selected slabs to fp32 *before* gathering; here
+    the gather fetches two taps per lane with no conversion pass, the
+    coarse align scans HALF the lanes, and the bf16->fp32 upcast becomes
+    two bit-ops in-register (bf16 bits << 16 ARE the fp32 bits). The two
+    deepest pyramid levels drop under one vreg and skip the align
+    entirely. Numerically identical to the unpacked path (same fp32 lerp
+    on the same bf16 tap values)."""
+    p, w2p2 = vol.shape
+    if w2p2 % LANE:
+        vol = jnp.concatenate(
+            [vol, jnp.zeros((p, LANE - w2p2 % LANE), vol.dtype)], axis=-1)
+        w2p2 = vol.shape[-1]
+    k = 2 * radius + 1
+    vi = jax.lax.bitcast_convert_type(vol, jnp.int32)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (p, LANE), 1)
+    i0 = jnp.floor(cl)
+    frac = cl - i0  # (P, 1)
+    base = i0.astype(jnp.int32) - radius  # first tap true position
+    xpos = base + lane  # true tap position for out lane t
+    pidx = xpos >> 1  # containing pair (arithmetic shift = floor)
+    if w2p2 > LANE:
+        nslab = w2p2 // LANE
+        slab = jnp.clip((base >> 1) // LANE, 0, nslab - 1)
+        # ONE merged pass: slab s feeds win_a where slab==s and win_b where
+        # slab==s-1 (successor), so each slab is read once.
+        win_a = vi[:, 0:LANE]
+        win_b = vi[:, LANE:2 * LANE]
+        for s in range(1, nslab):
+            sl = vi[:, s * LANE:(s + 1) * LANE]
+            win_a = jnp.where(slab == s, sl, win_a)
+            if s >= 2:
+                win_b = jnp.where(slab == s - 1, sl, win_b)
+        # slab == nslab-1 leaves win_b stale, but any rel >= LANE there
+        # implies xpos >= w2p >= w2 — zeroed by the bounds mask.
+        rel = pidx - slab * LANE  # pair-relative lane index
+        g_a = jnp.take_along_axis(win_a, jnp.clip(rel, 0, LANE - 1), axis=-1)
+        g_b = jnp.take_along_axis(win_b, jnp.clip(rel - LANE, 0, LANE - 1),
+                                  axis=-1)
+        g = jnp.where(rel < LANE, g_a, g_b)
+    else:
+        g = jnp.take_along_axis(vi, jnp.clip(pidx, 0, LANE - 1), axis=-1)
+    lo = jax.lax.bitcast_convert_type(g << 16, jnp.float32)
+    hi = jax.lax.bitcast_convert_type(g & jnp.int32(-65536), jnp.float32)
+    val = jnp.where((xpos & 1) == 0, lo, hi)
+    val = jnp.where((xpos >= 0) & (xpos < w2), val, 0.0)
+    return val[:, :k] * (1.0 - frac) + val[:, 1:k + 1] * frac
+
+
+PACK_ALIGN = 2 * LANE  # bf16 row width multiple that packs to whole vregs
+
+
+@jax.custom_vjp
+def pack_rows(rows: jax.Array) -> jax.Array:
+    """(..., Wb) bf16 rows -> (..., Wb/2) fp32-container rows (two bf16
+    taps per 32-bit lane). Called ONCE per frame at corr-fn build time —
+    outside the GRU scan — so the kernel reads packed rows every iteration
+    for free. The container is an opaque BIT transport: its vjp is zero
+    (fp32 addition of bit-packed pairs is meaningless, and JAX SUMS
+    cotangents across the loop's 32 lookup calls before any unpack could
+    run) — all gradient flows through the bf16 rows operand that
+    ``_lookup`` takes alongside the containers."""
+    wb = rows.shape[-1]
+    return jax.lax.bitcast_convert_type(
+        rows.reshape(*rows.shape[:-1], wb // 2, 2), jnp.float32)
+
+
+def unpack_rows(packed: jax.Array) -> jax.Array:
+    """(..., W2) fp32-container -> (..., 2*W2) bf16 rows (pack inverse)."""
+    rows = jax.lax.bitcast_convert_type(packed, jnp.bfloat16)
+    return rows.reshape(*packed.shape[:-1], packed.shape[-1] * 2)
+
+
+def _pack_fwd(rows):
+    return pack_rows(rows), None
+
+
+def _pack_bwd(_, g):
+    # Bit container: no meaningful float cotangent (see pack_rows).
+    return (jnp.zeros((*g.shape[:-1], g.shape[-1] * 2), jnp.bfloat16),)
+
+
+pack_rows.defvjp(_pack_fwd, _pack_bwd)
 
 
 def _row_sharding(mesh, arg_shapes, ndim: int, n_lead: int = 2):
@@ -245,25 +336,28 @@ def make_batch_partitioned(impl, batch_in_axes: Sequence,
     return fn
 
 
-def _lookup_kernel(coords_ref, *refs, radius: int, widths: Sequence[int]):
+def _lookup_kernel(coords_ref, *refs, radius: int, widths: Sequence[int],
+                   packed: bool):
     *vol_refs, out_ref = refs
     k = 2 * radius + 1
+    taps = gather_lerp_taps_packed if packed else gather_lerp_taps
     c = coords_ref[:]  # (TILE, 1) fp32
     for lvl, vol_ref in enumerate(vol_refs):
         cl = c * (1.0 / (1 << lvl))
-        out_ref[:, lvl * k:(lvl + 1) * k] = gather_lerp_taps(
+        out_ref[:, lvl * k:(lvl + 1) * k] = taps(
             vol_ref[:], cl, radius, widths[lvl]).astype(out_ref.dtype)
 
 
 def _pallas_lookup(pyramid: Sequence[jax.Array], coords_flat: jax.Array,
                    radius: int, widths: Tuple[int, ...],
-                   out_dtype) -> jax.Array:
+                   out_dtype, packed: bool = False) -> jax.Array:
     """pyramid: list of (N, W2p_l) fp32; coords_flat: (N, 1) fp32."""
     n = coords_flat.shape[0]
     k = 2 * radius + 1
     out_ch = len(pyramid) * k
     grid = pl.cdiv(n, TILE)
-    kernel = functools.partial(_lookup_kernel, radius=radius, widths=widths)
+    kernel = functools.partial(_lookup_kernel, radius=radius, widths=widths,
+                               packed=packed)
     out = pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((n, out_ch), out_dtype),
@@ -284,7 +378,7 @@ def _pallas_lookup(pyramid: Sequence[jax.Array], coords_flat: jax.Array,
 
 @functools.lru_cache(maxsize=None)
 def _partitioned_lookup(radius: int, widths: Tuple[int, ...], out_dtype_name,
-                        nlev: int):
+                        nlev: int, packed: bool = False):
     """SPMD-partitionable 3D lookup: coords (B, N, 1) + per-level rows
     (B, N, W2p_l) -> (B, N, nlev*(2r+1)), independent along (B, N) — any
     mesh sharding of the leading two axes runs the flat kernel per-shard.
@@ -295,7 +389,7 @@ def _partitioned_lookup(radius: int, widths: Tuple[int, ...], out_dtype_name,
         b, n, _ = coords3.shape
         flat = [p.reshape(b * n, p.shape[-1]) for p in pyr3]
         out = _pallas_lookup(flat, coords3.reshape(b * n, 1), radius,
-                             widths, out_dtype)
+                             widths, out_dtype, packed=packed)
         return out.reshape(b, n, -1)
 
     rule = ("b n u, " + ", ".join(f"b n w{i}" for i in range(nlev))
@@ -336,28 +430,36 @@ def _masked_lookup_xla(pyramid: Sequence[jax.Array], coords_flat: jax.Array,
     return jnp.concatenate(out, axis=-1)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
-def _lookup(pyramid: List[jax.Array], coords_flat: jax.Array,
-            radius: int, widths: Tuple[int, ...],
-            out_dtype=jnp.float32) -> jax.Array:
-    """pyramid: per-level (B, N, W2p_l); coords_flat: (B, N, 1)."""
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _lookup(pyramid: List[jax.Array], packed_pyr: List[jax.Array],
+            coords_flat: jax.Array, radius: int, widths: Tuple[int, ...],
+            out_dtype=jnp.float32, packed: bool = False) -> jax.Array:
+    """pyramid: per-level (B, N, W2p_l) bf16/fp32 rows — the DIFFERENTIABLE
+    operand (cotangents sum linearly across the loop's 32 lookup calls);
+    packed_pyr: the same rows pair-packed into fp32 containers (see
+    ``pack_rows``; empty unless ``packed``) — what the kernel reads, zero
+    cotangent. coords_flat: (B, N, 1)."""
     fn = _partitioned_lookup(radius, widths, jnp.dtype(out_dtype).name,
-                             len(pyramid))
-    return fn(coords_flat, *pyramid)
+                             len(pyramid), packed)
+    return fn(coords_flat, *(packed_pyr if packed else pyramid))
 
 
-def _lookup_fwd(pyramid, coords_flat, radius, widths, out_dtype):
-    return (_lookup(pyramid, coords_flat, radius, widths, out_dtype),
+def _lookup_fwd(pyramid, packed_pyr, coords_flat, radius, widths, out_dtype,
+                packed):
+    return (_lookup(pyramid, packed_pyr, coords_flat, radius, widths,
+                    out_dtype, packed),
             (pyramid, coords_flat))
 
 
-def _lookup_bwd(radius, widths, out_dtype, residuals, g):
+def _lookup_bwd(radius, widths, out_dtype, packed, residuals, g):
     pyramid, coords_flat = residuals
     _, vjp = jax.vjp(
         lambda p: _masked_lookup_xla(p, coords_flat, radius, widths), pyramid)
     # The oracle emits fp32; a bf16-out kernel hands back a bf16 cotangent.
     (d_pyramid,) = vjp(g.astype(jnp.float32))
-    return d_pyramid, jnp.zeros_like(coords_flat)
+    d_packed = [jnp.zeros((*p.shape[:-1], p.shape[-1] // 2), jnp.float32)
+                for p in pyramid] if packed else []
+    return d_pyramid, d_packed, jnp.zeros_like(coords_flat)
 
 
 _lookup.defvjp(_lookup_fwd, _lookup_bwd)
@@ -392,10 +494,14 @@ def make_reg_tpu_corr_fn(fmap1: jax.Array, fmap2: jax.Array, *,
     d = fmap1.shape[-1]
     vol = jnp.einsum("bhid,bhjd->bhij", fmap1, f2p) * (1.0 / d ** 0.5)
     pyramid = build_pyramid(vol, num_levels)
+    # bf16 pyramids pair-pack into fp32 containers ONCE here (outside the
+    # GRU scan — 32 lookups amortize one bitcast pass) so the kernel runs
+    # the half-width-scan / no-upcast gather path every iteration.
+    packed = vol.dtype == jnp.bfloat16
     flat = []
     for lvl, vol in enumerate(pyramid):
         wp = vol.shape[-1]
-        want = pad_width(widths[lvl])
+        want = pad_width(widths[lvl], PACK_ALIGN if packed else LANE)
         if wp < want:
             vol = jnp.pad(vol, ((0, 0), (0, 0), (0, 0), (0, want - wp)))
         elif wp > want:
@@ -405,10 +511,14 @@ def make_reg_tpu_corr_fn(fmap1: jax.Array, fmap2: jax.Array, *,
         # sharding survive the reshape, so the partitioned lookup runs
         # per-shard under any row mesh.
         flat.append(vol.reshape(b, h * w1, -1))
+    # The kernel reads the pair-packed containers; the bf16 rows stay the
+    # differentiable operand (and are DCE'd from no-grad programs).
+    flat_packed = [pack_rows(r) for r in flat] if packed else []
 
     def corr_fn(coords_x: jax.Array) -> jax.Array:
         coords_flat = coords_x.astype(jnp.float32).reshape(b, h * w1, 1)
-        out = _lookup(flat, coords_flat, radius, widths, out_dtype)
+        out = _lookup(flat, flat_packed, coords_flat, radius, widths,
+                      out_dtype, packed)
         return out.reshape(b, h, w1, -1)
 
     return corr_fn

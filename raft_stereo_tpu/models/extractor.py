@@ -43,6 +43,23 @@ def _trunk_strides(downsample: int) -> Tuple[int, int, int]:
     return (1 + (downsample > 2), 1 + (downsample > 1), 1 + (downsample > 0))
 
 
+def _fused_trunk_then_layer2(p: Params, x: jax.Array, norm_fn: str, s2: int,
+                             trunk_packed, trunk_unpacked) -> jax.Array:
+    """Fused stem+layer1 followed by layer2, shared by both encoders.
+
+    When layer2 opens with stride 2, its entry convs consume the trunk's
+    parity-packed (H, W/2, 128) exit in place (the full-res interleaving
+    unpack copy never materializes); otherwise the trunk unpacks and
+    layer2 runs the plain stage."""
+    from raft_stereo_tpu.models.layers import apply_residual_block_packed
+    if s2 == 2:
+        xp = trunk_packed(p, x)
+        x = apply_residual_block_packed(p["layer2"][0], xp, norm_fn)
+        return apply_residual_block(p["layer2"][1], x, norm_fn, stride=1)
+    x = trunk_unpacked(p, x)
+    return _apply_stage(p["layer2"], x, norm_fn, s2)
+
+
 def init_basic_encoder(key: jax.Array, output_dim: int = 128,
                        norm_fn: str = "instance", downsample: int = 3) -> Params:
     from raft_stereo_tpu.models.layers import init_norm
@@ -61,19 +78,22 @@ def apply_basic_encoder(p: Params, x: jax.Array, *, norm_fn: str,
                         downsample: int, fused: bool = True) -> jax.Array:
     from raft_stereo_tpu.models.layers import apply_norm
     from raft_stereo_tpu.ops.pallas_encoder import (
-        fused_in_stem_layer1, in_stem_layer1_is_fusable)
+        fused_in_stem_layer1, fused_in_stem_layer1_packed,
+        in_stem_layer1_is_fusable)
     s_stem, s2, s3 = _trunk_strides(downsample)
     if fused and in_stem_layer1_is_fusable(p, x, norm_fn, s_stem):
         # Full-resolution stem + layer1 streamed one-pass-per-conv with
         # inline instance normalization (see ops/pallas_encoder.py).
-        x = fused_in_stem_layer1(p, x)
+        x = _fused_trunk_then_layer2(p, x, norm_fn, s2,
+                                     fused_in_stem_layer1_packed,
+                                     fused_in_stem_layer1)
     else:
         x = apply_conv(p["conv1"], x, stride=s_stem, padding=3)
         # Stem GroupNorm uses 8 groups (extractor.py:129), unlike blocks
         # (planes//8).
         x = jax.nn.relu(apply_norm(norm_fn, p["norm1"], x, num_groups=8))
         x = _apply_stage(p["layer1"], x, norm_fn, 1)
-    x = _apply_stage(p["layer2"], x, norm_fn, s2)
+        x = _apply_stage(p["layer2"], x, norm_fn, s2)
     x = _apply_stage(p["layer3"], x, norm_fn, s3)
     return apply_conv(p["conv2"], x)
 
@@ -114,18 +134,20 @@ def apply_multi_basic_encoder(p: Params, x: jax.Array, *, norm_fn: str,
     trunk features when ``dual_inp``."""
     from raft_stereo_tpu.models.layers import apply_norm
     from raft_stereo_tpu.ops.pallas_encoder import (
-        fused_stem_layer1, stem_layer1_is_fusable)
+        fused_stem_layer1, fused_stem_layer1_packed, stem_layer1_is_fusable)
     s_stem, s2, s3 = _trunk_strides(downsample)
     if fused and stem_layer1_is_fusable(p, x, norm_fn, s_stem):
         # Full-resolution stem + layer1 as ONE streaming Pallas pass
         # (frozen-BN folded into the convs) — the XLA chain materializes
         # five ~770 MB activations per frame at Middlebury-F.
-        x = fused_stem_layer1(p, x)
+        x = _fused_trunk_then_layer2(p, x, norm_fn, s2,
+                                     fused_stem_layer1_packed,
+                                     fused_stem_layer1)
     else:
         x = apply_conv(p["conv1"], x, stride=s_stem, padding=3)
         x = jax.nn.relu(apply_norm(norm_fn, p["norm1"], x, num_groups=8))
         x = _apply_stage(p["layer1"], x, norm_fn, 1)
-    x = _apply_stage(p["layer2"], x, norm_fn, s2)
+        x = _apply_stage(p["layer2"], x, norm_fn, s2)
     x = _apply_stage(p["layer3"], x, norm_fn, s3)
     if dual_inp:
         v = x

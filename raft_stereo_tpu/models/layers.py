@@ -92,6 +92,28 @@ def apply_residual_block(p: Params, x: jax.Array, norm_fn: str,
     return jax.nn.relu(x + y)
 
 
+def apply_residual_block_packed(p: Params, xp: jax.Array,
+                                norm_fn: str) -> jax.Array:
+    """Stride-2 ``ResidualBlock`` whose entry convs read the parity-packed
+    (H, W/2, 128) fused-trunk exit in place (``ops/pallas_encoder.py``):
+    stride 2 over true columns is stride 1 over packed columns, so the
+    interleaving unpack copy never materializes. Matches
+    ``apply_residual_block(p, unpack(xp), norm_fn, stride=2)``."""
+    from raft_stereo_tpu.ops.pallas_encoder import (
+        packed_entry_conv, packed_entry_w1, packed_entry_w3)
+    planes = p["conv1"]["w"].shape[-1]
+    groups = planes // 8
+    y = packed_entry_conv(xp, packed_entry_w3(p["conv1"]["w"]),
+                          p["conv1"].get("b"), window_w=2)
+    y = jax.nn.relu(apply_norm(norm_fn, p["norm1"], y, num_groups=groups))
+    y = apply_conv(p["conv2"], y, padding=1)
+    y = jax.nn.relu(apply_norm(norm_fn, p["norm2"], y, num_groups=groups))
+    x = packed_entry_conv(xp, packed_entry_w1(p["downsample"]["conv"]["w"]),
+                          p["downsample"]["conv"].get("b"), window_w=1)
+    x = apply_norm(norm_fn, p["downsample"]["norm"], x, num_groups=groups)
+    return jax.nn.relu(x + y)
+
+
 def init_bottleneck_block(key: jax.Array, in_planes: int, planes: int,
                           norm_fn: str, stride: int = 1) -> Params:
     """Reference ``BottleneckBlock`` (``core/extractor.py:64-120``; unused by

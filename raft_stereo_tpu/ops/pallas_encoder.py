@@ -163,7 +163,7 @@ def _stats_update(scr_st, st_ref, contrib):
 
 
 def stem_halves(x: jax.Array):
-    """(1, H, W, 3) image -> even/odd column halves (3, H+6, W/2+4).
+    """(1, H, W, 3) image -> even/odd column halves (3, H+8, W/2+4).
 
     The stem kernel assembles its tap-major patches IN VMEM from these
     two small resident arrays (one strided split here is the only
@@ -242,7 +242,7 @@ def _stem_th(hh: int, wp_total: int, taps: int) -> int:
 
 
 def _run_stem(halves, w, bias, hh, wp_total, dtype, stats: bool):
-    """halves: even/odd (3, H+6, W/2+4). Returns packed raw
+    """halves: even/odd (3, H+8, W/2+4). Returns packed raw
     (H, W/2, 128) + stats."""
     even, odd = halves
     cin = even.shape[0]
@@ -377,8 +377,12 @@ def _pass_kernel(*refs, kind: str, th: int, nb: int, nwb: int, wp: int,
 
 
 def _point3_kernel(s_ref, ms_ref, vs_ref, y2_ref, m2_ref, v2_ref,
-                   y4_ref, m4_ref, v4_ref, out_ref, *, stats: bool):
-    if stats:
+                   y4_ref, m4_ref, v4_ref, out_ref, *, norm: bool):
+    # ``norm`` is the trunk's norm mode (instance => apply the computed
+    # mean/inv), NOT the stats-accumulation flag the conv passes take —
+    # point3 never emits stats, so conflating the two silently skips
+    # normalization on the instance trunk.
+    if norm:
         o1 = jax.nn.relu(
             _normed(s_ref[...], ms_ref[...], vs_ref[...]).astype(jnp.float32)
             + _normed(y2_ref[...], m2_ref[...], v2_ref[...]))
@@ -392,13 +396,18 @@ def _point3_kernel(s_ref, ms_ref, vs_ref, y2_ref, m2_ref, v2_ref,
 
 
 def _run_pass(kind, inputs, w, bias, hh, wp_total, wb, dtype,
-              stats: bool):
+              stats: bool, *, norm: bool = False):
     """One streamed pass over packed (H?, W/2, 128) chain tensors.
 
     inputs: list of (raw, mean128, inv128) triples whose raw arrays may
     carry trailing trash rows (the upstream pass's lag block) — index
     maps only ever touch the first ``hh`` rows; mid outputs carry one
-    trash row-block themselves (only point3 exits exact)."""
+    trash row-block themselves (only point3 exits exact).
+
+    ``stats`` = accumulate/emit per-channel stats (conv kinds only);
+    ``norm`` = apply the computed instance norms in the point3 combine.
+    They are SEPARATE flags on purpose: conflating them silently skipped
+    normalization on the instance trunk (the r4 point3 regression)."""
     wp = wb // 2
     th = _enc_th(hh, wp)
     nb, nwb = hh // th, wp_total // wp
@@ -415,7 +424,7 @@ def _run_pass(kind, inputs, w, bias, hh, wp_total, wb, dtype,
                                              memory_space=pltpu.VMEM))
                 args.append(t)
         return pl.pallas_call(
-            functools.partial(_point3_kernel, stats=stats),
+            functools.partial(_point3_kernel, norm=norm),
             grid=(nb, nwb),
             in_specs=in_specs,
             out_specs=pl.BlockSpec((th, wp, 128), lambda i, s: (i, s, 0),
@@ -527,14 +536,23 @@ def _trunk_passes(halves, convs, hh, width, dtype, instance: bool):
                        hh, wp_total, wb, dtype, instance)
     m4, v4 = mv(st)
     o2 = _run_pass("point3", [(stem, m1, v1), (y2, m2, v2), (y4, m4, v4)],
-                   None, None, hh, wp_total, wb, dtype, False)
-    # The chain's one exit from the packed layout (Mosaic has no shape
-    # cast for the lane->sublane unpack; XLA does it in one fused copy).
-    return o2.reshape(hh, wp_total, 2, 64).reshape(hh, width, 64)[None]
+                   None, None, hh, wp_total, wb, dtype, False,
+                   norm=instance)
+    return o2  # packed (H, W/2, 128); _unpack_exit restores (1, H, W, 64)
 
 
-def fused_stem_layer1_impl(p: dict, x: jax.Array):
-    """Frozen-BN (cnet) stem + layer1; BN folded into the conv weights."""
+def _unpack_exit(o2: jax.Array) -> jax.Array:
+    """Packed (H, W/2, 128) -> (1, H, W, 64). The chain's one exit from the
+    packed layout (Mosaic has no shape cast for the lane->sublane unpack;
+    XLA does it in one fused copy — but the interleaving copy measured
+    ~50 ms/frame across the three trunk exits at Middlebury-F, which is why
+    the stride-2 layer2 entry consumes the packed form directly instead)."""
+    hh, wp_total, _ = o2.shape
+    return o2.reshape(hh, wp_total, 2, 64).reshape(hh, wp_total * 2, 64)[None]
+
+
+def _stem_layer1_packed(p: dict, x: jax.Array):
+    """Frozen-BN (cnet) stem + layer1, packed exit; BN folded into convs."""
     b, hh, width, _ = x.shape
     assert b == 1
     dtype = x.dtype
@@ -547,8 +565,8 @@ def fused_stem_layer1_impl(p: dict, x: jax.Array):
                          instance=False)
 
 
-def fused_in_stem_layer1_impl(p: dict, x: jax.Array):
-    """Instance-norm (fnet) stem + layer1 for one (1, H, W, 3) image."""
+def _in_stem_layer1_packed(p: dict, x: jax.Array):
+    """Instance-norm (fnet) stem + layer1, packed exit."""
     b, hh, width, _ = x.shape
     assert b == 1
     dtype = x.dtype
@@ -561,6 +579,52 @@ def fused_in_stem_layer1_impl(p: dict, x: jax.Array):
              cb(blk2["conv1"]), cb(blk2["conv2"])]
     return _trunk_passes(stem_halves(x), convs, hh, width, dtype,
                          instance=True)
+
+
+def fused_stem_layer1_impl(p: dict, x: jax.Array):
+    """Frozen-BN (cnet) stem + layer1; BN folded into the conv weights."""
+    return _unpack_exit(_stem_layer1_packed(p, x))
+
+
+def fused_in_stem_layer1_impl(p: dict, x: jax.Array):
+    """Instance-norm (fnet) stem + layer1 for one (1, H, W, 3) image."""
+    return _unpack_exit(_in_stem_layer1_packed(p, x))
+
+
+# ---------------------------------------------------------------------------
+# Packed layer2 entry: stride 2 over true columns is stride 1 over packed
+# columns, so layer2's entry convs can read the (H, W/2, 128) trunk exit in
+# place — no interleaving unpack copy ever materializes.
+# ---------------------------------------------------------------------------
+
+
+def packed_entry_w3(w: jax.Array) -> jax.Array:
+    """(3, 3, 64, C) stride-2 conv weight -> (3, 2, 128, C) over the packed
+    layout. Output col j reads true cols 2j-1, 2j, 2j+1 = the odd half of
+    packed col j-1 plus both halves of packed col j."""
+    z = jnp.zeros_like(w[:, :1])
+    k0 = jnp.concatenate([z, w[:, 0:1]], axis=2)          # [0 ; w(dx=-1)]
+    k1 = jnp.concatenate([w[:, 1:2], w[:, 2:3]], axis=2)  # [w(0) ; w(+1)]
+    return jnp.concatenate([k0, k1], axis=1)
+
+
+def packed_entry_w1(w: jax.Array) -> jax.Array:
+    """(1, 1, 64, C) stride-2 downsample weight -> (1, 1, 128, C): true col
+    2j is the even half of packed col j; the odd half never contributes."""
+    return jnp.concatenate([w, jnp.zeros_like(w)], axis=2)
+
+
+def packed_entry_conv(xp: jax.Array, w: jax.Array, b, *, window_w: int):
+    """Stride-(2,1) conv over the packed (H, W/2, 128) trunk exit, emitting
+    the normal (1, H/2, W/2, C) layout. ``w`` comes from ``packed_entry_w3``
+    (window_w=2) or ``packed_entry_w1`` (window_w=1)."""
+    pads = ((1, 1), (1, 0)) if window_w == 2 else ((0, 0), (0, 0))
+    out = jax.lax.conv_general_dilated(
+        xp[None], w.astype(xp.dtype), window_strides=(2, 1), padding=pads,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    if b is not None:
+        out = out + b.astype(out.dtype)
+    return out
 
 
 def _fusable(p: dict, x, stride: int) -> bool:
@@ -645,3 +709,49 @@ def _in_bwd(res, g):
 
 
 fused_in_stem_layer1.defvjp(_in_fwd, _in_bwd)
+
+
+def _packed_cotangent(g: jax.Array) -> jax.Array:
+    """Packed (H, W/2, 128) cotangent -> unpacked (1, H, W, 64) for the
+    XLA-oracle backward (the unpack is a reshape, so its transpose is the
+    same reshape on the cotangent)."""
+    return _unpack_exit(g)
+
+
+@jax.custom_vjp
+def fused_stem_layer1_packed(p: dict, x):
+    """cnet stem + layer1 with the packed (H, W/2, 128) exit (for the
+    stride-2 layer2 entry); backward via the XLA oracle."""
+    return _stem_layer1_packed(p, x)
+
+
+def _pk_fwd(p, x):
+    return fused_stem_layer1_packed(p, x), (p, x)
+
+
+def _pk_bwd(res, g):
+    p, x = res
+    out, vjp = jax.vjp(_oracle, p, x)
+    return vjp(_packed_cotangent(g).astype(out.dtype))
+
+
+fused_stem_layer1_packed.defvjp(_pk_fwd, _pk_bwd)
+
+
+@jax.custom_vjp
+def fused_in_stem_layer1_packed(p: dict, x):
+    """fnet stem + layer1 with the packed exit; backward via the oracle."""
+    return _in_stem_layer1_packed(p, x)
+
+
+def _in_pk_fwd(p, x):
+    return fused_in_stem_layer1_packed(p, x), (p, x)
+
+
+def _in_pk_bwd(res, g):
+    p, x = res
+    out, vjp = jax.vjp(_in_oracle, p, x)
+    return vjp(_packed_cotangent(g).astype(out.dtype))
+
+
+fused_in_stem_layer1_packed.defvjp(_in_pk_fwd, _in_pk_bwd)

@@ -195,3 +195,118 @@ def test_lookup_under_jit_and_scan(fmaps, coords, impl):
     ys = run(coords)
     assert ys.shape == (4,)
     assert np.isfinite(np.asarray(ys)).all()
+
+
+@pytest.mark.parametrize("w", [32, 200, 376, 640])
+def test_reg_tpu_packed_bf16_matches_reg(rng, w):
+    """bf16 fmaps engage the pair-packed lookup (two bf16 taps per 32-bit
+    lane, fp32-container rows): must match the fp32 reg path to bf16
+    rounding. Widths cover single-vreg, two-slab and multi-slab packed
+    rows (w=640 -> 768-wide padded bf16 = 3 packed i32 slabs at level 0)."""
+    b, h, d = 1, 4, 16
+    f1 = jnp.asarray(rng.standard_normal((b, h, w, d), dtype=np.float32))
+    f2 = jnp.asarray(rng.standard_normal((b, h, w, d), dtype=np.float32))
+    coords = jnp.asarray(
+        rng.uniform(-8, w + 6, size=(b, h, w)).astype(np.float32))
+    ref = make_corr_fn("reg", f1, f2, num_levels=LEVELS, radius=RADIUS)(coords)
+    out = make_corr_fn("reg_tpu", f1.astype(jnp.bfloat16),
+                       f2.astype(jnp.bfloat16), num_levels=LEVELS,
+                       radius=RADIUS)(coords)
+    # bf16 fmaps change the volume einsum inputs too; tolerance covers the
+    # bf16 volume, not just the packed tap transport.
+    np.testing.assert_allclose(np.asarray(out, np.float32), np.asarray(ref),
+                               atol=0.25, rtol=0.05)
+
+
+def test_reg_tpu_packed_exact_vs_unpacked_taps(rng):
+    """The packed gather transports the SAME bf16 tap values as the
+    unpacked path — bit-exact agreement between a bf16-volume reg_tpu
+    lookup and the masked one-hot oracle run on the identical bf16 rows."""
+    from raft_stereo_tpu.corr.pallas_reg import (
+        _masked_lookup_xla, level_widths, make_reg_tpu_corr_fn, pad_width)
+    from raft_stereo_tpu.corr.reg import build_pyramid
+    b, h, w, d = 1, 3, 200, 16
+    f1 = jnp.asarray(
+        rng.standard_normal((b, h, w, d), dtype=np.float32)).astype(
+            jnp.bfloat16)
+    f2 = jnp.asarray(
+        rng.standard_normal((b, h, w, d), dtype=np.float32)).astype(
+            jnp.bfloat16)
+    coords = jnp.asarray(
+        rng.uniform(-8, w + 6, size=(b, h, w)).astype(np.float32))
+    out = make_reg_tpu_corr_fn(f1, f2, num_levels=LEVELS,
+                               radius=RADIUS)(coords)
+    # Rebuild the identical bf16 rows the kernel saw and run the oracle.
+    widths = level_widths(w, LEVELS)
+    f2p = jnp.pad(f2, ((0, 0), (0, 0), (0, pad_width(w) - w), (0, 0)))
+    vol = jnp.einsum("bhid,bhjd->bhij", f1, f2p) * (1.0 / d ** 0.5)
+    rows = []
+    for lvl, v in enumerate(build_pyramid(vol, LEVELS)):
+        want = -(-widths[lvl] // 256) * 256
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, 0), (0, want - v.shape[-1])))
+        rows.append(v.reshape(b, h * w, -1))
+    ref = _masked_lookup_xla(rows, coords.reshape(b, h * w, 1), RADIUS,
+                             widths).reshape(b, h, w, -1)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+
+def test_reg_tpu_packed_grads_flow_bf16_fmaps(rng):
+    """Grads traverse pack_rows' bit-transport vjp back to bf16 fmaps."""
+    b, h, w, d = 1, 4, 200, 16
+    f1 = jnp.asarray(rng.standard_normal((b, h, w, d), dtype=np.float32))
+    f2 = jnp.asarray(rng.standard_normal((b, h, w, d), dtype=np.float32))
+    coords = jnp.asarray(
+        rng.uniform(-4, w + 3, size=(b, h, w)).astype(np.float32))
+
+    def loss(f1_, f2_):
+        fn = make_corr_fn("reg_tpu", f1_.astype(jnp.bfloat16),
+                          f2_.astype(jnp.bfloat16), num_levels=LEVELS,
+                          radius=RADIUS)
+        return jnp.sum(fn(coords).astype(jnp.float32) ** 2)
+
+    g1, g2 = jax.grad(loss, argnums=(0, 1))(f1, f2)
+    assert np.isfinite(np.asarray(g1)).all() and np.abs(g1).sum() > 0
+    assert np.isfinite(np.asarray(g2)).all() and np.abs(g2).sum() > 0
+
+
+def test_reg_tpu_packed_multi_call_grad_linearity(rng):
+    """Cotangents must sum LINEARLY across multiple lookups of one corr fn
+    (the GRU loop runs 32): grad of a two-call loss == sum of single-call
+    grads. Regression: routing grads through the fp32 bit-containers made
+    JAX sum packed cotangents as ordinary floats -> NaN/garbage."""
+    b, h, w, d = 1, 4, 200, 16
+    f1 = jnp.asarray(rng.standard_normal((b, h, w, d), dtype=np.float32))
+    f2 = jnp.asarray(rng.standard_normal((b, h, w, d), dtype=np.float32))
+    c1 = jnp.asarray(
+        rng.uniform(-4, w + 3, size=(b, h, w)).astype(np.float32))
+    c2 = jnp.asarray(
+        rng.uniform(-4, w + 3, size=(b, h, w)).astype(np.float32))
+
+    def loss(f1_, f2_, coords_list):
+        fn = make_corr_fn("reg_tpu", f1_.astype(jnp.bfloat16),
+                          f2_.astype(jnp.bfloat16), num_levels=LEVELS,
+                          radius=RADIUS)
+        return sum(jnp.sum(fn(c).astype(jnp.float32) ** 2)
+                   for c in coords_list)
+
+    g_both = jax.grad(loss, argnums=(0, 1))(f1, f2, [c1, c2])
+    g_1 = jax.grad(loss, argnums=(0, 1))(f1, f2, [c1])
+    g_2 = jax.grad(loss, argnums=(0, 1))(f1, f2, [c2])
+    for gb, ga, gc in zip(g_both, g_1, g_2):
+        gb, ga, gc = map(np.asarray, (gb, ga, gc))
+        assert np.isfinite(gb).all()
+        scale = np.abs(ga + gc).max() + 1e-6
+        assert np.abs(gb - (ga + gc)).max() / scale < 0.05
+
+
+def test_pack_unpack_rows_roundtrip(rng):
+    """unpack_rows inverts pack_rows bit-exactly (the layout contract the
+    packed kernel's in-register unpack relies on)."""
+    from raft_stereo_tpu.corr.pallas_reg import pack_rows, unpack_rows
+    rows = jnp.asarray(
+        rng.standard_normal((2, 5, 256), dtype=np.float32)).astype(
+            jnp.bfloat16)
+    back = unpack_rows(pack_rows(rows))
+    assert back.dtype == jnp.bfloat16 and back.shape == rows.shape
+    assert (np.asarray(back, np.float32)
+            == np.asarray(rows, np.float32)).all()

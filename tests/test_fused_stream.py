@@ -212,3 +212,84 @@ def test_fused_fnet_stem_layer1_matches_oracle(hw):
     ref = np.asarray(_in_oracle(p, x))
     got = np.asarray(fused_in_stem_layer1_impl(p, x))
     assert np.abs(got - ref).max() < 5e-2, np.abs(got - ref).max()
+
+
+@pytest.mark.parametrize("norm_fn", ["batch", "instance"])
+def test_packed_entry_block_matches_unpacked(norm_fn):
+    """Stride-2 residual block over the parity-packed trunk exit vs the same
+    block over the unpacked (1, H, W, 64) layout — pure XLA on both sides,
+    so the only delta is MAC reassociation (the packed weights add exact
+    zero taps)."""
+    from raft_stereo_tpu.models.layers import (
+        apply_residual_block, apply_residual_block_packed,
+        init_residual_block)
+    key = jax.random.PRNGKey(3)
+    p = init_residual_block(key, 64, 96, norm_fn, stride=2)
+    h_, w_ = 20, 32
+    x = jax.random.normal(jax.random.PRNGKey(4), (1, h_, w_, 64))
+    xp = x[0].reshape(h_, w_ // 2, 2, 64).reshape(h_, w_ // 2, 128)
+    ref = np.asarray(apply_residual_block(p, x, norm_fn, stride=2))
+    got = np.asarray(apply_residual_block_packed(p, xp, norm_fn))
+    assert got.shape == ref.shape
+    assert np.abs(got - ref).max() < 1e-5, np.abs(got - ref).max()
+
+
+@pytest.mark.parametrize("norm_fn", ["batch", "instance"])
+def test_fused_encoder_end_to_end_packed_layer2(norm_fn):
+    """Full encoder with the fused trunk + packed layer2 entry vs the pure
+    XLA chain (fused=False) — certifies the default inference path through
+    layer3/heads, including the no-unpack packed handoff."""
+    from raft_stereo_tpu.models.extractor import (
+        apply_basic_encoder, apply_multi_basic_encoder, init_basic_encoder,
+        init_multi_basic_encoder)
+    key = jax.random.PRNGKey(5)
+    x = jax.random.normal(jax.random.PRNGKey(6), (1, 48, 24, 3))
+    if norm_fn == "instance":
+        p = init_basic_encoder(key, output_dim=256, norm_fn="instance",
+                               downsample=2)
+        ref = np.asarray(apply_basic_encoder(
+            p, x, norm_fn="instance", downsample=2, fused=False))
+        got = np.asarray(apply_basic_encoder(
+            p, x, norm_fn="instance", downsample=2, fused=True))
+    else:
+        p = init_multi_basic_encoder(key, output_dim=[[128] * 3],
+                                     norm_fn="batch", downsample=2)
+        ref = np.asarray(apply_multi_basic_encoder(
+            p, x, norm_fn="batch", downsample=2, num_layers=3,
+            fused=False)[0][0])
+        got = np.asarray(apply_multi_basic_encoder(
+            p, x, norm_fn="batch", downsample=2, num_layers=3,
+            fused=True)[0][0])
+    assert got.shape == ref.shape
+    assert np.abs(got - ref).max() < 5e-2, np.abs(got - ref).max()
+
+
+def test_fused_encoder_packed_grad_matches_oracle():
+    """d(loss)/d(params, x) through the packed custom_vjp == the XLA chain's
+    gradients (the packed backward re-runs the oracle on the reshaped
+    cotangent)."""
+    from raft_stereo_tpu.models.extractor import (
+        apply_basic_encoder, init_basic_encoder)
+    key = jax.random.PRNGKey(7)
+    p = init_basic_encoder(key, output_dim=256, norm_fn="instance",
+                           downsample=2)
+    x = jax.random.normal(jax.random.PRNGKey(8), (1, 48, 24, 3))
+
+    def loss(fused):
+        def f(p_, x_):
+            out = apply_basic_encoder(p_, x_, norm_fn="instance",
+                                      downsample=2, fused=fused)
+            return jnp.sum(out * out)
+        return jax.grad(f, argnums=(0, 1))(p, x)
+
+    g_ref, gx_ref = loss(False)
+    g_got, gx_got = loss(True)
+    rel = np.abs(np.asarray(gx_got) - np.asarray(gx_ref)).max() / (
+        np.abs(np.asarray(gx_ref)).max() + 1e-8)
+    assert rel < 5e-2, rel
+    flat_ref = jax.tree_util.tree_leaves(g_ref)
+    flat_got = jax.tree_util.tree_leaves(g_got)
+    for a, b in zip(flat_got, flat_ref):
+        d = np.abs(np.asarray(a) - np.asarray(b)).max()
+        s = np.abs(np.asarray(b)).max() + 1e-8
+        assert d / s < 5e-2, (d, s)
