@@ -184,7 +184,10 @@ def main() -> None:
         # HLO analysis takes ~2 s there, while the axon platform's
         # lowering path measured minutes.
         import subprocess
-        import sys
+        # XLA counts a while-loop BODY once regardless of trip count
+        # (measured: scan flops identical at 4 vs 8 iters), which
+        # understated MFU ~5x at 32 iters. Two UNROLLED lowerings at 1
+        # and 2 iterations give the per-iteration slope; extrapolate.
         code = (
             "import jax; jax.config.update('jax_platforms', 'cpu')\n"
             "import jax.numpy as jnp\n"
@@ -199,12 +202,15 @@ def main() -> None:
             f"slow_fast_gru={cfg.slow_fast_gru})\n"
             "params = init_raft_stereo(jax.random.PRNGKey(0), cfg)\n"
             f"img = jnp.zeros(({batch}, {h}, {w}, 3), jnp.float32)\n"
-            "def fwd(p, a, b):\n"
-            "    _, up = raft_stereo_forward(p, cfg, a, b, "
-            f"iters={iters}, test_mode=True)\n"
-            "    return up\n"
-            "ca = jax.jit(fwd).lower(params, img, img).cost_analysis()\n"
-            "print('FLOPS', ca.get('flops', 0.0) if ca else 0.0)\n")
+            "def f(n):\n"
+            "    def fwd(p, a, b):\n"
+            "        _, up = raft_stereo_forward(p, cfg, a, b, iters=n, "
+            "test_mode=True, unroll=True)\n"
+            "        return up\n"
+            "    ca = jax.jit(fwd).lower(params, img, img).cost_analysis()\n"
+            "    return (ca or {}).get('flops', 0.0)\n"
+            "f1, f2 = f(1), f(2)\n"
+            f"print('FLOPS', f1 + (f2 - f1) * ({iters} - 1))\n")
         env = dict(os.environ, JAX_PLATFORMS="cpu")
         out = subprocess.run([sys.executable, "-c", code], env=env,
                              cwd=os.path.dirname(os.path.abspath(__file__)),

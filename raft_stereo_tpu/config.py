@@ -40,6 +40,15 @@ class RAFTStereoConfig:
     # Mosaic kernels have no SPMD partitioning rule, so a jit sharded over a
     # real multi-chip mesh cannot split the pallas_call.
     fused_update: bool = True
+    # Engage the streaming kernels in TRAINING too (forward only; backward
+    # stays the XLA-oracle custom_vjp). The train scan then remats with
+    # ``save_only_these_names('stream_kernel')`` so each kernel forward runs
+    # ONCE instead of twice. Default off: at the reference's small crop
+    # shapes the row streams are too short to amortize kernel fixed costs
+    # (r4 measured 0.64 -> 0.13 steps/s without the policy; see BASELINE.md
+    # for the policy-on measurement) — profitable only for large-crop /
+    # full-res fine-tuning.
+    fused_train: bool = False
 
     def __post_init__(self):
         self.hidden_dims = tuple(self.hidden_dims)

@@ -54,6 +54,7 @@ VPU/MXU work in both directions), numerically identical to the kernel.
 from __future__ import annotations
 
 import functools
+import os
 from typing import List, Sequence, Tuple
 
 import jax
@@ -68,7 +69,9 @@ LANE = 128
 # per-step fixed-cost measurement (~5-10 us/step on the remote v5e —
 # 732 steps/lookup ~= 4.4 ms against a ~1.4 ms DMA roofline) says the
 # step COUNT was the real cost: 2048 cuts it 4x for ~11 MB more VMEM.
-TILE = 2048
+# Env override for sweeps (scratch/sweep_tile.py); r5 sweep table in
+# BASELINE.md.
+TILE = int(os.environ.get("RAFT_CORR_TILE", 2048))
 
 
 def _interpret() -> bool:
@@ -107,18 +110,19 @@ def gather_lerp_taps(vol, cl, radius: int, w2: int):
     if w2p > LANE:
         # Coarse: select the two vreg-aligned 128-lane slabs bracketing the
         # tap window (select-scans over aligned slices only — no cross-vreg
-        # relayouts; ~2 VPU ops per element per scan, once per level).
+        # relayouts). ONE merged pass: slab s feeds win_a where slab==s and
+        # win_b where slab==s-1, so each slab is read once.
         nslab = w2p // LANE
         slab = jnp.clip(base // LANE, 0, nslab - 1)
-        slab_b = jnp.minimum(slab + 1, nslab - 1)
         win_a = vol[:, 0:LANE]
-        win_b = vol[:, (nslab - 1) * LANE:]
+        win_b = vol[:, LANE:2 * LANE]
         for s in range(1, nslab):
-            win_a = jnp.where(slab == s, vol[:, s * LANE:(s + 1) * LANE],
-                              win_a)
-        for s in range(1, nslab - 1):
-            win_b = jnp.where(slab_b == s, vol[:, s * LANE:(s + 1) * LANE],
-                              win_b)
+            sl = vol[:, s * LANE:(s + 1) * LANE]
+            win_a = jnp.where(slab == s, sl, win_a)
+            if s >= 2:
+                win_b = jnp.where(slab == s - 1, sl, win_b)
+        # slab == nslab-1 leaves win_b stale; any rel >= LANE there implies
+        # xpos >= w2p >= w2, zeroed by the bounds mask below.
         # Fine: Mosaic's take_along_axis works on exactly one 128-lane vreg
         # AND only in 32-bit (index/result bitwidths must match, indices
         # are i32 — a bf16 gather was tried in r4 and rejected by Mosaic),
@@ -337,12 +341,12 @@ def make_batch_partitioned(impl, batch_in_axes: Sequence,
 
 
 def _lookup_kernel(coords_ref, *refs, radius: int, widths: Sequence[int],
-                   packed: bool):
+                   packed: Tuple[bool, ...]):
     *vol_refs, out_ref = refs
     k = 2 * radius + 1
-    taps = gather_lerp_taps_packed if packed else gather_lerp_taps
     c = coords_ref[:]  # (TILE, 1) fp32
     for lvl, vol_ref in enumerate(vol_refs):
+        taps = gather_lerp_taps_packed if packed[lvl] else gather_lerp_taps
         cl = c * (1.0 / (1 << lvl))
         out_ref[:, lvl * k:(lvl + 1) * k] = taps(
             vol_ref[:], cl, radius, widths[lvl]).astype(out_ref.dtype)
@@ -350,7 +354,7 @@ def _lookup_kernel(coords_ref, *refs, radius: int, widths: Sequence[int],
 
 def _pallas_lookup(pyramid: Sequence[jax.Array], coords_flat: jax.Array,
                    radius: int, widths: Tuple[int, ...],
-                   out_dtype, packed: bool = False) -> jax.Array:
+                   out_dtype, packed: Tuple[bool, ...]) -> jax.Array:
     """pyramid: list of (N, W2p_l) fp32; coords_flat: (N, 1) fp32."""
     n = coords_flat.shape[0]
     k = 2 * radius + 1
@@ -378,7 +382,7 @@ def _pallas_lookup(pyramid: Sequence[jax.Array], coords_flat: jax.Array,
 
 @functools.lru_cache(maxsize=None)
 def _partitioned_lookup(radius: int, widths: Tuple[int, ...], out_dtype_name,
-                        nlev: int, packed: bool = False):
+                        nlev: int, packed: Tuple[bool, ...] = ()):
     """SPMD-partitionable 3D lookup: coords (B, N, 1) + per-level rows
     (B, N, W2p_l) -> (B, N, nlev*(2r+1)), independent along (B, N) — any
     mesh sharding of the leading two axes runs the flat kernel per-shard.
@@ -389,7 +393,8 @@ def _partitioned_lookup(radius: int, widths: Tuple[int, ...], out_dtype_name,
         b, n, _ = coords3.shape
         flat = [p.reshape(b * n, p.shape[-1]) for p in pyr3]
         out = _pallas_lookup(flat, coords3.reshape(b * n, 1), radius,
-                             widths, out_dtype, packed=packed)
+                             widths, out_dtype,
+                             packed or (False,) * nlev)
         return out.reshape(b, n, -1)
 
     rule = ("b n u, " + ", ".join(f"b n w{i}" for i in range(nlev))
@@ -433,15 +438,19 @@ def _masked_lookup_xla(pyramid: Sequence[jax.Array], coords_flat: jax.Array,
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _lookup(pyramid: List[jax.Array], packed_pyr: List[jax.Array],
             coords_flat: jax.Array, radius: int, widths: Tuple[int, ...],
-            out_dtype=jnp.float32, packed: bool = False) -> jax.Array:
+            out_dtype=jnp.float32,
+            packed: Tuple[bool, ...] = ()) -> jax.Array:
     """pyramid: per-level (B, N, W2p_l) bf16/fp32 rows — the DIFFERENTIABLE
     operand (cotangents sum linearly across the loop's 32 lookup calls);
-    packed_pyr: the same rows pair-packed into fp32 containers (see
-    ``pack_rows``; empty unless ``packed``) — what the kernel reads, zero
-    cotangent. coords_flat: (B, N, 1)."""
+    packed_pyr: pair-packed fp32-container rows for the levels with
+    ``packed[lvl]`` True (see ``pack_rows``; same length as pyramid, with
+    the unpacked levels' entries aliasing the bf16 rows) — what the kernel
+    reads, zero cotangent for the packed entries. coords_flat: (B, N, 1).
+    """
     fn = _partitioned_lookup(radius, widths, jnp.dtype(out_dtype).name,
                              len(pyramid), packed)
-    return fn(coords_flat, *(packed_pyr if packed else pyramid))
+    rows = packed_pyr if any(packed) else pyramid
+    return fn(coords_flat, *rows)
 
 
 def _lookup_fwd(pyramid, packed_pyr, coords_flat, radius, widths, out_dtype,
@@ -458,7 +467,8 @@ def _lookup_bwd(radius, widths, out_dtype, packed, residuals, g):
     # The oracle emits fp32; a bf16-out kernel hands back a bf16 cotangent.
     (d_pyramid,) = vjp(g.astype(jnp.float32))
     d_packed = [jnp.zeros((*p.shape[:-1], p.shape[-1] // 2), jnp.float32)
-                for p in pyramid] if packed else []
+                if is_p else jnp.zeros_like(p)
+                for p, is_p in zip(pyramid, packed)] if any(packed) else []
     return d_pyramid, d_packed, jnp.zeros_like(coords_flat)
 
 
@@ -494,14 +504,20 @@ def make_reg_tpu_corr_fn(fmap1: jax.Array, fmap2: jax.Array, *,
     d = fmap1.shape[-1]
     vol = jnp.einsum("bhid,bhjd->bhij", fmap1, f2p) * (1.0 / d ** 0.5)
     pyramid = build_pyramid(vol, num_levels)
-    # bf16 pyramids pair-pack into fp32 containers ONCE here (outside the
-    # GRU scan — 32 lookups amortize one bitcast pass) so the kernel runs
-    # the half-width-scan / no-upcast gather path every iteration.
-    packed = vol.dtype == jnp.bfloat16
-    flat = []
+    # bf16 pyramid levels pair-pack into fp32 containers ONCE here (outside
+    # the GRU scan — 32 lookups amortize one bitcast pass) so the kernel
+    # runs the half-width-scan / no-upcast gather path every iteration.
+    # Per-level decision: pack only when the 256-multiple alignment the
+    # container needs pads no further than the plain 128 alignment —
+    # otherwise (e.g. a 372-wide level padding 384 -> 512) the extra zero
+    # lanes cost more per-step DMA than the packed gather saves.
+    bf16 = vol.dtype == jnp.bfloat16
+    packed = tuple(
+        bf16 and pad_width(w_, PACK_ALIGN) == pad_width(w_) for w_ in widths)
+    flat, kernel_rows = [], []
     for lvl, vol in enumerate(pyramid):
         wp = vol.shape[-1]
-        want = pad_width(widths[lvl], PACK_ALIGN if packed else LANE)
+        want = pad_width(widths[lvl], PACK_ALIGN if packed[lvl] else LANE)
         if wp < want:
             vol = jnp.pad(vol, ((0, 0), (0, 0), (0, 0), (0, want - wp)))
         elif wp > want:
@@ -510,15 +526,16 @@ def make_reg_tpu_corr_fn(fmap1: jax.Array, fmap2: jax.Array, *,
         # with W1 (minor, unsharded) — both mesh axes of a (data, space)
         # sharding survive the reshape, so the partitioned lookup runs
         # per-shard under any row mesh.
-        flat.append(vol.reshape(b, h * w1, -1))
-    # The kernel reads the pair-packed containers; the bf16 rows stay the
-    # differentiable operand (and are DCE'd from no-grad programs).
-    flat_packed = [pack_rows(r) for r in flat] if packed else []
+        rows = vol.reshape(b, h * w1, -1)
+        flat.append(rows)
+        # The kernel reads the containers on packed levels; the bf16 rows
+        # stay the differentiable operand (DCE'd from no-grad programs).
+        kernel_rows.append(pack_rows(rows) if packed[lvl] else rows)
 
     def corr_fn(coords_x: jax.Array) -> jax.Array:
         coords_flat = coords_x.astype(jnp.float32).reshape(b, h * w1, 1)
-        out = _lookup(flat, flat_packed, coords_flat, radius, widths,
-                      out_dtype, packed)
+        out = _lookup(flat, kernel_rows if any(packed) else [], coords_flat,
+                      radius, widths, out_dtype, packed)
         return out.reshape(b, h, w1, -1)
 
     return corr_fn

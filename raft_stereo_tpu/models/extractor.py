@@ -43,6 +43,12 @@ def _trunk_strides(downsample: int) -> Tuple[int, int, int]:
     return (1 + (downsample > 2), 1 + (downsample > 1), 1 + (downsample > 0))
 
 
+def _packed_l2_enabled() -> bool:
+    import os
+    return os.environ.get("RAFT_PACKED_L2", "1").strip().lower() not in (
+        "0", "false", "no", "off")
+
+
 def _fused_trunk_then_layer2(p: Params, x: jax.Array, norm_fn: str, s2: int,
                              trunk_packed, trunk_unpacked) -> jax.Array:
     """Fused stem+layer1 followed by layer2, shared by both encoders.
@@ -50,9 +56,10 @@ def _fused_trunk_then_layer2(p: Params, x: jax.Array, norm_fn: str, s2: int,
     When layer2 opens with stride 2, its entry convs consume the trunk's
     parity-packed (H, W/2, 128) exit in place (the full-res interleaving
     unpack copy never materializes); otherwise the trunk unpacks and
-    layer2 runs the plain stage."""
+    layer2 runs the plain stage. RAFT_PACKED_L2=0 forces the unpacked
+    handoff (A/B knob)."""
     from raft_stereo_tpu.models.layers import apply_residual_block_packed
-    if s2 == 2:
+    if s2 == 2 and _packed_l2_enabled():
         xp = trunk_packed(p, x)
         x = apply_residual_block_packed(p["layer2"][0], xp, norm_fn)
         return apply_residual_block(p["layer2"][1], x, norm_fn, stride=1)

@@ -20,6 +20,7 @@ from typing import List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 
 from raft_stereo_tpu.config import RAFTStereoConfig
 from raft_stereo_tpu.corr import make_corr_fn
@@ -167,13 +168,14 @@ def raft_stereo_forward(params: Params, cfg: RAFTStereoConfig,
     # None, keeping the whole scan body on partitionable XLA ops.
     from raft_stereo_tpu.ops.pallas_stream import (
         gru_is_fusable, prepare_gru_context, spatial_gru_is_fusable)
-    # The streaming kernels engage in TEST MODE only. Training was
-    # measured (r4, batch-6 320x720 crops on the v5e): the remat'd scan
-    # runs each kernel forward twice while the backward still pays the
-    # full XLA oracle, and at crop shapes the row streams are too short
-    # to amortize — 0.64 -> 0.13 steps/s. Inference is where they earn
-    # their keep (tall full-frame streams, no backward).
-    fuse = cfg.fused_update and test_mode
+    # The streaming kernels engage in test mode by default. Training
+    # engages them only under cfg.fused_train: r4 measured (batch-6
+    # 320x720 crops on the v5e) that the remat'd scan runs each kernel
+    # forward twice while the backward still pays the full XLA oracle,
+    # and at crop shapes the row streams are too short to amortize —
+    # 0.64 -> 0.13 steps/s. fused_train adds a remat policy that saves
+    # the kernel outputs (one forward each); see the scan below.
+    fuse = cfg.fused_update and (test_mode or cfg.fused_train)
     if space_mesh is not None:
         # Per-shard czrq (halo-exchanged, bias-folded, pre-padded) —
         # hoisted out of the scan exactly like the unsharded entries.
@@ -189,33 +191,45 @@ def raft_stereo_forward(params: Params, cfg: RAFTStereoConfig,
             else None
             for i in range(cfg.n_gru_layers)]
     else:
+        # Training engagement (fused_train) fuses at any batch size — the
+        # 200k-pixel batch threshold is an eval heuristic (see
+        # gru_is_fusable).
+        any_batch = not test_mode and cfg.fused_train
         fused_ctx = [
             prepare_gru_context(
                 params["update_block"][("gru08", "gru16", "gru32")[i]],
                 inp[i], compute_dtype)
-            if fuse and gru_is_fusable(net[i]) else None
+            if fuse and gru_is_fusable(net[i], any_batch=any_batch) else None
             for i in range(cfg.n_gru_layers)]
 
     def one_iteration(net, coords1, compute_mask=True):
         coords1 = lax.stop_gradient(coords1)  # truncated BPTT (:109)
         corr = corr_fn(coords1[..., 0])  # already compute_dtype (out_dtype)
+        # Named so the fused-train remat policy saves the lookup output
+        # (its custom_vjp backward needs only the residual coords/volume,
+        # never a kernel re-run). No-op outside that policy.
+        corr = checkpoint_name(corr, "stream_kernel")
         flow = (coords1 - coords0).astype(compute_dtype)
+        fuse_any_batch = not test_mode and cfg.fused_train
         if cfg.n_gru_layers == 3 and cfg.slow_fast_gru:  # low-res GRU only
             net = apply_update_block(params["update_block"], cfg, net, inp,
                                      iter32=True, iter16=False, iter08=False,
                                      update=False, fused_ctx=fused_ctx,
-                                     space_mesh=space_mesh)
+                                     space_mesh=space_mesh,
+                                     fuse_any_batch=fuse_any_batch)
         if cfg.n_gru_layers >= 2 and cfg.slow_fast_gru:  # low+mid-res GRUs
             net = apply_update_block(params["update_block"], cfg, net, inp,
                                      iter32=cfg.n_gru_layers == 3, iter16=True,
                                      iter08=False, update=False,
                                      fused_ctx=fused_ctx,
-                                     space_mesh=space_mesh)
+                                     space_mesh=space_mesh,
+                                     fuse_any_batch=fuse_any_batch)
         net, up_mask, delta_flow = apply_update_block(
             params["update_block"], cfg, net, inp, corr, flow,
             iter32=cfg.n_gru_layers == 3, iter16=cfg.n_gru_layers >= 2,
             compute_mask=compute_mask, fused_ctx=fused_ctx,
-            fuse_motion=flow_init is None, space_mesh=space_mesh)
+            fuse_motion=flow_init is None, space_mesh=space_mesh,
+            fuse_any_batch=fuse_any_batch)
         # Stereo: project the update onto the epipolar line (:120).
         delta_flow = delta_flow.astype(jnp.float32).at[..., 1].set(0.0)
         coords1 = coords1 + delta_flow
@@ -262,7 +276,16 @@ def raft_stereo_forward(params: Params, cfg: RAFTStereoConfig,
     # batch-6 training config — past a v5e chip's HBM). The reference's
     # truncated BPTT means each step's backward needs only that step's
     # activations, so remat trades ~1/3 extra backward FLOPs for O(1-step)
-    # memory.
+    # memory. When the streaming kernels are engaged (fused_train), the
+    # policy additionally saves their tagged outputs so each kernel
+    # forward runs ONCE — remat would otherwise re-run every pallas_call
+    # on top of the XLA-oracle backward.
+    if any(c is not None for c in fused_ctx):
+        ckpt = jax.checkpoint(
+            step, policy=jax.checkpoint_policies.save_only_these_names(
+                "stream_kernel"))
+    else:
+        ckpt = jax.checkpoint(step)
     (net, coords1), flow_predictions = lax.scan(
-        jax.checkpoint(step), (net, coords1), None, length=iters)
+        ckpt, (net, coords1), None, length=iters)
     return flow_predictions

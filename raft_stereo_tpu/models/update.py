@@ -193,7 +193,8 @@ def apply_update_block(p: Params, cfg: RAFTStereoConfig,
                        update: bool = True, compute_mask: bool = True,
                        fused_ctx: Sequence | None = None,
                        fuse_motion: bool = True,
-                       space_mesh=None):
+                       space_mesh=None,
+                       fuse_any_batch: bool = False):
     """Reference ``BasicMultiUpdateBlock.forward`` (``core/update.py:115-138``).
 
     net: per-scale hidden states, finest first. inp: per-scale (cz, cr, cq).
@@ -216,6 +217,7 @@ def apply_update_block(p: Params, cfg: RAFTStereoConfig,
     variants (fused_ctx then holds True flags — the gate context is
     folded per shard).
     """
+    from jax.ad_checkpoint import checkpoint_name
     from raft_stereo_tpu.ops.pallas_stream import (
         fused_conv_gru, fused_conv_gru_spatial, fused_gru_head,
         fused_gru_head_spatial, fused_motion, fused_motion_spatial,
@@ -223,16 +225,24 @@ def apply_update_block(p: Params, cfg: RAFTStereoConfig,
     fc = list(fused_ctx) if fused_ctx is not None else []
     fc += [None] * (3 - len(fc))
 
+    # Kernel outputs are checkpoint-named so the fused-train remat policy
+    # (save_only_these_names in raft_stereo.py) saves them: without the
+    # tag, jax.checkpoint re-runs every pallas_call forward in the
+    # backward pass. No-op outside that policy (and in test mode).
+    def kname(x):
+        return checkpoint_name(x, "stream_kernel")
+
     def gru(idx, h, ctx, *xs):
         gp = p[("gru08", "gru16", "gru32")[idx]]
         # bf16 single-sample steps run the streaming Pallas kernel (gate
         # convs + nonlinearities + state update fused in VMEM); other
         # shapes/dtypes use the XLA formulation.
         if fc[idx] is not None and space_mesh is not None:
-            return fused_conv_gru_spatial(space_mesh, gp, h, fc[idx], ctx,
-                                          *xs)
-        if fc[idx] is not None and gru_is_fusable(h, *xs):
-            return fused_conv_gru(gp, h, fc[idx], ctx, *xs)
+            return kname(fused_conv_gru_spatial(space_mesh, gp, h, fc[idx],
+                                                ctx, *xs))
+        if fc[idx] is not None and gru_is_fusable(
+                h, *xs, any_batch=fuse_any_batch):
+            return kname(fused_conv_gru(gp, h, fc[idx], ctx, *xs))
         return apply_conv_gru(gp, h, ctx, *xs)
 
     net = list(net)
@@ -254,10 +264,11 @@ def apply_update_block(p: Params, cfg: RAFTStereoConfig,
         if (fuse_motion and fc[0] is not None and space_mesh is not None
                 and spatial_motion_is_fusable(
                     corr, space_mesh.shape.get("space", 1))):
-            motion = fused_motion_spatial(space_mesh, p["encoder"], flow,
-                                          corr)
-        elif fuse_motion and fc[0] is not None and motion_is_fusable(corr):
-            motion = fused_motion(p["encoder"], flow, corr)
+            motion = kname(fused_motion_spatial(space_mesh, p["encoder"],
+                                                flow, corr))
+        elif (fuse_motion and fc[0] is not None
+                and motion_is_fusable(corr, any_batch=fuse_any_batch)):
+            motion = kname(fused_motion(p["encoder"], flow, corr))
         else:
             motion = apply_motion_encoder(p["encoder"], flow, corr)
         xs = (motion, interp_align_corners(net[1], net[0].shape[1:3])) \
@@ -267,10 +278,12 @@ def apply_update_block(p: Params, cfg: RAFTStereoConfig,
             net[0], delta_x = fused_gru_head_spatial(
                 space_mesh, p["gru08"], p["flow_head"], net[0], fc[0],
                 inp[0], *xs)
+            net[0], delta_x = kname(net[0]), kname(delta_x)
         elif (update and not compute_mask and fc[0] is not None
-                and gru_is_fusable(net[0], *xs)):
+                and gru_is_fusable(net[0], *xs, any_batch=fuse_any_batch)):
             net[0], delta_x = fused_gru_head(
                 p["gru08"], p["flow_head"], net[0], fc[0], inp[0], *xs)
+            net[0], delta_x = kname(net[0]), kname(delta_x)
         else:
             net[0] = gru(0, net[0], inp[0], *xs)
     net = tuple(net)

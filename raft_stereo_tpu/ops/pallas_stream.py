@@ -433,21 +433,31 @@ def _fused_gru_head_bwd(res, g):
 fused_gru_head.defvjp(_fused_gru_head_fwd, _fused_gru_head_bwd)
 
 
+def _batch_fuse_pixels() -> int:
+    import os
+    return int(os.environ.get("RAFT_BATCH_FUSE_PIXELS", 200_000))
+
+
 def _batch_worthwhile(t) -> bool:
     """B>1 engages the kernels only for big per-sample frames: at small
     shapes the per-sample ring flush/fixed costs beat the fusion win —
     measured r4: batch-16 realtime eval (48x156/sample) regressed 129 ->
     83 fps fused, while B=1 Middlebury (504x744) is the kernels' +9%
-    headline. 200k pixels ~= half of Middlebury-F's 1/4-res plane."""
-    return t.shape[0] == 1 or t.shape[1] * t.shape[2] >= 200_000
+    headline. 200k pixels ~= half of Middlebury-F's 1/4-res plane.
+    RAFT_BATCH_FUSE_PIXELS overrides the threshold (0 = always fuse;
+    sweep table in BASELINE.md)."""
+    return t.shape[0] == 1 or t.shape[1] * t.shape[2] >= _batch_fuse_pixels()
 
 
-def gru_is_fusable(h, *x_list) -> bool:
+def gru_is_fusable(h, *x_list, any_batch: bool = False) -> bool:
     """Shapes/dtype the streaming kernel supports; callers fall back to
     the XLA path otherwise (fp32 runs exceed the VMEM budget at full
-    res). Batch rides as the outer grid dimension since r4 (big frames
-    only — see ``_batch_worthwhile``)."""
-    return (_dtype_ok(h) and _batch_worthwhile(h)
+    res). Batch rides as the outer grid dimension since r4; B>1 engages
+    only for big frames (``_batch_worthwhile``, an EVAL heuristic) unless
+    ``any_batch`` — fused TRAINING (cfg.fused_train) measured 0.742 vs
+    0.637 steps/s at the reference batch-6 320x720 crop config (r5, with
+    the save-kernel-outputs remat policy), so it fuses at any batch."""
+    return (_dtype_ok(h) and (any_batch or _batch_worthwhile(h))
             and pick_th(h.shape[1], h.shape[2]) > 0 and h.shape[1] >= 8)
 
 
@@ -816,8 +826,8 @@ def fused_motion_fwd_impl(p: dict, flow, corr):
     return out[:, lag:lag + hh]
 
 
-def motion_is_fusable(corr) -> bool:
-    return (_dtype_ok(corr) and _batch_worthwhile(corr)
+def motion_is_fusable(corr, any_batch: bool = False) -> bool:
+    return (_dtype_ok(corr) and (any_batch or _batch_worthwhile(corr))
             and pick_th(corr.shape[1], corr.shape[2]) > 0 and corr.shape[1] >= 8)
 
 

@@ -16,10 +16,17 @@ from raft_stereo_tpu.engine.steps import make_train_step
 from raft_stereo_tpu.models import init_raft_stereo
 
 corr = os.environ.get("TRAIN_BENCH_CORR", "reg_tpu")
-b, h, w, iters = 6, 320, 720, 22
+b = int(os.environ.get("TRAIN_BENCH_B", 6))
+h = int(os.environ.get("TRAIN_BENCH_H", 320))
+w = int(os.environ.get("TRAIN_BENCH_W", 720))
+iters = int(os.environ.get("TRAIN_BENCH_ITERS", 22))
 fused = os.environ.get("TRAIN_BENCH_FUSED", "1") not in ("0", "false")
+# TRAIN_BENCH_FUSED_TRAIN=1 engages the streaming kernels in the train
+# step itself (with the save_only_these_names remat policy).
+fused_train = os.environ.get("TRAIN_BENCH_FUSED_TRAIN", "0") not in (
+    "0", "false")
 cfg = RAFTStereoConfig(corr_implementation=corr, mixed_precision=True,
-                       fused_update=fused)
+                       fused_update=fused, fused_train=fused_train)
 params = jax.jit(lambda k: init_raft_stereo(k, cfg))(jax.random.PRNGKey(0))
 tx, _ = make_optimizer(lr=2e-4, num_steps=1000)
 opt_state = jax.jit(tx.init)(params)

@@ -1,0 +1,71 @@
+"""Sweep the reg_tpu lookup kernel's TILE (pixels per grid cell) on chip.
+
+Isolated lookup at the headline 1/4-res shape (504 x 744, D=256-channel
+fmaps, bf16 pyramid, 4 levels r=4), 8 lookups in a scan; device time from
+the profiler trace (wall clock is tunnel-dominated). Each TILE value runs
+in a fresh subprocess because the kernel binds TILE at import
+(RAFT_CORR_TILE env). Results recorded in BASELINE.md.
+"""
+import json
+import os
+import subprocess
+import sys
+
+CHILD = r'''
+import glob, gzip, json, os, sys
+import numpy as np
+import jax, jax.numpy as jnp
+sys.path.insert(0, "/root/repo")
+from raft_stereo_tpu.corr import make_corr_fn
+
+h, w, d = 504, 744, 256
+rng = np.random.default_rng(0)
+f1 = jnp.asarray(rng.standard_normal((1, h, w, d)), jnp.bfloat16)
+f2 = jnp.asarray(rng.standard_normal((1, h, w, d)), jnp.bfloat16)
+corr_fn = make_corr_fn("reg_tpu", f1, f2, num_levels=4, radius=4,
+                       out_dtype=jnp.bfloat16)
+
+@jax.jit
+def run(c0):
+    def step(c, _):
+        out = corr_fn(c)
+        return c + 0.25, jnp.sum(out.astype(jnp.float32))
+    _, ys = jax.lax.scan(step, c0, None, length=8)
+    return jnp.sum(ys)
+
+c0 = jnp.asarray(rng.uniform(0, w, (1, h, w)), jnp.float32)
+float(run(c0))  # compile + warm
+trace_dir = "/tmp/sweep_tile_trace"
+import shutil; shutil.rmtree(trace_dir, ignore_errors=True)
+with jax.profiler.trace(trace_dir):
+    float(run(c0))
+files = sorted(glob.glob(f"{trace_dir}/**/*.trace.json.gz", recursive=True))
+ev = json.load(gzip.open(files[-1]))["traceEvents"]
+pids = {e["pid"]: e["args"]["name"] for e in ev
+        if e.get("ph") == "M" and e.get("name") == "process_name"}
+total = lookup = 0.0
+for e in ev:
+    if e.get("ph") == "X" and "dur" in e and "TPU" in pids.get(e.get("pid"), ""):
+        n = str(e.get("name", ""))
+        if n.startswith(("jit_", "while")):
+            continue
+        total += e["dur"]
+        if n.startswith("closed_call"):
+            lookup += e["dur"]
+print(json.dumps({"tile": int(os.environ["RAFT_CORR_TILE"]),
+                  "lookup_ms_per_call": round(lookup / 8 / 1000, 3),
+                  "total_ms": round(total / 1000, 2)}))
+'''
+
+results = []
+for tile in (512, 1024, 2048, 4096):
+    env = dict(os.environ, RAFT_CORR_TILE=str(tile))
+    out = subprocess.run([sys.executable, "-c", CHILD], env=env,
+                         capture_output=True, text=True, timeout=1200)
+    line = [l for l in out.stdout.splitlines() if l.startswith("{")]
+    if line:
+        results.append(json.loads(line[-1]))
+        print(results[-1])
+    else:
+        print(f"tile {tile} FAILED:", out.stderr[-500:])
+print(json.dumps(results))

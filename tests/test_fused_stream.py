@@ -293,3 +293,49 @@ def test_fused_encoder_packed_grad_matches_oracle():
         d = np.abs(np.asarray(a) - np.asarray(b)).max()
         s = np.abs(np.asarray(b)).max() + 1e-8
         assert d / s < 5e-2, (d, s)
+
+
+@pytest.mark.slow
+def test_fused_train_grads_match_xla():
+    """cfg.fused_train engages the streaming kernels in the train scan
+    (with the save-kernel-outputs remat policy): the loss must sit inside
+    the kernel bf16 envelope and every SIGNIFICANT gradient leaf must
+    align with the XLA chain's. Bias leaves under instance norm are
+    excluded — their true gradient is exactly zero (IN subtracts the
+    mean), so their values are pure rounding noise in both programs.
+    One iteration: with more, the bf16-divergent coordinate trajectories
+    shift lookup tap positions by whole cells, which legitimately changes
+    the volume (hence fnet) gradients — multi-call cotangent linearity is
+    pinned separately in test_corr.py."""
+    def run(fused_train):
+        cfg = RAFTStereoConfig(corr_implementation="reg_tpu",
+                               mixed_precision=True, fused_update=True,
+                               fused_train=fused_train)
+        params = init_raft_stereo(jax.random.PRNGKey(0), cfg)
+        rng = np.random.default_rng(1)
+        im1 = jnp.asarray(rng.uniform(0, 255, (1, 64, 128, 3)), jnp.float32)
+        im2 = jnp.asarray(rng.uniform(0, 255, (1, 64, 128, 3)), jnp.float32)
+
+        def loss(p):
+            preds = raft_stereo_forward(p, cfg, im1, im2, iters=1,
+                                        test_mode=False)
+            return jnp.mean(jnp.abs(preds.astype(jnp.float32)))
+
+        return jax.jit(jax.value_and_grad(loss))(params)
+
+    (l0, g0), (l1, g1) = run(False), run(True)
+    assert abs(l0 - l1) / abs(l0) < 0.01, (l0, l1)
+    flat0 = jax.tree_util.tree_leaves_with_path(g0)
+    flat1 = jax.tree_util.tree_leaves(g1)
+    gmax = max(float(np.abs(np.asarray(a)).max()) for _, a in flat0)
+    for (path, a), b in zip(flat0, flat1):
+        a = np.asarray(a, np.float32).ravel()
+        b = np.asarray(b, np.float32).ravel()
+        assert np.isfinite(b).all(), path
+        key = jax.tree_util.keystr(path)
+        if "fnet" in key and key.endswith("['b']"):
+            continue  # IN-cancelled bias: true grad is zero
+        if np.abs(a).max() < 0.01 * gmax:
+            continue  # insignificant leaf: noise-dominated
+        cos = float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-12))
+        assert cos > 0.98, (key, cos)
