@@ -62,7 +62,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from raft_stereo_tpu.corr.reg import build_pyramid
+from raft_stereo_tpu.ops.pooling import avg_pool_last
 
 LANE = 128
 # Pixels per grid cell. r3 swept 128-1024 and settled on 512; r4's
@@ -221,6 +221,43 @@ def unpack_rows(packed: jax.Array) -> jax.Array:
     """(..., W2) fp32-container -> (..., 2*W2) bf16 rows (pack inverse)."""
     rows = jax.lax.bitcast_convert_type(packed, jnp.bfloat16)
     return rows.reshape(*packed.shape[:-1], packed.shape[-1] * 2)
+
+
+def _lohi_avg(packed: jax.Array) -> jax.Array:
+    """Average the two bf16 taps in each 32-bit lane (elementwise)."""
+    vi = jax.lax.bitcast_convert_type(packed, jnp.int32)
+    lo = jax.lax.bitcast_convert_type(vi << 16, jnp.float32)
+    hi = jax.lax.bitcast_convert_type(vi & jnp.int32(-65536), jnp.float32)
+    return ((lo + hi) * 0.5).astype(jnp.bfloat16)
+
+
+@jax.custom_vjp
+def pool_next_level(rows: jax.Array, packed: jax.Array) -> jax.Array:
+    """Next pyramid level from a packed level's container — numerically
+    identical to ``avg_pool_last(rows)`` (exact fp32 values of both bf16
+    taps, fp32 mean, one bf16 round) but pure ELEMENTWISE bit-ops: the
+    conventional pool (reshape + mean over a minor size-2 axis) makes XLA
+    materialize an fp32 copy of the whole level in a rotated layout
+    (measured ~6 ms on the 576 MB headline L0). The custom backward is the
+    pooling transpose on the ROWS operand — routing the forward through
+    the container's bit-ops alone would silently zero every deeper
+    level's gradient (integer bitcasts carry no tangent and pack_rows'
+    vjp is deliberately zero)."""
+    del rows
+    return _lohi_avg(packed)
+
+
+def _pool_next_fwd(rows, packed):
+    return pool_next_level(rows, packed), None
+
+
+def _pool_next_bwd(_, g):
+    # avg_pool_last transpose: input lane i receives 0.5 * g[i // 2].
+    d_rows = jnp.repeat(g.astype(jnp.float32) * 0.5, 2, axis=-1)
+    return d_rows.astype(jnp.bfloat16), jnp.zeros(g.shape, jnp.float32)
+
+
+pool_next_level.defvjp(_pool_next_fwd, _pool_next_bwd)
 
 
 def _pack_fwd(rows):
@@ -503,34 +540,44 @@ def make_reg_tpu_corr_fn(fmap1: jax.Array, fmap2: jax.Array, *,
     # fmaps are fp32.
     d = fmap1.shape[-1]
     vol = jnp.einsum("bhid,bhjd->bhij", fmap1, f2p) * (1.0 / d ** 0.5)
-    pyramid = build_pyramid(vol, num_levels)
     # bf16 pyramid levels pair-pack into fp32 containers ONCE here (outside
     # the GRU scan — 32 lookups amortize one bitcast pass) so the kernel
     # runs the half-width-scan / no-upcast gather path every iteration.
     # Per-level decision: pack only when the 256-multiple alignment the
     # container needs pads no further than the plain 128 alignment —
     # otherwise (e.g. a 372-wide level padding 384 -> 512) the extra zero
-    # lanes cost more per-step DMA than the packed gather saves.
+    # lanes cost more per-step DMA than the packed gather saves. A packed
+    # level's successor pools via ``_lohi_avg`` on the container
+    # (elementwise); unpacked levels pool conventionally. Padded zero
+    # lanes pool to zeros and every consumer masks by the true width, so
+    # pooling padded rows is value-identical to the pad-after-pool order.
+    # (B, H*W1, W2p_l) rows: batch stays a real axis and H (major) merges
+    # with W1 (minor, unsharded) — both mesh axes of a (data, space)
+    # sharding survive the reshape, so the partitioned lookup runs
+    # per-shard under any row mesh.
     bf16 = vol.dtype == jnp.bfloat16
     packed = tuple(
         bf16 and pad_width(w_, PACK_ALIGN) == pad_width(w_) for w_ in widths)
     flat, kernel_rows = [], []
-    for lvl, vol in enumerate(pyramid):
-        wp = vol.shape[-1]
+    cur = vol.reshape(b, h * w1, -1)
+    for lvl in range(num_levels):
+        wp = cur.shape[-1]
         want = pad_width(widths[lvl], PACK_ALIGN if packed[lvl] else LANE)
         if wp < want:
-            vol = jnp.pad(vol, ((0, 0), (0, 0), (0, 0), (0, want - wp)))
+            cur = jnp.pad(cur, ((0, 0), (0, 0), (0, want - wp)))
         elif wp > want:
-            vol = vol[..., :want]
-        # (B, H*W1, W2p_l): batch stays a real axis and H (major) merges
-        # with W1 (minor, unsharded) — both mesh axes of a (data, space)
-        # sharding survive the reshape, so the partitioned lookup runs
-        # per-shard under any row mesh.
-        rows = vol.reshape(b, h * w1, -1)
-        flat.append(rows)
+            cur = cur[..., :want]
         # The kernel reads the containers on packed levels; the bf16 rows
         # stay the differentiable operand (DCE'd from no-grad programs).
-        kernel_rows.append(pack_rows(rows) if packed[lvl] else rows)
+        flat.append(cur)
+        if packed[lvl]:
+            pk = pack_rows(cur)
+            kernel_rows.append(pk)
+            cur = (pool_next_level(cur, pk)
+                   if lvl + 1 < num_levels else None)
+        else:
+            kernel_rows.append(cur)
+            cur = avg_pool_last(cur) if lvl + 1 < num_levels else None
 
     def corr_fn(coords_x: jax.Array) -> jax.Array:
         coords_flat = coords_x.astype(jnp.float32).reshape(b, h * w1, 1)

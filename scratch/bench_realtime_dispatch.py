@@ -1,0 +1,131 @@
+"""B=1 realtime wall-fps dispatch-path breakdown through the axon tunnel.
+
+VERDICT r3/r4 asked: reach >=35 fps wall at the reference realtime config
+(shared backbone, ds3, 2 GRU levels, slow_fast, 7 iters, 384x1248 — 7.3 ms
+device) or prove the link's floor. This measures each stage of the
+dispatch path separately, then the end-to-end loop in three modes:
+
+- rtt:        dispatch+fetch of a scalar identity — the tunnel's floor for
+              any synchronous frame loop (nothing can be faster).
+- upload:     device_put of one bf16 frame pair (the realtime H2D payload).
+- sync:       upload -> forward -> fetch checksum, one frame at a time
+              (strict realtime latency semantics).
+- pipelined:  frame n+1's upload+dispatch issued before frame n's fetch
+              (depth-2 software pipeline; latency unchanged, throughput
+              overlaps transfer with compute — legal for a realtime sink
+              that tolerates one frame of latency).
+- device:     device-side op time from the profiler (the hardware number a
+              locally-attached chip would approach).
+"""
+import json
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from raft_stereo_tpu.config import RAFTStereoConfig
+from raft_stereo_tpu.models import init_raft_stereo, raft_stereo_forward
+
+H, W, ITERS, N = 384, 1248, 7, 30
+cfg = RAFTStereoConfig(corr_implementation="reg_tpu", mixed_precision=True,
+                       shared_backbone=True, n_downsample=3, n_gru_layers=2,
+                       slow_fast_gru=True)
+params = init_raft_stereo(jax.random.PRNGKey(0), cfg)
+
+
+@jax.jit
+def forward(params, image1, image2):
+    _, up = raft_stereo_forward(params, cfg, image1, image2, iters=ITERS,
+                                test_mode=True)
+    return up, jnp.sum(up)
+
+
+@jax.jit
+def ident(x):
+    return x + 1.0
+
+
+def fetch(x):
+    return float(x)
+
+
+rng = np.random.default_rng(0)
+frames = [(rng.uniform(0, 255, (1, H, W, 3)).astype(np.float32),
+           rng.uniform(0, 255, (1, H, W, 3)).astype(np.float32))
+          for _ in range(4)]
+
+# Warm compile + steady state.
+d1 = jax.device_put(jnp.asarray(frames[0][0]))
+d2 = jax.device_put(jnp.asarray(frames[0][1]))
+fetch(forward(params, d1, d2)[1])
+fetch(ident(jnp.float32(0.0)))
+
+out = {}
+
+# 1) RTT floor: scalar dispatch + fetch.
+t0 = time.perf_counter()
+for _ in range(N):
+    fetch(ident(jnp.float32(1.0)))
+out["rtt_ms"] = (time.perf_counter() - t0) / N * 1000
+
+# 2) Upload: one fp32 frame pair + fetch (isolates H2D from compute).
+t0 = time.perf_counter()
+for i in range(N):
+    a, b = frames[i % 4]
+    d1 = jax.device_put(a)
+    d2 = jax.device_put(b)
+    # completion barrier for the upload itself
+    fetch(jnp.sum(d1[0, 0, 0]) + jnp.sum(d2[0, 0, 0]))
+out["upload_plus_rtt_ms"] = (time.perf_counter() - t0) / N * 1000
+
+# 3) Strict sync realtime loop.
+t0 = time.perf_counter()
+for i in range(N):
+    a, b = frames[i % 4]
+    _, c = forward(params, jax.device_put(a), jax.device_put(b))
+    fetch(c)
+sync_ms = (time.perf_counter() - t0) / N * 1000
+out["sync_ms_per_frame"] = sync_ms
+out["sync_fps"] = 1000 / sync_ms
+
+# 4) Depth-2 pipeline: dispatch n+1 before fetching n.
+t0 = time.perf_counter()
+pending = None
+for i in range(N):
+    a, b = frames[i % 4]
+    nxt = forward(params, jax.device_put(a), jax.device_put(b))[1]
+    if pending is not None:
+        fetch(pending)
+    pending = nxt
+fetch(pending)
+pipe_ms = (time.perf_counter() - t0) / N * 1000
+out["pipelined_ms_per_frame"] = pipe_ms
+out["pipelined_fps"] = 1000 / pipe_ms
+
+# 5) Device-side op time.
+try:
+    import glob
+    import gzip
+    import shutil
+    tdir = "/tmp/rt_dispatch_trace"
+    shutil.rmtree(tdir, ignore_errors=True)
+    with jax.profiler.trace(tdir):
+        _, c = forward(params, d1, d2)
+        fetch(c)
+    files = sorted(glob.glob(f"{tdir}/**/*.trace.json.gz", recursive=True))
+    ev = json.load(gzip.open(files[-1]))["traceEvents"]
+    pids = {e["pid"]: e["args"]["name"] for e in ev
+            if e.get("ph") == "M" and e.get("name") == "process_name"}
+    dev = sum(e["dur"] for e in ev
+              if e.get("ph") == "X" and "dur" in e
+              and "TPU" in pids.get(e.get("pid"), "")
+              and not str(e.get("name", "")).startswith(("jit_", "while")))
+    out["device_ms"] = dev / 1000
+    out["device_fps"] = 1e6 / dev if dev else None
+except Exception:
+    pass
+
+print(json.dumps({k: round(v, 2) for k, v in out.items()}))

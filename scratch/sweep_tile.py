@@ -58,14 +58,18 @@ print(json.dumps({"tile": int(os.environ["RAFT_CORR_TILE"]),
 '''
 
 results = []
-for tile in (512, 1024, 2048, 4096):
+for tile in (1024, 2048, 4096):
     env = dict(os.environ, RAFT_CORR_TILE=str(tile))
-    out = subprocess.run([sys.executable, "-c", CHILD], env=env,
-                         capture_output=True, text=True, timeout=1200)
+    try:
+        out = subprocess.run([sys.executable, "-c", CHILD], env=env,
+                             capture_output=True, text=True, timeout=1500)
+    except subprocess.TimeoutExpired:
+        print(f"tile {tile} TIMEOUT", flush=True)
+        continue
     line = [l for l in out.stdout.splitlines() if l.startswith("{")]
     if line:
         results.append(json.loads(line[-1]))
-        print(results[-1])
+        print(results[-1], flush=True)
     else:
-        print(f"tile {tile} FAILED:", out.stderr[-500:])
+        print(f"tile {tile} FAILED:", out.stderr[-500:], flush=True)
 print(json.dumps(results))

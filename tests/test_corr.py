@@ -310,3 +310,34 @@ def test_pack_unpack_rows_roundtrip(rng):
     assert back.dtype == jnp.bfloat16 and back.shape == rows.shape
     assert (np.asarray(back, np.float32)
             == np.asarray(rows, np.float32)).all()
+
+
+def test_reg_tpu_packed_deep_level_grads_flow(rng):
+    """Gradients from pyramid levels >= 1 must reach the fmaps when level 0
+    packs. Regression: deriving deeper levels through pack_rows' container
+    (zero vjp + integer bitcasts) silently zeroed every deeper level's
+    contribution — a loss reading ONLY deep-level channels had zero fmap
+    grads. The grads must also track the reg path's (same bf16 volume)."""
+    b, h, w, d = 1, 4, 200, 16  # w=200: level 0 packs (256 == 256)
+    k = 2 * RADIUS + 1
+    f1 = jnp.asarray(rng.standard_normal((b, h, w, d), dtype=np.float32))
+    f2 = jnp.asarray(rng.standard_normal((b, h, w, d), dtype=np.float32))
+    coords = jnp.asarray(
+        rng.uniform(0, w, size=(b, h, w)).astype(np.float32))
+
+    def loss(impl, f1_, f2_):
+        fn = make_corr_fn(impl, f1_.astype(jnp.bfloat16),
+                          f2_.astype(jnp.bfloat16), num_levels=LEVELS,
+                          radius=RADIUS)
+        out = fn(coords).astype(jnp.float32)
+        return jnp.sum(out[..., k:] ** 2)  # ONLY levels 1..3 channels
+
+    g1, g2 = jax.grad(lambda a, c: loss("reg_tpu", a, c),
+                      argnums=(0, 1))(f1, f2)
+    assert np.abs(np.asarray(g2)).max() > 0, "deep-level grads dropped"
+    r1, r2 = jax.grad(lambda a, c: loss("reg", a, c), argnums=(0, 1))(f1, f2)
+    for a_, b_ in ((g1, r1), (g2, r2)):
+        a_, b_ = np.asarray(a_, np.float32), np.asarray(b_, np.float32)
+        scale = np.abs(b_).max() + 1e-8
+        assert np.abs(a_ - b_).max() / scale < 0.05, \
+            np.abs(a_ - b_).max() / scale

@@ -69,6 +69,11 @@ def build_parser() -> argparse.ArgumentParser:
                         help="shard each sample's height over this many "
                              "devices (mesh 'space' axis) in addition to "
                              "batch data parallelism")
+    parser.add_argument('--fused_train', action='store_true',
+                        help="engage the streaming Pallas scan-body kernels "
+                             "in the train step (save-kernel-outputs remat "
+                             "policy; measured +16%% steps/s at the "
+                             "reference crop config)")
     return parser
 
 
