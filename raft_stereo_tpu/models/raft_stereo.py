@@ -236,9 +236,12 @@ def raft_stereo_forward(params: Params, cfg: RAFTStereoConfig,
         return net, coords1, up_mask
 
     def upsampled(coords1, up_mask):
-        flow_up = convex_upsample((coords1 - coords0).astype(jnp.float32),
-                                  up_mask.astype(jnp.float32), factor)
-        return flow_up[..., :1]  # only x (disparity) survives (:134)
+        # Only x (disparity) survives (:134); slicing BEFORE the upsample
+        # halves its einsum and write bytes. Identical output: the convex
+        # combination is per-channel independent, so dropping y before or
+        # after upsampling cannot change channel 0.
+        flow_x = (coords1 - coords0)[..., :1].astype(jnp.float32)
+        return convex_upsample(flow_x, up_mask.astype(jnp.float32), factor)
 
     if unroll:  # reference-style Python loop, for debugging and parity checks
         flow_predictions = []
