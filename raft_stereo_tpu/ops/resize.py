@@ -4,8 +4,14 @@ The reference resizes GRU hidden states across pyramid scales with
 ``F.interpolate(mode='bilinear', align_corners=True)`` (``core/update.py:93-95``)
 and upsamples fallback flow the same way (``core/utils/utils.py:82-84``).
 ``jax.image.resize`` uses half-pixel-center semantics, which differ, so the
-aligned-corners variant is built here from two 1D gather-lerps (each lowers to
-a pair of gathers + fused FMA — cheap on TPU, no conv needed).
+aligned-corners variant is built here as two banded-matrix MXU contractions:
+each output row/col is a 2-tap lerp, i.e. a (out, in) matrix with two
+nonzeros per row. The earlier gather-lerp form (jnp.take per axis) made XLA
+materialize transposed intermediates for the W-axis gather — ~1.1 ms per
+GRU iteration at Middlebury-F, ~36 ms/frame; the dense dot wastes MXU FLOPs
+on zeros but runs in their shadow, accumulates fp32, and needs no relayout.
+The matrices derive from iota, so under a scan they are loop-invariant
+constants.
 """
 
 from __future__ import annotations
@@ -29,25 +35,36 @@ def _lerp_indices(in_size: int, out_size: int, dtype) -> Tuple[jax.Array, jax.Ar
     return lo, hi, w
 
 
+def _lerp_matrix(in_size: int, out_size: int, dtype) -> jax.Array:
+    """(out, in) aligned-corners lerp matrix: two nonzeros per row."""
+    lo, hi, wt = _lerp_indices(in_size, out_size, jnp.float32)
+    m = (jax.nn.one_hot(lo, in_size, dtype=jnp.float32) * (1 - wt)[:, None]
+         + jax.nn.one_hot(hi, in_size, dtype=jnp.float32) * wt[:, None])
+    return m.astype(dtype)
+
+
 def interp_align_corners(x: jax.Array, size: Tuple[int, int]) -> jax.Array:
     """Bilinear resize of (B, H, W, C) to (B, size[0], size[1], C), align_corners=True."""
     b, h, w, c = x.shape
     oh, ow = size
     if (oh, ow) == (h, w):
         return x
-    # Lerp in the input dtype: under mixed precision the reference's
-    # F.interpolate runs inside autocast (fp16) too, and the fp32
-    # round-trip doubled this op's HBM traffic (~0.7 ms/GRU-iteration at
-    # Middlebury-F). The fractional weights stay fp32 until the multiply.
-    compute = x
+    # Contractions run in the input dtype (bf16 under mixed precision —
+    # the reference's F.interpolate runs inside autocast too) with fp32
+    # accumulation. Precision.HIGHEST keeps fp32 inputs EXACT (the TPU
+    # default would demote fp32 operands to bf16 MXU multiplies — a
+    # silent regression vs the elementwise lerp this replaced) and is
+    # free for bf16 inputs. bf16 nuance: (1-wt) and wt round
+    # independently here, so a row may sum to 1 +/- 1 ulp and constant
+    # regions can drift ~1 bf16 ulp where the old a+(b-a)*wt form
+    # preserved them bit-exactly — same order as that form's own
+    # rounding, covered by the parity batteries.
+    out = x
+    hp = jax.lax.Precision.HIGHEST
     if oh != h:
-        lo, hi, wt = _lerp_indices(h, oh, jnp.float32)
-        a = jnp.take(compute, lo, axis=1)
-        bb = jnp.take(compute, hi, axis=1)
-        compute = a + (bb - a) * wt[None, :, None, None].astype(x.dtype)
+        out = jnp.einsum("Oh,bhwc->bOwc", _lerp_matrix(h, oh, x.dtype), out,
+                         precision=hp)
     if ow != w:
-        lo, hi, wt = _lerp_indices(w, ow, jnp.float32)
-        a = jnp.take(compute, lo, axis=2)
-        bb = jnp.take(compute, hi, axis=2)
-        compute = a + (bb - a) * wt[None, None, :, None].astype(x.dtype)
-    return compute.astype(x.dtype)
+        out = jnp.einsum("Pw,bOwc->bOPc", _lerp_matrix(w, ow, x.dtype), out,
+                         precision=hp)
+    return out.astype(x.dtype)
