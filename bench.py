@@ -45,7 +45,14 @@ def _check_checksum_pin(key: str, checksum: float, here: str) -> None:
         with open(path) as f:
             refs = json.load(f)
     if os.environ.get("RAFT_BENCH_REBASELINE"):
-        refs[key] = {"checksum": checksum, "rtol": 0.02, "atol": 100.0}
+        # The absolute floor exists ONLY to absorb bf16 jitter when the
+        # pinned checksum is legitimately near zero (signed disparities
+        # canceling) — the rtol term covers every other magnitude. Pin it
+        # at 1.0 instead of the old fixed 100.0, which for a
+        # small-magnitude config would swallow a real regression many
+        # times the checksum itself. (Any magnitude-proportional atol
+        # below rtol's 2% would be dead code — rtol dominates it.)
+        refs[key] = {"checksum": checksum, "rtol": 0.02, "atol": 1.0}
         with open(path, "w") as f:
             json.dump(refs, f, indent=1, sort_keys=True)
         print(f"bench: re-baselined checksum for {key}: {checksum:.2f}",
@@ -57,7 +64,9 @@ def _check_checksum_pin(key: str, checksum: float, here: str) -> None:
               "RAFT_BENCH_REBASELINE=1 records one", file=sys.stderr)
         return
     # The absolute floor keeps a legitimately-near-zero pinned checksum
-    # (signed disparities canceling) from rejecting ordinary bf16 jitter.
+    # (signed disparities canceling) from rejecting ordinary bf16 jitter;
+    # re-baselined pins write a tight 1.0 floor (above), pre-existing pins
+    # keep their recorded (looser) one.
     tol = max(abs(ref["checksum"]) * ref.get("rtol", 0.02),
               ref.get("atol", 100.0))
     if abs(checksum - ref["checksum"]) > tol:

@@ -115,6 +115,20 @@ class TrainConfig:
     # axis) in addition to batch data parallelism — the big-crop/full-res
     # training enabler, mirroring evaluate's --spatial_shard.
     spatial_shard: int = 1
+    # Fault tolerance (DESIGN.md "Failure recovery"). A non-finite step is
+    # skipped (params/opt_state untouched via optax.apply_if_finite) and the
+    # run aborts only after this many CONSECUTIVE bad steps; 0 restores the
+    # reference's abort-on-first behavior. `restore_ckpt` may also name a
+    # checkpoint DIRECTORY: resume from its newest valid bundle
+    # (checkpoint.find_latest_checkpoint), skipping truncated/corrupt ones.
+    max_bad_steps: int = 5
+    # Keep-last-K retention over periodic checkpoints; 0 keeps all.
+    # Preempt/epoch/final bundles are never pruned.
+    keep_ckpts: int = 3
+    # Per-sample IO/decode retries before quarantine + substitution, and
+    # the base seconds of the loader's exponential retry backoff.
+    data_retries: int = 2
+    data_retry_backoff: float = 0.05
 
     def __post_init__(self):
         self.train_datasets = tuple(self.train_datasets)

@@ -71,7 +71,15 @@ LANE = 128
 # step COUNT was the real cost: 2048 cuts it 4x for ~11 MB more VMEM.
 # Env override for sweeps (scratch/sweep_tile.py); r5 sweep table in
 # BASELINE.md.
-TILE = int(os.environ.get("RAFT_CORR_TILE", 2048))
+_TILE_DEFAULT = 2048
+
+
+def corr_tile() -> int:
+    """Pixels per grid cell, read from ``RAFT_CORR_TILE`` when each corr fn
+    is built (i.e. at trace time — the lookup cache is keyed by the tile, so
+    sweeps in one process get the tile they set; programs already compiled
+    keep the tile they were traced with)."""
+    return int(os.environ.get("RAFT_CORR_TILE", _TILE_DEFAULT))
 
 
 def _interpret() -> bool:
@@ -391,23 +399,24 @@ def _lookup_kernel(coords_ref, *refs, radius: int, widths: Sequence[int],
 
 def _pallas_lookup(pyramid: Sequence[jax.Array], coords_flat: jax.Array,
                    radius: int, widths: Tuple[int, ...],
-                   out_dtype, packed: Tuple[bool, ...]) -> jax.Array:
+                   out_dtype, packed: Tuple[bool, ...],
+                   tile: int = _TILE_DEFAULT) -> jax.Array:
     """pyramid: list of (N, W2p_l) fp32; coords_flat: (N, 1) fp32."""
     n = coords_flat.shape[0]
     k = 2 * radius + 1
     out_ch = len(pyramid) * k
-    grid = pl.cdiv(n, TILE)
+    grid = pl.cdiv(n, tile)
     kernel = functools.partial(_lookup_kernel, radius=radius, widths=widths,
                                packed=packed)
     out = pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((n, out_ch), out_dtype),
         grid=(grid,),
-        in_specs=[pl.BlockSpec((TILE, 1), lambda i: (i, 0),
+        in_specs=[pl.BlockSpec((tile, 1), lambda i: (i, 0),
                                memory_space=pltpu.VMEM)] +
-                 [pl.BlockSpec((TILE, p.shape[-1]), lambda i: (i, 0),
+                 [pl.BlockSpec((tile, p.shape[-1]), lambda i: (i, 0),
                                memory_space=pltpu.VMEM) for p in pyramid],
-        out_specs=pl.BlockSpec((TILE, out_ch), lambda i: (i, 0),
+        out_specs=pl.BlockSpec((tile, out_ch), lambda i: (i, 0),
                                memory_space=pltpu.VMEM),
         # The 2048-pixel tile's double-buffered level blocks + fp32
         # gather temporaries need ~28 MB; the default scoped cap is 16.
@@ -419,10 +428,13 @@ def _pallas_lookup(pyramid: Sequence[jax.Array], coords_flat: jax.Array,
 
 @functools.lru_cache(maxsize=None)
 def _partitioned_lookup(radius: int, widths: Tuple[int, ...], out_dtype_name,
-                        nlev: int, packed: Tuple[bool, ...] = ()):
+                        nlev: int, packed: Tuple[bool, ...] = (),
+                        tile: int = _TILE_DEFAULT):
     """SPMD-partitionable 3D lookup: coords (B, N, 1) + per-level rows
     (B, N, W2p_l) -> (B, N, nlev*(2r+1)), independent along (B, N) — any
     mesh sharding of the leading two axes runs the flat kernel per-shard.
+    ``tile`` is part of the cache key, so corr fns built under different
+    ``RAFT_CORR_TILE`` values coexist.
     """
     out_dtype = jnp.dtype(out_dtype_name)
 
@@ -431,7 +443,7 @@ def _partitioned_lookup(radius: int, widths: Tuple[int, ...], out_dtype_name,
         flat = [p.reshape(b * n, p.shape[-1]) for p in pyr3]
         out = _pallas_lookup(flat, coords3.reshape(b * n, 1), radius,
                              widths, out_dtype,
-                             packed or (False,) * nlev)
+                             packed or (False,) * nlev, tile)
         return out.reshape(b, n, -1)
 
     rule = ("b n u, " + ", ".join(f"b n w{i}" for i in range(nlev))
@@ -472,11 +484,12 @@ def _masked_lookup_xla(pyramid: Sequence[jax.Array], coords_flat: jax.Array,
     return jnp.concatenate(out, axis=-1)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _lookup(pyramid: List[jax.Array], packed_pyr: List[jax.Array],
             coords_flat: jax.Array, radius: int, widths: Tuple[int, ...],
             out_dtype=jnp.float32,
-            packed: Tuple[bool, ...] = ()) -> jax.Array:
+            packed: Tuple[bool, ...] = (),
+            tile: int = _TILE_DEFAULT) -> jax.Array:
     """pyramid: per-level (B, N, W2p_l) bf16/fp32 rows — the DIFFERENTIABLE
     operand (cotangents sum linearly across the loop's 32 lookup calls);
     packed_pyr: pair-packed fp32-container rows for the levels with
@@ -485,19 +498,19 @@ def _lookup(pyramid: List[jax.Array], packed_pyr: List[jax.Array],
     reads, zero cotangent for the packed entries. coords_flat: (B, N, 1).
     """
     fn = _partitioned_lookup(radius, widths, jnp.dtype(out_dtype).name,
-                             len(pyramid), packed)
+                             len(pyramid), packed, tile)
     rows = packed_pyr if any(packed) else pyramid
     return fn(coords_flat, *rows)
 
 
 def _lookup_fwd(pyramid, packed_pyr, coords_flat, radius, widths, out_dtype,
-                packed):
+                packed, tile):
     return (_lookup(pyramid, packed_pyr, coords_flat, radius, widths,
-                    out_dtype, packed),
+                    out_dtype, packed, tile),
             (pyramid, coords_flat))
 
 
-def _lookup_bwd(radius, widths, out_dtype, packed, residuals, g):
+def _lookup_bwd(radius, widths, out_dtype, packed, tile, residuals, g):
     pyramid, coords_flat = residuals
     _, vjp = jax.vjp(
         lambda p: _masked_lookup_xla(p, coords_flat, radius, widths), pyramid)
@@ -579,10 +592,12 @@ def make_reg_tpu_corr_fn(fmap1: jax.Array, fmap2: jax.Array, *,
             kernel_rows.append(cur)
             cur = avg_pool_last(cur) if lvl + 1 < num_levels else None
 
+    tile = corr_tile()  # env override honored per corr-fn build (trace time)
+
     def corr_fn(coords_x: jax.Array) -> jax.Array:
         coords_flat = coords_x.astype(jnp.float32).reshape(b, h * w1, 1)
         out = _lookup(flat, kernel_rows if any(packed) else [], coords_flat,
-                      radius, widths, out_dtype, packed)
+                      radius, widths, out_dtype, packed, tile)
         return out.reshape(b, h, w1, -1)
 
     return corr_fn

@@ -14,11 +14,20 @@ thread pool over the numpy-native dataset with deterministic per-sample RNG:
 cv2/PIL decode and numpy augmentation release the GIL for their hot parts, so
 threads keep an 8-chip slice fed without fork complexity; ``num_workers``
 matches the reference's ``SLURM_CPUS_PER_TASK - 2`` sizing by default.
+
+Fault tolerance (DESIGN.md "Failure recovery"): IO/decode errors retry with
+bounded backoff; persistently-bad samples are quarantined for the run and
+their batch slots filled by deterministic substitutes keyed off the same
+``[seed, epoch, i]`` slot RNG, so one corrupt PNG/PFM costs one sample — not
+the run — and multi-host batches stay identical.
 """
 
 from __future__ import annotations
 
+import logging
 import os
+import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Iterator, Optional
 
@@ -26,7 +35,31 @@ import numpy as np
 
 from raft_stereo_tpu.data.datasets import StereoDataset, fetch_dataset
 
+logger = logging.getLogger(__name__)
+
 ARRAY_KEYS = ("image1", "image2", "flow", "valid")
+
+# Errors worth retrying/quarantining: filesystem hiccups (OSError covers
+# PIL's UnidentifiedImageError and truncated-read IOErrors), decode failures
+# (ValueError from the PFM/flow parsers), and cv2.imread's None-return
+# arithmetic (TypeError). Anything else — shape bugs, OOM, KeyboardInterrupt
+# — propagates: retrying a programming error hides it.
+IO_RETRY_ERRORS = (OSError, ValueError, TypeError)
+
+# Key-salt separating the substitute-candidate stream from the per-sample
+# augmentation stream (both are keyed off [seed, epoch, position]).
+_SUBSTITUTE_SALT = 0x5B5
+# Distinct substitute candidates probed before giving up on a batch slot.
+_SUBSTITUTE_TRIES = 32
+# Quarantine is for ISOLATED corruption. IO_RETRY_ERRORS is deliberately
+# broad (ValueError/TypeError also cover decode bugs reached through real
+# files), so a systematic failure — an augmentation bug for a whole
+# dataset, a dead mount — would otherwise be silently substituted away
+# sample by sample. Cap the quarantine at this fraction of the dataset
+# (floored at an absolute count so tiny datasets aren't over-strict) and
+# abort loudly beyond it.
+_MAX_QUARANTINE_FRAC = 0.01
+_MAX_QUARANTINE_MIN = 16
 
 
 def collate(samples, return_paths: bool = False) -> Dict[str, np.ndarray]:
@@ -49,9 +82,21 @@ class StereoLoader:
                  shuffle: bool = True, num_workers: int = 4,
                  drop_last: bool = True, seed: int = 0, prefetch: int = 2,
                  return_paths: bool = False,
-                 local_rows: Optional[slice] = None):
+                 local_rows: Optional[slice] = None,
+                 retries: int = 2, retry_backoff: float = 0.05):
         self.dataset = dataset
         self.batch_size = batch_size
+        # Fault tolerance (DESIGN.md "Failure recovery"): per-sample load
+        # errors retry `retries` times with bounded exponential backoff;
+        # a sample still failing after that is quarantined for the run and
+        # its batch slot filled by a deterministic substitute.
+        self.retries = max(0, retries)
+        self.retry_backoff = retry_backoff
+        self.quarantined: Dict[int, str] = {}
+        # Worker threads quarantine concurrently; the lock keeps the
+        # check-then-insert atomic and quarantine_report()'s copy safe
+        # against a late in-flight _load mutating the dict mid-iteration.
+        self._quarantine_lock = threading.Lock()
         # Multi-host: decode only this process's rows of each (globally
         # deterministic) batch. Epoch order and per-sample RNG stay keyed
         # by GLOBAL position, so the pod-wide batch is identical to the
@@ -83,9 +128,102 @@ class StereoLoader:
             np.random.default_rng([self.seed, epoch]).shuffle(order)
         return order
 
+    def _load_once(self, index: int, epoch: int, position: int):
+        """One sample with bounded retry; re-raises after retries exhaust.
+
+        The RNG is re-derived from ``[seed, epoch, position]`` on every
+        attempt, so a retried sample draws the *identical* augmentation
+        stream — a transient IO fault that recovers within the retry budget
+        yields a run bit-for-bit equal to the fault-free one.
+        """
+        last_err = None
+        for attempt in range(self.retries + 1):
+            rng = np.random.default_rng([self.seed, epoch, position])
+            try:
+                return self.dataset.__getitem__(int(index), rng=rng)
+            except IO_RETRY_ERRORS as e:
+                last_err = e
+                if attempt < self.retries:
+                    time.sleep(min(self.retry_backoff * (2 ** attempt), 2.0))
+        raise last_err
+
+    def _quarantine(self, index: int, err: BaseException) -> None:
+        with self._quarantine_lock:
+            if int(index) not in self.quarantined:
+                self.quarantined[int(index)] = repr(err)
+                logger.warning(
+                    "quarantined sample %d after %d failed attempts (%r); "
+                    "%d sample(s) quarantined so far",
+                    index, self.retries + 1, err, len(self.quarantined))
+            n = len(self.quarantined)
+        limit = max(_MAX_QUARANTINE_MIN,
+                    int(_MAX_QUARANTINE_FRAC * len(self.dataset)))
+        if n > limit:
+            # Last-resort abort, HOST-LOCAL by design: on a pod each process
+            # decodes only its own rows, so a dead local mount trips the cap
+            # here only — survivors exit via the distributed-runtime barrier
+            # timeout at their next collective. Coordinating this abort at a
+            # step boundary is impossible (the dying host never reaches one);
+            # see DESIGN.md "Failure recovery".
+            raise RuntimeError(
+                f"{n} samples quarantined (limit "
+                f"{limit}): this is a systematic data-pipeline failure "
+                f"(bad mount, decode/augmentation bug), not isolated "
+                f"corruption; last error: {err!r}")
+
+    def quarantine_report(self) -> Dict[int, str]:
+        """Quarantined dataset indices -> last error repr (copy)."""
+        with self._quarantine_lock:
+            return dict(self.quarantined)
+
     def _load(self, index: int, epoch: int, position: int):
-        rng = np.random.default_rng([self.seed, epoch, position])
-        return self.dataset.__getitem__(int(index), rng=rng)
+        """Load batch slot ``position``: the scheduled sample, or — when it
+        is persistently bad — a deterministic substitute.
+
+        Substitutes are drawn from an RNG keyed off the same
+        ``[seed, epoch, position]`` slot (plus a salt separating it from the
+        augmentation stream), and a candidate is accepted or rejected by
+        *content* (it must itself load within the retry budget). The local
+        ``quarantined`` set is only a fast path past candidates already
+        proven persistently bad — it never changes which candidate wins, so
+        every run and every pod process fills the slot identically and
+        multi-host batches stay batch-identical. (The retry budget is the
+        transient/persistent boundary: a fault that exceeds it on one host
+        but not another is by definition not transient.)
+        """
+        index = int(index)
+        if index not in self.quarantined:
+            try:
+                return self._load_once(index, epoch, position)
+            except IO_RETRY_ERRORS as e:
+                self._quarantine(index, e)
+        # Candidates are a seeded PERMUTATION (no duplicate or self draws
+        # wasting tries), and a known-quarantined candidate consumes a try
+        # just like probing-and-failing it would — so every host walks the
+        # identical candidate sequence with the identical try budget whether
+        # it learned a candidate was bad locally or not.
+        order = np.random.default_rng(
+            [self.seed, epoch, position, _SUBSTITUTE_SALT]).permutation(
+                len(self.dataset))
+        last_err: Optional[BaseException] = None
+        tried = 0
+        for j in order:
+            j = int(j)
+            if j == index:
+                continue
+            if tried >= _SUBSTITUTE_TRIES:
+                break
+            tried += 1
+            if j in self.quarantined:
+                continue  # counted: a content probe would fail identically
+            try:
+                return self._load_once(j, epoch, position)
+            except IO_RETRY_ERRORS as e:
+                self._quarantine(j, e)
+                last_err = e
+        raise RuntimeError(
+            f"sample {index} is quarantined and none of {tried} "
+            f"deterministic substitute candidates loaded") from last_err
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
         # Claim the epoch number up front: a partially-consumed iterator
@@ -146,7 +284,10 @@ def fetch_dataloader(train_cfg, root: Optional[str] = None,
     return StereoLoader(dataset, batch_size=train_cfg.batch_size, shuffle=True,
                         num_workers=num_workers, drop_last=True,
                         seed=getattr(train_cfg, "seed", 0),
-                        local_rows=local_rows)
+                        local_rows=local_rows,
+                        retries=getattr(train_cfg, "data_retries", 2),
+                        retry_backoff=getattr(train_cfg, "data_retry_backoff",
+                                              0.05))
 
 
 def device_prefetch(loader, mesh=None, size: int = 2, image_dtype=None,

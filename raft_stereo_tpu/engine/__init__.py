@@ -1,7 +1,8 @@
 """Training and evaluation engines."""
 
 from raft_stereo_tpu.engine.checkpoint import (  # noqa: F401
-    load_checkpoint, load_params, save_checkpoint)
+    CheckpointError, check_run_name, find_latest_checkpoint, load_checkpoint,
+    load_params, prune_checkpoints, save_checkpoint, validate_checkpoint)
 from raft_stereo_tpu.engine.logger import Logger  # noqa: F401
 from raft_stereo_tpu.engine.loss import sequence_loss  # noqa: F401
 from raft_stereo_tpu.engine.optimizer import (  # noqa: F401

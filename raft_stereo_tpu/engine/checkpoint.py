@@ -8,6 +8,16 @@ checkpoint is the complete training state, serialized with flax msgpack
 atomically (tmp file + rename) so preemption mid-save never corrupts the
 latest checkpoint.
 
+Integrity (DESIGN.md "Failure recovery"): atomic rename protects against
+*this* process dying mid-save, but not against a copy truncated in transit,
+a partial NFS flush, or on-disk corruption. Every bundle therefore carries a
+content hash — an 8-byte magic + sha256(payload) header ahead of the msgpack
+payload — and loads verify it; headerless files load as legacy bundles (no
+integrity information, best effort). ``find_latest_checkpoint`` turns that
+into auto-resume: newest *valid* numbered bundle in a directory, falling
+back past truncated/corrupt ones, and ``prune_checkpoints`` bounds disk use
+with keep-last-K retention of the periodic saves.
+
 ``load_params`` additionally accepts the reference's ``.pth`` checkpoints via
 the transplant shim, so all published RAFT-Stereo weights load anywhere our
 checkpoints do.
@@ -15,13 +25,52 @@ checkpoints do.
 
 from __future__ import annotations
 
+import hashlib
+import logging
 import os
-from typing import Any, Dict, Optional, Tuple
+import re
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 from flax import serialization
 
+logger = logging.getLogger(__name__)
+
 CKPT_SUFFIX = ".msgpack"
+
+# Hash-framed bundle: MAGIC + sha256(payload) + payload. The magic cannot
+# collide with a legacy bundle — flax msgpack of the state dict starts with
+# a msgpack fixmap byte (0x8N), never 'R'.
+_MAGIC = b"RSCKPT1\n"
+_DIGEST_LEN = hashlib.sha256().digest_size
+_HEADER_LEN = len(_MAGIC) + _DIGEST_LEN
+
+# Numbered resume candidates: "{step}_{name}", "{step}_preempt_{name}",
+# "{step}_epoch_{name}". The final "{name}" bundle has no step prefix and
+# means "finished" — never a resume candidate.
+_STEP_RE = re.compile(r"^(\d+)_(?:preempt_|epoch_)?(.+)$")
+
+
+class CheckpointError(ValueError):
+    """A checkpoint file failed integrity validation (truncated/corrupt)."""
+
+
+def check_run_name(name: str) -> str:
+    """Reject run names that collide with the bundle-filename grammar.
+
+    A name starting with ``<digits>_`` makes that run's FINAL bundle
+    (``{name}.msgpack``) parse as another run's periodic bundle — so
+    keep-last-K pruning for the other run could delete it; a name starting
+    with ``preempt_``/``epoch_`` makes this run's periodic bundles parse as
+    another run's marker bundles. Both are silent cross-run interference,
+    so fail fast at train start instead.
+    """
+    if re.match(r"^\d+_", name) or name.startswith(("preempt_", "epoch_")):
+        raise ValueError(
+            f"run name {name!r} collides with the checkpoint filename "
+            "grammar ('<step>_[preempt_|epoch_]<name>.msgpack'); it must "
+            "not start with digits-underscore, 'preempt_' or 'epoch_'")
+    return name
 
 
 def save_checkpoint(path: str, params, opt_state=None, step: int = 0) -> str:
@@ -34,19 +83,110 @@ def save_checkpoint(path: str, params, opt_state=None, step: int = 0) -> str:
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "wb") as f:
+        f.write(_MAGIC)
+        f.write(hashlib.sha256(blob).digest())
         f.write(blob)
     os.replace(tmp, path)
     return path
 
 
-def load_checkpoint(path: str, params_template, opt_state_template=None
-                    ) -> Tuple[Any, Any, int]:
-    """Restore (params, opt_state, step); templates define the pytree shape."""
+def _read_payload(path: str) -> Tuple[bytes, bool]:
+    """Read a bundle's msgpack payload, verifying the content hash.
+
+    Returns ``(payload, hash_verified)``. Raises :class:`CheckpointError`
+    when a hash-framed bundle is truncated or its digest mismatches.
+    Headerless (legacy) files pass through unverified
+    (``hash_verified=False``) — corruption there surfaces as a msgpack
+    parse error.
+    """
     with open(path, "rb") as f:
         blob = f.read()
+    if not blob.startswith(_MAGIC):
+        return blob, False  # legacy bundle: no integrity header to check
+    if len(blob) < _HEADER_LEN:
+        raise CheckpointError(f"{path}: truncated checkpoint header")
+    digest = blob[len(_MAGIC):_HEADER_LEN]
+    payload = blob[_HEADER_LEN:]
+    if hashlib.sha256(payload).digest() != digest:
+        raise CheckpointError(
+            f"{path}: checkpoint content hash mismatch (truncated or "
+            "corrupt bundle)")
+    return payload, True
+
+
+def bundle_step(path: str) -> int:
+    """Training step of a bundle: free from the filename for numbered
+    bundles; a full payload parse (verifying the hash frame, so this raises
+    :class:`CheckpointError` on corruption) only for unnumbered/final ones —
+    multi-GB bundles must not be deserialized just to compare steps."""
+    stem = os.path.basename(path)
+    if stem.endswith(CKPT_SUFFIX):
+        stem = stem[:-len(CKPT_SUFFIX)]
+    m = _STEP_RE.match(stem)
+    if m is not None:
+        return int(m.group(1))
+    payload, _ = _read_payload(path)
+    return int(serialization.msgpack_restore(payload)["step"])
+
+
+def validate_checkpoint(path: str) -> bool:
+    """True iff ``path`` holds a structurally-sound checkpoint bundle."""
+    try:
+        payload, hash_verified = _read_payload(path)
+        # A matching sha256 already proves the payload intact — the msgpack
+        # parse (a full deserialization, expensive for multi-GB bundles) is
+        # only the fallback check for legacy headerless files.
+        if not hash_verified:
+            serialization.msgpack_restore(payload)
+        return True
+    except Exception:  # noqa: BLE001 - any failure means "not loadable"
+        return False
+
+
+def load_checkpoint(path: str, params_template, opt_state_template=None
+                    ) -> Tuple[Any, Any, int]:
+    """Restore (params, opt_state, step); templates define the pytree shape.
+
+    Migration shim, both directions across the ``optax.apply_if_finite``
+    wrapper (the ``max_bad_steps`` skip-if-nonfinite optimizer): a wrapped
+    template accepts bundles saved WITHOUT the wrapper (pre-wrapper runs,
+    ``--max_bad_steps 0``) by re-wrapping the inner optimizer state with
+    fresh (zero) failure counters, and an unwrapped template accepts
+    wrapped bundles by restoring just their ``inner_state``. Without this,
+    changing ``max_bad_steps`` across 0 would strand every checkpoint on
+    the other side.
+    """
+    blob, _ = _read_payload(path)
     template = {"params": params_template,
                 "opt_state": opt_state_template, "step": 0}
-    state = serialization.from_bytes(template, blob)
+    try:
+        state = serialization.from_bytes(template, blob)
+    except (ValueError, KeyError):
+        import optax
+
+        # flax serializes the wrapper NamedTuple by field names and a plain
+        # chain tuple as {"0": ..., "1": ...} — distinguishable in the raw
+        # state dict.
+        raw = serialization.msgpack_restore(blob)
+        raw_opt = raw.get("opt_state") if isinstance(raw, dict) else None
+        raw_wrapped = (isinstance(raw_opt, dict)
+                       and set(raw_opt) >= {"inner_state", "notfinite_count"})
+        tmpl_wrapped = isinstance(opt_state_template, optax.ApplyIfFiniteState)
+        if tmpl_wrapped and not raw_wrapped:
+            inner = serialization.from_state_dict(
+                opt_state_template.inner_state, raw_opt)
+            opt_state = opt_state_template._replace(inner_state=inner)
+            logger.info("%s holds an unwrapped opt_state: re-wrapped with "
+                        "fresh apply_if_finite counters", path)
+        elif raw_wrapped and not tmpl_wrapped:
+            opt_state = serialization.from_state_dict(
+                opt_state_template, raw_opt["inner_state"])
+            logger.info("%s holds an apply_if_finite opt_state: restored "
+                        "its inner state into the unwrapped optimizer", path)
+        else:
+            raise
+        params = serialization.from_state_dict(params_template, raw["params"])
+        return params, opt_state, int(raw["step"])
     return state["params"], state["opt_state"], int(state["step"])
 
 
@@ -57,3 +197,108 @@ def load_params(path: str, cfg, params_template=None):
         return load_pth(path, cfg)
     params, _, _ = load_checkpoint(path, params_template)
     return params
+
+
+def _numbered_bundles(ckpt_dir: str, name: Optional[str] = None
+                      ) -> List[Tuple[int, str]]:
+    """(step, path) for every numbered bundle under ``ckpt_dir``, optionally
+    filtered to run ``name``; unsorted.
+
+    The name filter anchors the regex on the literal name (rather than
+    parsing generically and comparing), so prune/find operate strictly
+    per-run. Names that collide with the bundle grammar (leading digits or
+    marker words — ``check_run_name`` rejects e.g. ``epoch_v2``) are refused
+    at train() start; callers using save/prune as library API directly must
+    run :func:`check_run_name` themselves.
+    """
+    pat = _STEP_RE if name is None else re.compile(
+        rf"^(\d+)_(?:preempt_|epoch_)?({re.escape(name)})$")
+    out = []
+    for fname in os.listdir(ckpt_dir):
+        if not fname.endswith(CKPT_SUFFIX):
+            continue
+        m = pat.match(fname[:-len(CKPT_SUFFIX)])
+        if m is None:
+            continue
+        out.append((int(m.group(1)), os.path.join(ckpt_dir, fname)))
+    return out
+
+
+def find_latest_checkpoint(ckpt_dir: str, name: Optional[str] = None,
+                           include_final: bool = False) -> Optional[str]:
+    """Newest *valid* bundle in ``ckpt_dir``, or None.
+
+    Candidates are walked newest-step-first and validated (hash check for
+    hash-framed bundles, msgpack parse for legacy ones); a truncated or
+    corrupt newest bundle is logged and skipped so resume falls back to the
+    previous good state instead of dying on it.
+
+    ``include_final`` (needs ``name``) also considers the unnumbered FINAL
+    ``{name}`` bundle, preferring it whenever it holds a step >= the newest
+    valid numbered one: a finished run's final state can be AHEAD of its
+    last periodic save (num_steps not a multiple of ckpt_every), and
+    resuming from the periodic one would silently retrain the schedule
+    tail. Off by default — the final bundle means "finished", and plain
+    mid-run fallback must never pick it over newer numbered state it ties
+    with arbitrarily.
+    """
+    if not os.path.isdir(ckpt_dir):
+        return None
+    best = None
+    for step, path in sorted(_numbered_bundles(ckpt_dir, name), reverse=True):
+        if validate_checkpoint(path):
+            best = (step, path)
+            break
+        logger.warning("skipping invalid checkpoint %s (truncated/corrupt); "
+                       "falling back to the previous bundle", path)
+    if include_final and name is not None:
+        final = os.path.join(ckpt_dir, name + CKPT_SUFFIX)
+        if os.path.exists(final):
+            try:
+                # Parsing the step doubles as validation (hash frame or
+                # msgpack parse) — no separate validate pass/read.
+                fstep = bundle_step(final)
+            except Exception:  # noqa: BLE001 - corrupt final: not a candidate
+                logger.warning("ignoring corrupt final bundle %s", final)
+            else:
+                if best is None or fstep >= best[0]:
+                    return final
+    return best[1] if best is not None else None
+
+
+def prune_checkpoints(ckpt_dir: str, name: str, keep: int) -> List[str]:
+    """Keep-last-``keep``-*valid* retention over the *periodic*
+    ``{step}_{name}`` bundles; preempt/epoch/final bundles are never pruned
+    (each marks a distinct recovery point). Returns the removed paths.
+
+    Only bundles that validate count toward ``keep`` — a run whose newest
+    bundles were corrupted on disk (the exact failure the hash frame
+    detects) must not have its only loadable fallback deleted out from
+    under ``find_latest_checkpoint``. Corrupt bundles inside the retention
+    window are left in place (deleting data on a failing filesystem helps
+    nobody); corrupt or not, anything older than ``keep`` valid bundles is
+    removed. Validation hashes each retained bundle per prune — a few
+    sequential file reads every ``ckpt_every`` steps, noise next to the
+    save itself.
+    """
+    if keep <= 0 or not os.path.isdir(ckpt_dir):
+        return []
+    periodic = re.compile(rf"^(\d+)_{re.escape(name)}{re.escape(CKPT_SUFFIX)}$")
+    numbered = []
+    for fname in os.listdir(ckpt_dir):
+        m = periodic.match(fname)
+        if m is not None:
+            numbered.append((int(m.group(1)), os.path.join(ckpt_dir, fname)))
+    removed = []
+    kept_valid = 0
+    for _, path in sorted(numbered, reverse=True):
+        if kept_valid < keep:
+            if validate_checkpoint(path):
+                kept_valid += 1
+            continue
+        try:
+            os.remove(path)
+            removed.append(path)
+        except OSError:  # already gone (concurrent cleanup): retention met
+            pass
+    return removed

@@ -3,7 +3,11 @@
 
 Same observable behavior: scalars flushed every ``SUM_FREQ=100`` steps from
 running means, per-batch ``live_loss`` and ``learning_rate`` entries, and
-``write_dict`` for validation results. The writer is tensorboardX (pure
+``write_dict`` for validation results. One deliberate refinement over the
+reference: means divide by the number of pushes that actually carried the
+key (the reference divides by the fixed window size) — skipped non-finite
+steps push no metrics, and a fixed divisor would deflate exactly the
+windows where divergence is being diagnosed. The writer is tensorboardX (pure
 python), lazily constructed so headless / test runs pay nothing; when
 tensorboardX is unavailable the scalars land in ``<log_dir>/scalars.jsonl``
 (one ``{"step", "tag", "value"}`` object per line) so training telemetry is
@@ -45,7 +49,13 @@ class Logger:
         self.log_dir = log_dir
         self.scheduler = scheduler
         self.total_steps = 0
+        # Steps whose update was skipped (non-finite grads) don't advance
+        # the optimizer's schedule position; the train loop keeps this at
+        # the wrapper's total_notfinite so the console LR reads the
+        # schedule where the optimizer actually is.
+        self.schedule_offset = 0
         self.running_loss: Dict[str, float] = {}
+        self.running_count: Dict[str, int] = {}
         self.writer = None
 
     def _ensure_writer(self):
@@ -58,16 +68,16 @@ class Logger:
         return self.writer
 
     def _print_training_status(self):
-        metrics_data = [self.running_loss[k] / SUM_FREQ
+        metrics_data = [self.running_loss[k] / self.running_count[k]
                         for k in sorted(self.running_loss.keys())]
-        lr = (float(self.scheduler(self.total_steps))
+        lr = (float(self.scheduler(self.total_steps - self.schedule_offset))
               if self.scheduler is not None else float("nan"))
         metrics_str = ("{:10.4f}, " * len(metrics_data)).format(*metrics_data)
         logger.info("[%6d, %10.7f] %s", self.total_steps + 1, lr, metrics_str)
 
         writer = self._ensure_writer()
         for k in self.running_loss:
-            writer.add_scalar(k, self.running_loss[k] / SUM_FREQ,
+            writer.add_scalar(k, self.running_loss[k] / self.running_count[k],
                               self.total_steps)
             self.running_loss[k] = 0.0
 
@@ -75,9 +85,11 @@ class Logger:
         self.total_steps += 1
         for key, value in metrics.items():
             self.running_loss[key] = self.running_loss.get(key, 0.0) + float(value)
+            self.running_count[key] = self.running_count.get(key, 0) + 1
         if self.total_steps % SUM_FREQ == SUM_FREQ - 1:
             self._print_training_status()
             self.running_loss = {}
+            self.running_count = {}
 
     def write_scalar(self, name: str, value: float, step: Optional[int] = None):
         self._ensure_writer().add_scalar(

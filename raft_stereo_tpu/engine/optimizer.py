@@ -45,15 +45,29 @@ def onecycle_linear_schedule(max_lr: float, total_steps: int,
 
 
 def make_optimizer(lr: float, num_steps: int, wdecay: float = 1e-5,
-                   eps: float = 1e-8, clip_norm: float = 1.0):
+                   eps: float = 1e-8, clip_norm: float = 1.0,
+                   skip_nonfinite: int = 0):
     """The reference's full optimizer stack as one optax transform.
 
     ``num_steps + 100`` mirrors the reference's scheduler horizon
     (``train_stereo.py:77``).
+
+    ``skip_nonfinite`` > 0 wraps the stack in ``optax.apply_if_finite``: a
+    step whose gradients contain NaN/Inf leaves params and the inner
+    optimizer state untouched *inside the compiled step* (zero updates, no
+    Adam-moment or schedule-count advance) instead of poisoning the
+    parameters. The finiteness decision is made on the post-all-reduce
+    gradients — replicated values — so every pod process skips the same
+    steps. Note optax's wrapper gives up and APPLIES the non-finite update
+    once more than ``skip_nonfinite`` consecutive steps were skipped; the
+    train loop aborts at exactly ``skip_nonfinite`` consecutive bad steps
+    (engine/train.py), strictly before that can happen.
     """
     schedule = onecycle_linear_schedule(lr, num_steps + 100)
     tx = optax.chain(
         optax.clip_by_global_norm(clip_norm),
         optax.adamw(schedule, b1=0.9, b2=0.999, eps=eps, weight_decay=wdecay),
     )
+    if skip_nonfinite > 0:
+        tx = optax.apply_if_finite(tx, max_consecutive_errors=skip_nonfinite)
     return tx, schedule

@@ -48,6 +48,19 @@ def make_train_step(cfg: RAFTStereoConfig, tx: optax.GradientTransformation,
         params = optax.apply_updates(params, updates)
         metrics = dict(metrics, loss=loss,
                        grad_norm=optax.global_norm(grads))
+        if isinstance(opt_state, optax.ApplyIfFiniteState):
+            # Skip-if-nonfinite optimizer (make_optimizer(skip_nonfinite=N)):
+            # surface the wrapper's replicated skip decision so the host loop
+            # counts skipped steps and bounds consecutive failures without a
+            # second finiteness reduction.
+            metrics["skipped"] = 1.0 - opt_state.last_finite.astype(jnp.float32)
+            metrics["notfinite_count"] = opt_state.notfinite_count.astype(
+                jnp.float32)
+            # Lifetime skip total (survives checkpoint round trips inside
+            # opt_state): step - total_notfinite is the APPLIED-update count,
+            # i.e. the true schedule position for learning-rate logging.
+            metrics["total_notfinite"] = opt_state.total_notfinite.astype(
+                jnp.float32)
         return params, opt_state, metrics
 
     if mesh is None:

@@ -12,6 +12,13 @@ reference, all deliberate:
 
 Cadence preserved: validate + checkpoint every ``ckpt_every`` (10k) steps
 on FlyingThings, final save to ``checkpoints/<name>``.
+
+Fault tolerance (DESIGN.md "Failure recovery"): non-finite steps are skipped
+(params/opt_state untouched via ``optax.apply_if_finite``) with a bounded
+consecutive-failure abort; ``restore_ckpt`` may name a checkpoint directory
+for auto-resume from the newest valid bundle; periodic checkpoints keep the
+last K. All recovery paths are exercised by ``tests/test_faults.py`` through
+the deterministic injection hooks (``faults=`` parameter).
 """
 
 from __future__ import annotations
@@ -144,8 +151,16 @@ class _NullLogger:
 
 def train(cfg: RAFTStereoConfig, tcfg: TrainConfig,
           mesh=None, data_root: Optional[str] = None,
-          validate: bool = True) -> Dict[str, float]:
-    """Run the full training loop; returns the last validation results."""
+          validate: bool = True, faults=None) -> Dict[str, float]:
+    """Run the full training loop.
+
+    Returns the last validation results plus the run's reliability
+    counters (``skipped_steps``, ``quarantined_samples``).
+
+    ``faults``: optional :class:`raft_stereo_tpu.faults.FaultPlan` — the
+    deterministic fault-injection harness used by ``tests/test_faults.py``
+    to exercise every recovery path; None in production.
+    """
     # Multi-host launch (COORDINATOR_ADDRESS set): initialize the JAX
     # distributed runtime BEFORE any device query, so jax.devices() sees
     # the whole pod and the data mesh spans hosts over DCN. No-op otherwise.
@@ -156,23 +171,56 @@ def train(cfg: RAFTStereoConfig, tcfg: TrainConfig,
                            jax.devices(), jax.process_count(),
                            jax.local_device_count())
 
+    # Fail fast on run names that collide with the checkpoint filename
+    # grammar (cross-run prune/resume interference otherwise).
+    ckpt.check_run_name(tcfg.name)
+
     key = jax.random.PRNGKey(tcfg.seed)
     params = jax.jit(lambda k: init_raft_stereo(k, cfg))(key)
-    tx, schedule = make_optimizer(tcfg.lr, tcfg.num_steps, tcfg.wdecay)
+    # max_bad_steps > 0 engages the skip-if-nonfinite policy: a NaN/Inf step
+    # leaves params/opt_state untouched inside the compiled step and the
+    # loop below aborts only after that many consecutive failures.
+    tx, schedule = make_optimizer(tcfg.lr, tcfg.num_steps, tcfg.wdecay,
+                                  skip_nonfinite=tcfg.max_bad_steps)
     opt_state = jax.jit(tx.init)(params)
     start_step = 0
 
-    if tcfg.restore_ckpt is not None:
-        if tcfg.restore_ckpt.endswith(".pth"):
-            params = ckpt.load_params(tcfg.restore_ckpt, cfg)
+    restore = tcfg.restore_ckpt
+    ckpt_dir = "checkpoints"
+    if restore is not None and os.path.isdir(restore):
+        # Auto-resume: newest VALID bundle for THIS run name in the
+        # directory; a truncated or corrupt newest checkpoint is skipped in
+        # favor of the previous good one (ckpt.find_latest_checkpoint), and
+        # an empty/fresh directory starts from scratch — the restart command
+        # of a preemptible job is the same on its first and its N-th launch.
+        # The name filter keeps a shared checkpoints/ directory from
+        # silently resuming another experiment's state; to continue a
+        # DIFFERENT run's checkpoint, pass its file path explicitly.
+        # New checkpoints (periodic/preempt/final) and pruning follow the
+        # SAME directory, so relaunch-with-identical-flags actually makes
+        # forward progress when the directory isn't ./checkpoints.
+        ckpt_dir = restore
+        # include_final: a finished run's relaunch must restore the final
+        # bundle (and train zero steps), not retrain the schedule tail from
+        # the last periodic save on a fresh epoch ordering. (When the final
+        # bundle wins, load_checkpoint parses it a second time — accepted:
+        # that relaunch trains nothing anyway.)
+        found = ckpt.find_latest_checkpoint(restore, name=tcfg.name,
+                                            include_final=True)
+        if found is None:
+            logger.warning("no valid checkpoint under %s: starting fresh",
+                           restore)
+        restore = found
+    if restore is not None:
+        if restore.endswith(".pth"):
+            params = ckpt.load_params(restore, cfg)
             opt_state = jax.jit(tx.init)(params)
-            logger.info("Transplanted reference weights from %s",
-                        tcfg.restore_ckpt)
+            logger.info("Transplanted reference weights from %s", restore)
         else:
             params, opt_state, start_step = ckpt.load_checkpoint(
-                tcfg.restore_ckpt, params, opt_state)
+                restore, params, opt_state)
             logger.info("Restored full state from %s at step %d",
-                        tcfg.restore_ckpt, start_step)
+                        restore, start_step)
 
     logger.info("Parameter Count: %d", count_parameters(params))
     # Multi-host: each process decodes only the global-batch rows its
@@ -189,11 +237,24 @@ def train(cfg: RAFTStereoConfig, tcfg: TrainConfig,
     log = Logger(scheduler=schedule) if is_lead else _NullLogger()
     log.total_steps = start_step
 
-    os.makedirs("checkpoints", exist_ok=True)
+    if faults is not None:
+        from raft_stereo_tpu.faults import FaultyDataset
+        train_loader.dataset = FaultyDataset(train_loader.dataset, faults)
+
+    os.makedirs(ckpt_dir, exist_ok=True)
     total_steps = start_step
-    should_keep_training = True
+    # A resumed bundle at/past the horizon (auto-resume of a finished run)
+    # must not execute extra steps beyond the OneCycle schedule: the
+    # in-loop bound only triggers AFTER a step runs.
+    should_keep_training = start_step < tcfg.num_steps
+    if not should_keep_training:
+        logger.warning("restored step %d >= num_steps %d: nothing to train",
+                       start_step, tcfg.num_steps)
     preempted = False
     last_results: Dict[str, float] = {}
+    skipped_total = 0
+    consecutive_bad = 0
+    quarantine_seen = 0
     guard = PreemptGuard()
 
     def run_step(params, opt_state, batch):
@@ -213,8 +274,13 @@ def train(cfg: RAFTStereoConfig, tcfg: TrainConfig,
     image_dtype = jnp.bfloat16 if cfg.mixed_precision else None
     try:
         while should_keep_training:
+            epoch_batches = train_loader
+            if faults is not None:
+                from raft_stereo_tpu.faults import poisoned_batches
+                epoch_batches = poisoned_batches(train_loader, faults,
+                                                 start_step=total_steps)
             for batch in device_prefetch(
-                    train_loader, mesh=mesh, image_dtype=image_dtype,
+                    epoch_batches, mesh=mesh, image_dtype=image_dtype,
                     global_batch=(tcfg.batch_size if local_rows is not None
                                   else None)):
                 if (tcfg.trace_dir is not None and is_lead
@@ -225,30 +291,93 @@ def train(cfg: RAFTStereoConfig, tcfg: TrainConfig,
                 else:
                     params, opt_state, host = run_step(params, opt_state,
                                                        batch)
-                if host.get("finite", 1.0) < 1.0:
+                bad = (host.get("finite", 1.0) < 1.0
+                       or host.get("skipped", 0.0) > 0.0
+                       or not np.isfinite(host.get("loss", 0.0)))
+                # Keep the console LR on the schedule position the optimizer
+                # actually reached (applied updates, not raw steps).
+                log.schedule_offset = int(host.get("total_notfinite", 0))
+                if bad:
                     # Reference invariant (train_stereo.py:48-56): NaN/Inf in
-                    # the predictions or loss aborts loudly instead of
-                    # silently corrupting the parameters.
-                    raise FloatingPointError(
-                        f"non-finite loss/predictions at step {total_steps} "
-                        f"(loss={host.get('loss')})")
-                log.push({k: host[k] for k in
-                          ("epe", "1px", "3px", "5px", "loss") if k in host})
-                log.write_scalar("live_loss", host["loss"], total_steps)
-                log.write_scalar("learning_rate", float(schedule(total_steps)),
-                                 total_steps)
+                    # the predictions or loss must never corrupt the
+                    # parameters. With max_bad_steps > 0 the optimizer's
+                    # apply_if_finite wrapper rejects any update whose
+                    # gradients are non-finite (params/opt_state untouched),
+                    # so such a step is counted and skipped; the abort fires
+                    # only after max_bad_steps CONSECUTIVE failures — a
+                    # systematic divergence, not a one-off bad batch. The
+                    # decision inputs (loss, the wrapper's notfinite_count)
+                    # are replicated values, so every pod process counts —
+                    # and aborts — identically.
+                    if not host.get("skipped", 0.0) > 0.0:
+                        # No wrapper (max_bad_steps <= 0), or non-finite
+                        # loss/predictions with FINITE gradients (fp32
+                        # overflow in the loss reduction, NaN predictions
+                        # masked out of the loss): the update was APPLIED, so
+                        # the parameters are already suspect — skipping
+                        # cannot undo it. Abort immediately, exactly like the
+                        # reference.
+                        raise FloatingPointError(
+                            f"non-finite loss/predictions at step "
+                            f"{total_steps} with the update applied "
+                            f"(loss={host.get('loss')})")
+                    skipped_total += 1
+                    consecutive_bad = int(host["notfinite_count"])
+                    log.write_scalar("skipped_steps", skipped_total,
+                                     total_steps)
+                    # Keep the Logger's step counter in lockstep with the
+                    # loop (skipped steps contribute no metrics but do
+                    # occupy a step), so running-mean x-axes and the console
+                    # status line never drift from total_steps.
+                    log.push({})
+                    logger.warning(
+                        "non-finite step %d skipped (%d consecutive, "
+                        "%d total, loss=%s)", total_steps, consecutive_bad,
+                        skipped_total, host.get("loss"))
+                    if consecutive_bad >= tcfg.max_bad_steps:
+                        raise FloatingPointError(
+                            f"non-finite loss/predictions at step "
+                            f"{total_steps} ({consecutive_bad} consecutive; "
+                            f"loss={host.get('loss')})")
+                else:
+                    consecutive_bad = 0
+                    log.push({k: host[k] for k in
+                              ("epe", "1px", "3px", "5px", "loss")
+                              if k in host})
+                    log.write_scalar("live_loss", host["loss"], total_steps)
+                    # Schedule position = APPLIED-update count: skipped
+                    # steps leave the inner Adam count untouched, so the LR
+                    # actually used sits total_notfinite steps behind
+                    # total_steps (exact across checkpoint round trips —
+                    # the counter lives in opt_state).
+                    applied = total_steps - int(host.get("total_notfinite", 0))
+                    log.write_scalar("learning_rate",
+                                     float(schedule(applied)), total_steps)
+                nq = len(getattr(train_loader, "quarantined", ()))
+                if nq != quarantine_seen:
+                    quarantine_seen = nq
+                    log.write_scalar("quarantined_samples", nq, total_steps)
                 total_steps += 1
+                if faults is not None:
+                    from raft_stereo_tpu.faults import fire_step_faults
+                    fire_step_faults(faults, total_steps)
 
                 # Writes (checkpoints, validation, TensorBoard) happen on the
                 # lead process only: on a pod, every process executes the loop
                 # and holds the same replicated state, and concurrent writers
                 # to a shared filesystem would corrupt the checkpoint.
                 if total_steps % tcfg.ckpt_every == 0 and is_lead:
-                    save_path = (f"checkpoints/{total_steps}_{tcfg.name}"
-                                 f"{ckpt.CKPT_SUFFIX}")
+                    save_path = os.path.join(
+                        ckpt_dir, f"{total_steps}_{tcfg.name}"
+                        f"{ckpt.CKPT_SUFFIX}")
                     ckpt.save_checkpoint(save_path, params, opt_state,
                                          total_steps)
                     logger.info("Saved %s", save_path)
+                    if tcfg.keep_ckpts > 0:
+                        # Keep-last-K retention over the periodic bundles
+                        # (preempt/epoch/final saves are never pruned).
+                        ckpt.prune_checkpoints(ckpt_dir, tcfg.name,
+                                               keep=tcfg.keep_ckpts)
                     if validate:
                         # Pull params to host first: a lead-only jit on
                         # arrays still committed to the pod-wide sharding
@@ -268,8 +397,9 @@ def train(cfg: RAFTStereoConfig, tcfg: TrainConfig,
                 if guard.stop(total_steps):
                     preempted = True
                     if is_lead:
-                        save_path = (f"checkpoints/{total_steps}_preempt_"
-                                     f"{tcfg.name}{ckpt.CKPT_SUFFIX}")
+                        save_path = os.path.join(
+                            ckpt_dir, f"{total_steps}_preempt_"
+                            f"{tcfg.name}{ckpt.CKPT_SUFFIX}")
                         ckpt.save_checkpoint(save_path, params, opt_state,
                                              total_steps)
                         logger.warning(
@@ -280,8 +410,9 @@ def train(cfg: RAFTStereoConfig, tcfg: TrainConfig,
                     break
 
             if len(train_loader) >= 10000 and is_lead:
-                save_path = (f"checkpoints/{total_steps}_epoch_{tcfg.name}"
-                             f"{ckpt.CKPT_SUFFIX}")
+                save_path = os.path.join(
+                    ckpt_dir, f"{total_steps}_epoch_{tcfg.name}"
+                    f"{ckpt.CKPT_SUFFIX}")
                 ckpt.save_checkpoint(save_path, params, opt_state, total_steps)
                 logger.info("Saved epoch checkpoint %s", save_path)
 
@@ -289,10 +420,15 @@ def train(cfg: RAFTStereoConfig, tcfg: TrainConfig,
         # means "finished training" to downstream eval/demo, and the preempt
         # file above already holds the resumable state.
         if is_lead and not preempted:
-            final = f"checkpoints/{tcfg.name}{ckpt.CKPT_SUFFIX}"
+            final = os.path.join(ckpt_dir, f"{tcfg.name}{ckpt.CKPT_SUFFIX}")
             ckpt.save_checkpoint(final, params, opt_state, total_steps)
             logger.info("Saved final checkpoint %s", final)
     finally:
         log.close()
         guard.restore()
-    return last_results
+    quarantined = getattr(train_loader, "quarantine_report", dict)()
+    if quarantined:
+        logger.warning("quarantine report: %d sample(s) substituted: %s",
+                       len(quarantined), quarantined)
+    return dict(last_results, skipped_steps=float(skipped_total),
+                quarantined_samples=float(len(quarantined)))

@@ -1,0 +1,117 @@
+"""Deterministic fault injection for the reliability layer (DESIGN.md
+"Failure recovery").
+
+Long schedules (100k+ steps, SURVEY §5) meet every failure domain
+eventually: unreadable/corrupt samples, non-finite steps, truncated
+checkpoints, preemption. Each recovery path in ``data/loader.py``,
+``engine/train.py`` and ``engine/checkpoint.py`` is proven under test by
+the injectors here. Everything is driven by an explicit :class:`FaultPlan`
+value — no environment-variable side channels, no wall-clock, no global
+state — so an injected fault fires at exactly the same sample/step/byte on
+every run, every host, every worker-thread schedule.
+
+The four injectors map one-to-one onto the recovery paths:
+
+- ``io_errors``      -> loader retry + quarantine + deterministic substitution;
+- ``nan_at_steps``   -> ``optax.apply_if_finite`` skip policy + bounded abort;
+- ``truncate_file``  -> checkpoint hash validation + ``find_latest_checkpoint``
+  fallback to the previous good bundle;
+- ``sigterm_at_step``-> ``PreemptGuard`` checkpoint-and-exit + schedule-exact
+  resume.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import threading
+from typing import Dict, Iterable, Iterator, Mapping, Optional, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """Declarative fault schedule; all coordinates are deterministic keys.
+
+    io_errors: dataset index -> number of injected load failures for that
+        sample (``-1`` = fail every attempt, i.e. permanently corrupt).
+        Counted per *attempt*, so a budget of 1 models a transient fault
+        that succeeds on the loader's first retry.
+    nan_at_steps: global step numbers whose batch is NaN-poisoned before
+        the compiled step (exercises the skip-if-nonfinite policy).
+    sigterm_at_step: deliver SIGTERM to this process at that step boundary
+        (exercises the PreemptGuard checkpoint-and-exit path).
+    """
+
+    io_errors: Mapping[int, int] = dataclasses.field(default_factory=dict)
+    nan_at_steps: Tuple[int, ...] = ()
+    sigterm_at_step: Optional[int] = None
+
+
+class FaultyDataset:
+    """Dataset wrapper raising injected IO errors per :class:`FaultPlan`.
+
+    Attempt counts are per dataset index and lock-protected: the loader's
+    thread pool may probe the same quarantined index concurrently, and a
+    lost increment would turn a configured-transient fault permanent.
+    """
+
+    def __init__(self, dataset, plan: FaultPlan):
+        self.dataset = dataset
+        self.plan = plan
+        self.attempts: Dict[int, int] = {}
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return len(self.dataset)
+
+    def __getitem__(self, index, rng=None):
+        budget = self.plan.io_errors.get(int(index))
+        if budget is not None:
+            with self._lock:
+                n = self.attempts.get(int(index), 0)
+                self.attempts[int(index)] = n + 1
+            if budget < 0 or n < budget:
+                raise OSError(
+                    f"injected IO fault for sample {index} (attempt {n + 1})")
+        return self.dataset.__getitem__(index, rng=rng)
+
+
+def poisoned_batches(batches: Iterable, plan: Optional[FaultPlan],
+                     start_step: int = 0) -> Iterator:
+    """Yield host batches, NaN-poisoning those for steps in ``nan_at_steps``.
+
+    Applied to the *host* loader before ``device_prefetch`` so the poison
+    rides the normal transfer path (including the bf16 image downcast,
+    which preserves NaN). Batch ``i`` of this iterator feeds global step
+    ``start_step + i`` — prefetch depth does not change that mapping, only
+    when the decode happens.
+    """
+    for i, batch in enumerate(batches):
+        if plan is not None and (start_step + i) in plan.nan_at_steps:
+            batch = dict(batch)
+            img = np.array(batch["image1"], copy=True)
+            img[(0,) * img.ndim] = np.nan
+            batch["image1"] = img
+        yield batch
+
+
+def fire_step_faults(plan: Optional[FaultPlan], step: int) -> None:
+    """Step-boundary injections (currently: SIGTERM at a configured step)."""
+    if plan is not None and plan.sigterm_at_step == step:
+        os.kill(os.getpid(), signal.SIGTERM)
+
+
+def truncate_file(path: str, keep_bytes: Optional[int] = None,
+                  keep_frac: float = 0.5) -> int:
+    """Truncate ``path`` (default: to half its size), modeling a checkpoint
+    write cut off by a crash that bypassed the atomic-rename path (partial
+    NFS flush, disk-full copy, ...). Returns the retained byte count."""
+    size = os.path.getsize(path)
+    keep = int(size * keep_frac) if keep_bytes is None else keep_bytes
+    keep = max(0, min(size, keep))
+    with open(path, "rb+") as f:
+        f.truncate(keep)
+    return keep

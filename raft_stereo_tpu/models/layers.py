@@ -101,6 +101,13 @@ def apply_residual_block_packed(p: Params, xp: jax.Array,
     ``apply_residual_block(p, unpack(xp), norm_fn, stride=2)``."""
     from raft_stereo_tpu.ops.pallas_encoder import (
         packed_entry_conv, packed_entry_w1, packed_entry_w3)
+    # Stride-2 blocks ALWAYS carry a downsample shortcut (init_residual_block
+    # creates one unless stride == 1 and widths match), so its absence means
+    # these params came from a stride-1 block — a packed (stride-2-only)
+    # apply would silently compute the wrong shortcut; fail with the cause.
+    assert "downsample" in p, (
+        "apply_residual_block_packed needs stride-2 block params (with a "
+        "'downsample' shortcut); got a stride-1 block's params")
     planes = p["conv1"]["w"].shape[-1]
     groups = planes // 8
     y = packed_entry_conv(xp, packed_entry_w3(p["conv1"]["w"]),

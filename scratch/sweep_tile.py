@@ -3,8 +3,10 @@
 Isolated lookup at the headline 1/4-res shape (504 x 744, D=256-channel
 fmaps, bf16 pyramid, 4 levels r=4), 8 lookups in a scan; device time from
 the profiler trace (wall clock is tunnel-dominated). Each TILE value runs
-in a fresh subprocess because the kernel binds TILE at import
-(RAFT_CORR_TILE env). Results recorded in BASELINE.md.
+in a fresh subprocess for clean per-tile profiler traces; the kernel reads
+RAFT_CORR_TILE when each corr fn is built (pallas_reg.corr_tile — the
+lookup cache is keyed by the tile), so same-process sweeps would also work.
+Results recorded in BASELINE.md.
 """
 import json
 import os

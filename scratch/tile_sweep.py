@@ -1,7 +1,7 @@
 import sys; sys.path.insert(0, "/root/repo")
+import os
 import time
 import numpy as np, jax, jax.numpy as jnp
-import raft_stereo_tpu.corr.pallas_reg as pr
 from raft_stereo_tpu.corr import make_corr_fn
 
 B, H, W, D, iters = 1, 504, 744, 256, 16
@@ -11,7 +11,10 @@ f2 = jnp.asarray(rng.standard_normal((B, H, W, D)), jnp.bfloat16)
 c0 = jnp.asarray(rng.uniform(0, W - 1, size=(B, H, W)), jnp.float32)
 
 for tile in (128, 256, 512, 1024):
-    pr.TILE = tile
+    # pallas_reg reads RAFT_CORR_TILE when each corr fn is built (trace
+    # time) and keys its lookup cache by the tile, so same-process sweeps
+    # just set the env var before make_corr_fn.
+    os.environ["RAFT_CORR_TILE"] = str(tile)
     @jax.jit
     def run(c):
         fn = make_corr_fn("reg_tpu", f1, f2, num_levels=4, radius=4)

@@ -1,0 +1,468 @@
+"""Fault-injection recovery tests (DESIGN.md "Failure recovery").
+
+Every recovery path of the reliability layer, driven end-to-end on CPU by
+the deterministic harness in ``raft_stereo_tpu/faults.py`` — no env vars,
+no wall-clock, no flakiness:
+
+1. transient IO fault -> bounded retry -> training input bit-for-bit equal
+   to the fault-free run;
+2. permanently-corrupt sample -> quarantine + deterministic substitution +
+   report; training completes;
+3. injected NaN step -> params/opt_state untouched inside the compiled
+   step, ``skipped_steps`` counted, N consecutive failures abort loudly;
+4. truncated newest checkpoint -> auto-resume falls back to the previous
+   valid bundle and continues the OneCycle schedule;
+plus the SIGTERM preempt -> resume round trip over the same machinery.
+"""
+
+import os
+import os.path as osp
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_stereo_tpu.config import TrainConfig
+from raft_stereo_tpu.data.loader import StereoLoader
+from raft_stereo_tpu.engine import checkpoint as ckpt
+from raft_stereo_tpu.engine.optimizer import make_optimizer
+from raft_stereo_tpu.engine.steps import make_train_step
+from raft_stereo_tpu.faults import (FaultPlan, FaultyDataset,
+                                    poisoned_batches, truncate_file)
+from raft_stereo_tpu.models import init_raft_stereo
+from tests.test_eval_engine import TINY, _tiny_things_tree
+
+pytestmark = pytest.mark.faults
+
+
+class ToyDataset:
+    """Deterministic dict-sample dataset matching the loader protocol."""
+
+    def __init__(self, n=8):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, index, rng=None):
+        v = rng.standard_normal(4).astype(np.float32) + index
+        return {"image1": v, "image2": v, "flow": v[:1], "valid": v[:1]}
+
+
+def _toy_loader(plan=None, retries=2, n=8, seed=7):
+    ds = ToyDataset(n)
+    if plan is not None:
+        ds = FaultyDataset(ds, plan)
+    return StereoLoader(ds, batch_size=4, num_workers=2, seed=seed,
+                        retries=retries, retry_backoff=0.001)
+
+
+def _epochs(loader, n=2):
+    return [b["image1"].copy() for _ in range(n) for b in loader]
+
+
+def _tcfg(**kw):
+    base = dict(batch_size=1, image_size=(32, 48), train_iters=2,
+                num_workers=1, spatial_scale=(-0.2, 0.4),
+                data_retry_backoff=0.001)
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def _leaves_equal(a, b):
+    return all((np.asarray(x) == np.asarray(y)).all()
+               for x, y in zip(jax.tree_util.tree_leaves(a),
+                               jax.tree_util.tree_leaves(b)))
+
+
+def _adam_count(opt_state) -> int:
+    # apply_if_finite(chain(clip, adamw)): inner_state[1] is the adamw chain
+    # state, whose first element is ScaleByAdamState — its count is the
+    # number of APPLIED updates, i.e. the OneCycle schedule position.
+    return int(opt_state.inner_state[1][0].count)
+
+
+# ---------------------------------------------------------------------------
+# Path 1+2: data IO — retry, quarantine, substitution (loader level)
+# ---------------------------------------------------------------------------
+
+def test_transient_fault_retries_bit_identical():
+    clean = _epochs(_toy_loader())
+    loader = _toy_loader(FaultPlan(io_errors={3: 1}))  # fails once, then loads
+    faulted = _epochs(loader)
+    assert all((a == b).all() for a, b in zip(clean, faulted))
+    assert loader.quarantine_report() == {}  # transient != quarantined
+
+
+def test_permanent_fault_quarantined_substituted_and_deterministic():
+    loader = _toy_loader(FaultPlan(io_errors={3: -1}))
+    run1 = _epochs(loader)
+    report = loader.quarantine_report()
+    assert list(report) == [3] and "injected IO fault" in report[3]
+    # Substitution is keyed off [seed, epoch, position]: independent runs
+    # fill the bad slot with the identical substitute.
+    run2 = _epochs(_toy_loader(FaultPlan(io_errors={3: -1})))
+    assert all((a == b).all() for a, b in zip(run1, run2))
+    # Only batches containing the bad sample differ from the clean run.
+    clean = _epochs(_toy_loader())
+    assert 0 < sum((a != b).any() for a, b in zip(clean, run1)) < len(clean)
+
+
+def test_quarantined_sample_skips_retries_on_later_epochs():
+    plan = FaultPlan(io_errors={3: -1})
+    ds = FaultyDataset(ToyDataset(), plan)
+    loader = StereoLoader(ds, batch_size=4, num_workers=1, seed=7,
+                          retries=2, retry_backoff=0.001)
+    _epochs(loader, n=1)
+    attempts_epoch1 = ds.attempts[3]
+    assert attempts_epoch1 == 3  # initial + 2 retries, then quarantined
+    _epochs(loader, n=1)
+    assert ds.attempts[3] == attempts_epoch1  # fast path: not re-probed
+
+
+def test_no_loadable_substitute_raises():
+    # Every sample is permanently bad: the loader must fail loudly, not spin.
+    loader = _toy_loader(FaultPlan(io_errors={i: -1 for i in range(8)}))
+    with pytest.raises(RuntimeError, match="substitute"):
+        _epochs(loader, n=1)
+
+
+def test_quarantine_cap_aborts_on_systematic_failure():
+    # Isolated corruption is substituted; a failure rate past the cap
+    # (1% of the dataset, floored at 16) is a pipeline bug and must abort
+    # loudly instead of silently reshaping the training distribution.
+    n = 4096
+    bad = FaultPlan(io_errors={i: -1 for i in range(64)})
+    loader = _toy_loader(bad, retries=0, n=n)
+    with pytest.raises(RuntimeError, match="systematic"):
+        _epochs(loader, n=1)
+
+
+def test_corrupt_file_on_disk_quarantines(tmp_path):
+    """Real decode path: a garbage PNG raises inside PIL and is quarantined."""
+    from raft_stereo_tpu.data.datasets import SceneFlowDatasets
+    root = _tiny_things_tree(tmp_path)
+    bad = osp.join(root, "FlyingThings3D", "frames_cleanpass", "TRAIN", "A",
+                   "0000", "left", "0006.png")
+    with open(bad, "wb") as f:
+        f.write(b"not a png at all")
+    aug = {"crop_size": [32, 48], "min_scale": -0.2, "max_scale": 0.4,
+           "do_flip": False, "yjitter": True}
+    clean = SceneFlowDatasets(aug, root=root, dstype="frames_cleanpass")
+    final = SceneFlowDatasets(aug, root=root, dstype="frames_finalpass")
+    loader = StereoLoader(clean + final, batch_size=1, num_workers=1, seed=0,
+                          retries=1, retry_backoff=0.001)
+    batches = list(loader)
+    assert len(batches) == 2
+    assert loader.quarantine_report()  # the corrupt cleanpass sample
+
+
+# ---------------------------------------------------------------------------
+# Path 3: numerics — skip-if-nonfinite inside the compiled step
+# ---------------------------------------------------------------------------
+
+def test_nan_step_leaves_params_and_opt_state_unchanged():
+    cfg = TINY
+    params = jax.jit(lambda k: init_raft_stereo(k, cfg))(jax.random.PRNGKey(0))
+    tx, _ = make_optimizer(2e-4, 100, skip_nonfinite=3)
+    opt_state = jax.jit(tx.init)(params)
+    step = make_train_step(cfg, tx, train_iters=2)
+    batch = {"image1": jnp.zeros((1, 32, 48, 3)),
+             "image2": jnp.zeros((1, 32, 48, 3)),
+             "flow": jnp.zeros((1, 32, 48, 1)),
+             "valid": jnp.ones((1, 32, 48))}
+
+    params, opt_state, m = step(params, opt_state, batch)
+    assert float(m["skipped"]) == 0.0 and float(m["finite"]) == 1.0
+    p_before = jax.device_get(params)
+    inner_before = jax.device_get(opt_state.inner_state)
+
+    bad = dict(batch, image1=batch["image1"].at[0, 0, 0, 0].set(jnp.nan))
+    params, opt_state, m = step(params, opt_state, bad)
+    assert float(m["finite"]) == 0.0
+    assert float(m["skipped"]) == 1.0
+    assert float(m["notfinite_count"]) == 1.0
+    # The rejected update leaves params and the inner optimizer state
+    # (Adam moments, schedule count) bit-for-bit untouched.
+    assert _leaves_equal(params, p_before)
+    assert _leaves_equal(opt_state.inner_state, inner_before)
+
+    # Consecutive counting, then reset on a finite step.
+    params, opt_state, m = step(params, opt_state, bad)
+    assert float(m["notfinite_count"]) == 2.0
+    params, opt_state, m = step(params, opt_state, batch)
+    assert float(m["skipped"]) == 0.0
+    assert float(m["notfinite_count"]) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Path 4: checkpoint integrity (unit level)
+# ---------------------------------------------------------------------------
+
+def _toy_state():
+    return {"w": jnp.arange(4, dtype=jnp.float32)}
+
+
+def test_checkpoint_hash_detects_truncation_and_falls_back(tmp_path):
+    d = str(tmp_path)
+    p2 = ckpt.save_checkpoint(osp.join(d, "2_run.msgpack"), _toy_state(),
+                              None, 2)
+    p4 = ckpt.save_checkpoint(osp.join(d, "4_run.msgpack"), _toy_state(),
+                              None, 4)
+    assert ckpt.validate_checkpoint(p4)
+    assert ckpt.find_latest_checkpoint(d) == p4
+    truncate_file(p4)
+    assert not ckpt.validate_checkpoint(p4)
+    with pytest.raises(ckpt.CheckpointError):
+        ckpt.load_checkpoint(p4, _toy_state(), None)
+    # Fallback: the newest VALID bundle wins.
+    assert ckpt.find_latest_checkpoint(d) == p2
+    _, _, step = ckpt.load_checkpoint(p2, _toy_state(), None)
+    assert step == 2
+    truncate_file(p2, keep_bytes=4)  # not even a full header
+    assert ckpt.find_latest_checkpoint(d) is None
+
+
+def test_unwrapped_opt_state_restores_into_skip_wrapper(tmp_path):
+    """Migration: a bundle saved WITHOUT apply_if_finite (pre-wrapper run or
+    --max_bad_steps 0) restores into a wrapped optimizer — inner state kept,
+    failure counters fresh — instead of a pytree-structure error."""
+    import optax
+
+    params = _toy_state()
+    tx_plain, _ = make_optimizer(2e-4, 10, skip_nonfinite=0)
+    plain = tx_plain.init(params)
+    path = ckpt.save_checkpoint(osp.join(str(tmp_path), "5_m.msgpack"),
+                                params, plain, 5)
+    tx_wrapped, _ = make_optimizer(2e-4, 10, skip_nonfinite=3)
+    template = tx_wrapped.init(params)
+    _, restored, step = ckpt.load_checkpoint(path, params, template)
+    assert step == 5
+    assert isinstance(restored, optax.ApplyIfFiniteState)
+    assert int(restored.notfinite_count) == 0
+    assert _leaves_equal(restored.inner_state, plain)
+
+
+def test_wrapped_opt_state_restores_into_plain_optimizer(tmp_path):
+    """Reverse migration: a bundle saved WITH apply_if_finite (the default)
+    restores into an unwrapped optimizer (--max_bad_steps 0) by taking its
+    inner state."""
+    params = _toy_state()
+    tx_w, _ = make_optimizer(2e-4, 10, skip_nonfinite=3)
+    wrapped = tx_w.init(params)
+    path = ckpt.save_checkpoint(osp.join(str(tmp_path), "7_w.msgpack"),
+                                params, wrapped, 7)
+    tx_p, _ = make_optimizer(2e-4, 10, skip_nonfinite=0)
+    _, restored, step = ckpt.load_checkpoint(path, params, tx_p.init(params))
+    assert step == 7
+    assert _leaves_equal(restored, wrapped.inner_state)
+
+
+def test_run_name_grammar_guard():
+    # Names that parse as another run's numbered/marker bundles would cause
+    # silent cross-run prune/resume interference; reject them up front.
+    with pytest.raises(ValueError, match="grammar"):
+        ckpt.check_run_name("2_foo")
+    with pytest.raises(ValueError, match="grammar"):
+        ckpt.check_run_name("epoch_v2")
+    with pytest.raises(ValueError, match="grammar"):
+        ckpt.check_run_name("preempt_x")
+    assert ckpt.check_run_name("raft-stereo") == "raft-stereo"
+
+
+def test_legacy_headerless_checkpoint_loads(tmp_path):
+    from flax import serialization
+    path = osp.join(str(tmp_path), "3_old.msgpack")
+    blob = serialization.to_bytes(
+        {"params": jax.device_get(_toy_state()), "opt_state": None, "step": 3})
+    with open(path, "wb") as f:
+        f.write(blob)
+    assert ckpt.validate_checkpoint(path)
+    params, _, step = ckpt.load_checkpoint(path, _toy_state(), None)
+    assert step == 3
+    np.testing.assert_array_equal(np.asarray(params["w"]), np.arange(4))
+    assert ckpt.find_latest_checkpoint(str(tmp_path)) == path
+
+
+def test_prune_checkpoints_keep_last_k(tmp_path):
+    d = str(tmp_path)
+    for s in (2, 4, 6, 8):
+        ckpt.save_checkpoint(osp.join(d, f"{s}_run.msgpack"), _toy_state(),
+                             None, s)
+    # preempt/epoch/final bundles are retention-exempt
+    ckpt.save_checkpoint(osp.join(d, "5_preempt_run.msgpack"), _toy_state(),
+                         None, 5)
+    ckpt.save_checkpoint(osp.join(d, "3_epoch_run.msgpack"), _toy_state(),
+                         None, 3)
+    ckpt.save_checkpoint(osp.join(d, "run.msgpack"), _toy_state(), None, 8)
+    removed = ckpt.prune_checkpoints(d, "run", keep=2)
+    assert sorted(osp.basename(p) for p in removed) == ["2_run.msgpack",
+                                                        "4_run.msgpack"]
+    assert sorted(os.listdir(d)) == ["3_epoch_run.msgpack", "5_preempt_run.msgpack",
+                                     "6_run.msgpack", "8_run.msgpack",
+                                     "run.msgpack"]
+
+
+def test_prune_never_deletes_the_last_valid_fallbacks(tmp_path):
+    """Corrupt bundles must not count toward keep-last-K: with the newest
+    K periodic saves corrupted on disk, pruning has to retain the older
+    valid ones find_latest_checkpoint will fall back to."""
+    d = str(tmp_path)
+    for s in (2, 4, 6, 8):
+        ckpt.save_checkpoint(osp.join(d, f"{s}_run.msgpack"), _toy_state(),
+                             None, s)
+    truncate_file(osp.join(d, "6_run.msgpack"))
+    truncate_file(osp.join(d, "8_run.msgpack"))
+    removed = ckpt.prune_checkpoints(d, "run", keep=2)
+    # 2_run and 4_run are the only valid bundles left: nothing is removable,
+    # and the corrupt ones inside the window are left in place.
+    assert removed == []
+    assert ckpt.find_latest_checkpoint(d) == osp.join(d, "4_run.msgpack")
+    # Once enough newer VALID bundles exist again, older ones (corrupt or
+    # not) age out normally.
+    for s in (10, 12):
+        ckpt.save_checkpoint(osp.join(d, f"{s}_run.msgpack"), _toy_state(),
+                             None, s)
+    removed = ckpt.prune_checkpoints(d, "run", keep=2)
+    assert sorted(osp.basename(p) for p in removed) == [
+        "2_run.msgpack", "4_run.msgpack", "6_run.msgpack", "8_run.msgpack"]
+
+
+def test_poisoned_batches_targets_exact_step():
+    batches = [{"image1": np.zeros((1, 2, 2, 3), np.float32)}
+               for _ in range(4)]
+    out = list(poisoned_batches(iter(batches), FaultPlan(nan_at_steps=(6,)),
+                                start_step=5))
+    assert not np.isnan(out[0]["image1"]).any()
+    assert np.isnan(out[1]["image1"][0, 0, 0, 0])
+    assert not np.isnan(out[2]["image1"]).any()
+    # source batches are never mutated in place
+    assert not np.isnan(batches[1]["image1"]).any()
+
+
+# ---------------------------------------------------------------------------
+# End-to-end train-loop recovery (tiny real model; one compile per train())
+# ---------------------------------------------------------------------------
+
+def test_train_skips_nan_quarantines_and_retains(tmp_path, monkeypatch):
+    """One training run exercising three recovery paths at once: a NaN step
+    is skipped (not fatal), a corrupt PNG is quarantined and substituted,
+    and periodic checkpoints honor keep-last-K retention."""
+    from raft_stereo_tpu.engine.train import train
+
+    root = _tiny_things_tree(tmp_path)
+    bad = osp.join(root, "FlyingThings3D", "frames_finalpass", "TRAIN", "A",
+                   "0000", "left", "0006.png")
+    with open(bad, "wb") as f:
+        f.write(b"garbage")
+    monkeypatch.chdir(tmp_path)
+    tcfg = _tcfg(name="ft", num_steps=8, ckpt_every=2, keep_ckpts=2,
+                 max_bad_steps=3, data_retries=1)
+    res = train(TINY, tcfg, data_root=root, validate=False,
+                faults=FaultPlan(nan_at_steps=(1,)))
+
+    assert res["skipped_steps"] == 1.0
+    assert res["quarantined_samples"] >= 1.0
+    # keep-last-K over the periodic saves (4 written at 2/4/6/8, 2 kept).
+    periodic = sorted(f for f in os.listdir("checkpoints")
+                      if f.endswith("_ft.msgpack"))
+    assert periodic == ["6_ft.msgpack", "8_ft.msgpack"]
+    # Final state: 8 steps, one skipped -> 7 applied updates; the schedule
+    # position (Adam count) reflects exactly the applied ones.
+    params = init_raft_stereo(jax.random.PRNGKey(0), TINY)
+    tx, _ = make_optimizer(tcfg.lr, tcfg.num_steps,
+                           skip_nonfinite=tcfg.max_bad_steps)
+    _, opt_state, step = ckpt.load_checkpoint("checkpoints/ft.msgpack",
+                                              params, tx.init(params))
+    assert step == 8
+    assert _adam_count(opt_state) == 7
+
+    # Auto-resume of the finished schedule (newest numbered bundle at
+    # num_steps) must train ZERO extra steps — the horizon guard fires
+    # before the loop, not after an off-schedule step already ran.
+    res2 = train(TINY, _tcfg(name="ft", num_steps=8, ckpt_every=2,
+                             keep_ckpts=2, max_bad_steps=3, data_retries=1,
+                             restore_ckpt="checkpoints"),
+                 data_root=root, validate=False)
+    assert res2["skipped_steps"] == 0.0
+    _, opt_state, step = ckpt.load_checkpoint("checkpoints/ft.msgpack",
+                                              params, tx.init(params))
+    assert step == 8
+    assert _adam_count(opt_state) == 7
+
+
+def test_train_aborts_after_consecutive_nans(tmp_path, monkeypatch):
+    from raft_stereo_tpu.engine.train import train
+
+    root = _tiny_things_tree(tmp_path)
+    monkeypatch.chdir(tmp_path)
+    tcfg = _tcfg(name="abort", num_steps=8, ckpt_every=100, max_bad_steps=2)
+    with pytest.raises(FloatingPointError, match="2 consecutive"):
+        train(TINY, tcfg, data_root=root, validate=False,
+              faults=FaultPlan(nan_at_steps=(0, 1, 2)))
+    # An aborted run must not masquerade as a finished one.
+    assert not osp.exists("checkpoints/abort.msgpack")
+
+
+def test_preempt_resume_roundtrip_continues_schedule(tmp_path, monkeypatch):
+    """SIGTERM mid-run -> preempt checkpoint -> auto-resume from the
+    checkpoint DIRECTORY continues the OneCycle schedule from that step."""
+    from raft_stereo_tpu.engine.train import train
+
+    root = _tiny_things_tree(tmp_path)
+    monkeypatch.chdir(tmp_path)
+    tcfg = _tcfg(name="pre", num_steps=10, ckpt_every=100)
+    train(TINY, tcfg, data_root=root, validate=False,
+          faults=FaultPlan(sigterm_at_step=2))
+    files = os.listdir("checkpoints")
+    assert "2_preempt_pre.msgpack" in files
+    assert "pre.msgpack" not in files  # preempted != finished
+
+    # Relaunch with the same flags (same name), pointing at the checkpoint
+    # directory: auto-resume picks up this run's preempt bundle.
+    tcfg2 = _tcfg(name="pre", num_steps=4, ckpt_every=100,
+                  restore_ckpt="checkpoints")
+    train(TINY, tcfg2, data_root=root, validate=False)
+    params = init_raft_stereo(jax.random.PRNGKey(0), TINY)
+    tx, _ = make_optimizer(tcfg2.lr, tcfg2.num_steps,
+                           skip_nonfinite=tcfg2.max_bad_steps)
+    _, opt_state, step = ckpt.load_checkpoint("checkpoints/pre.msgpack",
+                                              params, tx.init(params))
+    assert step == 4
+    # 2 applied updates before preemption + 2 after resume: the schedule
+    # continued instead of restarting (a fresh run would also show 4 only
+    # if it ran 4 updates from step 0 — the preempt bundle at step 2 plus
+    # this count pins the resume point).
+    assert _adam_count(opt_state) == 4
+
+
+def test_resume_falls_back_past_truncated_newest(tmp_path, monkeypatch,
+                                                 caplog):
+    """Acceptance path 4 end-to-end: the newest bundle in the resume
+    directory is truncated; auto-resume logs it, restores the previous
+    valid bundle, and finishes the schedule."""
+    import logging
+
+    from raft_stereo_tpu.engine.train import train
+
+    root = _tiny_things_tree(tmp_path)
+    monkeypatch.chdir(tmp_path)
+    train(TINY, _tcfg(name="a", num_steps=2, ckpt_every=1, keep_ckpts=0),
+          data_root=root, validate=False)
+    assert ckpt.find_latest_checkpoint("checkpoints") == \
+        osp.join("checkpoints", "2_a.msgpack")
+    truncate_file("checkpoints/2_a.msgpack")
+
+    with caplog.at_level(logging.WARNING,
+                         logger="raft_stereo_tpu.engine.checkpoint"):
+        assert ckpt.find_latest_checkpoint("checkpoints") == \
+            osp.join("checkpoints", "1_a.msgpack")
+        train(TINY, _tcfg(name="a", num_steps=3, ckpt_every=100,
+                          restore_ckpt="checkpoints"),
+              data_root=root, validate=False)
+    assert "skipping invalid checkpoint" in caplog.text
+    params = init_raft_stereo(jax.random.PRNGKey(0), TINY)
+    _, _, step = ckpt.load_checkpoint("checkpoints/a.msgpack", params, None)
+    assert step == 3

@@ -7,7 +7,6 @@ from __future__ import annotations
 
 import argparse
 import logging
-from pathlib import Path
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -18,7 +17,9 @@ def build_parser() -> argparse.ArgumentParser:
                         help="name your experiment")
     parser.add_argument('--restore_ckpt', help="restore checkpoint "
                         "(.pth transplants reference weights; .msgpack "
-                        "restores full state incl. optimizer and step)")
+                        "restores full state incl. optimizer and step; a "
+                        "DIRECTORY auto-resumes from its newest valid "
+                        "bundle, skipping truncated/corrupt ones)")
 
     # Training parameters
     parser.add_argument('--batch_size', type=int, default=6,
@@ -74,6 +75,23 @@ def build_parser() -> argparse.ArgumentParser:
                              "in the train step (save-kernel-outputs remat "
                              "policy; measured +16%% steps/s at the "
                              "reference crop config)")
+
+    # Fault tolerance (DESIGN.md "Failure recovery")
+    parser.add_argument('--max_bad_steps', type=int, default=5,
+                        help="skip non-finite steps (params/opt_state "
+                             "untouched) and abort only after this many "
+                             "CONSECUTIVE bad steps; 0 = abort on first")
+    parser.add_argument('--keep_ckpts', type=int, default=3,
+                        help="keep-last-K retention over periodic "
+                             "checkpoints (preempt/epoch/final bundles are "
+                             "never pruned); 0 keeps all")
+    parser.add_argument('--data_retries', type=int, default=2,
+                        help="per-sample IO/decode retries before the "
+                             "sample is quarantined and deterministically "
+                             "substituted")
+    parser.add_argument('--data_retry_backoff', type=float, default=0.05,
+                        help="base seconds of the loader's exponential "
+                             "per-sample retry backoff")
     return parser
 
 
@@ -83,8 +101,6 @@ def main(argv=None) -> None:
     logging.basicConfig(
         level=logging.INFO,
         format='%(asctime)s %(levelname)-8s [%(filename)s:%(lineno)d] %(message)s')
-    Path("checkpoints").mkdir(exist_ok=True, parents=True)
-
     from raft_stereo_tpu.config import RAFTStereoConfig, TrainConfig
     from raft_stereo_tpu.engine.train import train
 
