@@ -36,6 +36,19 @@ volume einsum, so no post-hoc volume copy is needed; per-level true
 widths (successive floor halving of the original W2) bound the tap mask,
 which also hides the pooled-boundary artifact when a level width is odd.
 
+Packing (bf16): levels pair-pack two taps per 32-bit lane so the gather
+needs no upcast pass and the align scan walks half the lanes. A level
+whose 128-aligned row is an EVEN number of 128-blocks packs standalone
+(container rows are whole vregs at the same byte count); the odd-block
+levels — whose standalone containers would pad half a vreg of dead DMA
+per row (r5: +17% pyramid traffic at Middlebury-F) — pair up instead:
+the widest odd-block level hosts a combined container whose last 64
+lanes carry the deepest level's packed rows (``pack_plan``). Total DMA
+equals the unpacked layout exactly, every level runs the packed gather,
+and the kernel reads one fewer operand. Reads that land in the other
+level's lanes (a tap window straddling past a true width) are zeroed by
+the same true-width bounds mask that hides stale-slab reads.
+
 Precision: the pyramid is stored in the feature-map dtype (bf16 under the
 mixed-precision policy — the analog of the reference's fp16-capable CUDA
 sampler, ``sampler_kernel.cu:126``) and upcast to fp32 inside the kernel,
@@ -61,6 +74,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from raft_stereo_tpu.ops.jax_compat import compiler_params
 
 from raft_stereo_tpu.ops.pooling import avg_pool_last
 
@@ -207,6 +222,39 @@ def gather_lerp_taps_packed(vol, cl, radius: int, w2: int):
     return val[:, :k] * (1.0 - frac) + val[:, 1:k + 1] * frac
 
 
+def gather_lerp_taps_packed_tail(vol, cl, radius: int, w2: int,
+                                 lane_base: int):
+    """Packed gather for a level riding in the TAIL lanes of a combined
+    container operand (see the pairing rule in ``make_reg_tpu_corr_fn``).
+
+    The level's packed rows occupy container lanes ``[lane_base,
+    lane_base + pad_width(w2)/2)`` and must fit inside ONE 128-lane slab
+    (``lane_base % LANE + pad_width(w2)//2 <= LANE`` — the builder
+    asserts it), so the gather is a single ``take_along_axis`` on that
+    slab with a static lane offset: no align scan at all, like the
+    deepest levels of a standalone packed operand. Out-of-range taps
+    (including clipped indices that land in the OTHER level's lanes)
+    are zeroed by the true-width bounds mask, exactly like the stale-
+    slab reads of the standalone walk."""
+    k = 2 * radius + 1
+    vi = jax.lax.bitcast_convert_type(vol, jnp.int32)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (vol.shape[0], LANE), 1)
+    i0 = jnp.floor(cl)
+    frac = cl - i0  # (P, 1)
+    base = i0.astype(jnp.int32) - radius  # first tap true position
+    xpos = base + lane  # true tap position for out lane t
+    pidx = xpos >> 1  # containing pair (arithmetic shift = floor)
+    sb, off = lane_base // LANE, lane_base % LANE
+    slab = vi[:, sb * LANE:(sb + 1) * LANE]
+    g = jnp.take_along_axis(slab, jnp.clip(off + pidx, 0, LANE - 1),
+                            axis=-1)
+    lo = jax.lax.bitcast_convert_type(g << 16, jnp.float32)
+    hi = jax.lax.bitcast_convert_type(g & jnp.int32(-65536), jnp.float32)
+    val = jnp.where((xpos & 1) == 0, lo, hi)
+    val = jnp.where((xpos >= 0) & (xpos < w2), val, 0.0)
+    return val[:, :k] * (1.0 - frac) + val[:, 1:k + 1] * frac
+
+
 PACK_ALIGN = 2 * LANE  # bf16 row width multiple that packs to whole vregs
 
 
@@ -316,9 +364,10 @@ def _make_partitioned(impl, ndims: Sequence[int], rule: str,
         arg_sh = tuple(_row_sharding(mesh, arg_shapes, nd) for nd in ndims)
         return mesh, impl, out_sh, arg_sh
 
-    fn.def_partition(partition, infer_sharding_from_operands=infer,
-                     sharding_rule=rule,
-                     need_replication_factors=need_replication_factors)
+    from raft_stereo_tpu.ops.jax_compat import def_partition
+    def_partition(fn, partition, infer_sharding_from_operands=infer,
+                  sharding_rule=rule,
+                  need_replication_factors=need_replication_factors)
     return fn
 
 
@@ -379,35 +428,45 @@ def make_batch_partitioned(impl, batch_in_axes: Sequence,
         ins, outs = _shardings(mesh, arg_shapes)
         return mesh, impl, outs, ins
 
-    fn.def_partition(partition, infer_sharding_from_operands=infer,
-                     sharding_rule=rule,
-                     need_replication_factors=tuple(repl))
+    from raft_stereo_tpu.ops.jax_compat import def_partition
+    def_partition(fn, partition, infer_sharding_from_operands=infer,
+                  sharding_rule=rule,
+                  need_replication_factors=tuple(repl))
     return fn
 
 
 def _lookup_kernel(coords_ref, *refs, radius: int, widths: Sequence[int],
-                   packed: Tuple[bool, ...]):
+                   spec: Tuple[Tuple[int, bool, int], ...]):
+    """``spec``: per level ``(operand_idx, packed, lane_base)`` — levels may
+    share one operand (the combined host+tail container), so operands are a
+    separate axis from pyramid levels."""
     *vol_refs, out_ref = refs
     k = 2 * radius + 1
     c = coords_ref[:]  # (TILE, 1) fp32
-    for lvl, vol_ref in enumerate(vol_refs):
-        taps = gather_lerp_taps_packed if packed[lvl] else gather_lerp_taps
+    for lvl, (op, is_packed, base) in enumerate(spec):
         cl = c * (1.0 / (1 << lvl))
-        out_ref[:, lvl * k:(lvl + 1) * k] = taps(
-            vol_ref[:], cl, radius, widths[lvl]).astype(out_ref.dtype)
+        if not is_packed:
+            t = gather_lerp_taps(vol_refs[op][:], cl, radius, widths[lvl])
+        elif base == 0:
+            t = gather_lerp_taps_packed(vol_refs[op][:], cl, radius,
+                                        widths[lvl])
+        else:
+            t = gather_lerp_taps_packed_tail(vol_refs[op][:], cl, radius,
+                                             widths[lvl], base)
+        out_ref[:, lvl * k:(lvl + 1) * k] = t.astype(out_ref.dtype)
 
 
 def _pallas_lookup(pyramid: Sequence[jax.Array], coords_flat: jax.Array,
                    radius: int, widths: Tuple[int, ...],
-                   out_dtype, packed: Tuple[bool, ...],
+                   out_dtype, spec: Tuple[Tuple[int, bool, int], ...],
                    tile: int = _TILE_DEFAULT) -> jax.Array:
-    """pyramid: list of (N, W2p_l) fp32; coords_flat: (N, 1) fp32."""
+    """pyramid: list of per-OPERAND (N, W2p) rows; coords_flat: (N, 1)."""
     n = coords_flat.shape[0]
     k = 2 * radius + 1
-    out_ch = len(pyramid) * k
+    out_ch = len(spec) * k
     grid = pl.cdiv(n, tile)
     kernel = functools.partial(_lookup_kernel, radius=radius, widths=widths,
-                               packed=packed)
+                               spec=spec)
     out = pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((n, out_ch), out_dtype),
@@ -420,7 +479,7 @@ def _pallas_lookup(pyramid: Sequence[jax.Array], coords_flat: jax.Array,
                                memory_space=pltpu.VMEM),
         # The 2048-pixel tile's double-buffered level blocks + fp32
         # gather temporaries need ~28 MB; the default scoped cap is 16.
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=64 * 2**20),
+        compiler_params=compiler_params(vmem_limit_bytes=64 * 2**20),
         interpret=_interpret(),
     )(coords_flat, *pyramid)
     return out
@@ -428,29 +487,32 @@ def _pallas_lookup(pyramid: Sequence[jax.Array], coords_flat: jax.Array,
 
 @functools.lru_cache(maxsize=None)
 def _partitioned_lookup(radius: int, widths: Tuple[int, ...], out_dtype_name,
-                        nlev: int, packed: Tuple[bool, ...] = (),
+                        nops: int,
+                        spec: Tuple[Tuple[int, bool, int], ...] = (),
                         tile: int = _TILE_DEFAULT):
-    """SPMD-partitionable 3D lookup: coords (B, N, 1) + per-level rows
-    (B, N, W2p_l) -> (B, N, nlev*(2r+1)), independent along (B, N) — any
-    mesh sharding of the leading two axes runs the flat kernel per-shard.
-    ``tile`` is part of the cache key, so corr fns built under different
-    ``RAFT_CORR_TILE`` values coexist.
+    """SPMD-partitionable 3D lookup: coords (B, N, 1) + ``nops`` row
+    operands (B, N, W2p) -> (B, N, nlev*(2r+1)), independent along (B, N)
+    — any mesh sharding of the leading two axes runs the flat kernel
+    per-shard. ``spec`` maps pyramid levels onto operands (a combined
+    host+tail container serves two levels). ``tile`` is part of the cache
+    key, so corr fns built under different ``RAFT_CORR_TILE`` values
+    coexist.
     """
     out_dtype = jnp.dtype(out_dtype_name)
+    spec = spec or tuple((i, False, 0) for i in range(len(widths)))
 
     def impl(coords3, *pyr3):
         b, n, _ = coords3.shape
         flat = [p.reshape(b * n, p.shape[-1]) for p in pyr3]
         out = _pallas_lookup(flat, coords3.reshape(b * n, 1), radius,
-                             widths, out_dtype,
-                             packed or (False,) * nlev, tile)
+                             widths, out_dtype, spec, tile)
         return out.reshape(b, n, -1)
 
-    rule = ("b n u, " + ", ".join(f"b n w{i}" for i in range(nlev))
+    rule = ("b n u, " + ", ".join(f"b n w{i}" for i in range(nops))
             + " -> b n k")
     # In rule-appearance order (the Shardy verifier requires it).
-    repl = ("u",) + tuple(f"w{i}" for i in range(nlev)) + ("k",)
-    return _make_partitioned(impl, [3] * (nlev + 1), rule,
+    repl = ("u",) + tuple(f"w{i}" for i in range(nops)) + ("k",)
+    return _make_partitioned(impl, [3] * (nops + 1), rule,
                              need_replication_factors=repl)
 
 
@@ -485,41 +547,42 @@ def _masked_lookup_xla(pyramid: Sequence[jax.Array], coords_flat: jax.Array,
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _lookup(pyramid: List[jax.Array], packed_pyr: List[jax.Array],
+def _lookup(pyramid: List[jax.Array], kernel_ops: List[jax.Array],
             coords_flat: jax.Array, radius: int, widths: Tuple[int, ...],
             out_dtype=jnp.float32,
-            packed: Tuple[bool, ...] = (),
+            spec: Tuple[Tuple[int, bool, int], ...] = (),
             tile: int = _TILE_DEFAULT) -> jax.Array:
     """pyramid: per-level (B, N, W2p_l) bf16/fp32 rows — the DIFFERENTIABLE
     operand (cotangents sum linearly across the loop's 32 lookup calls);
-    packed_pyr: pair-packed fp32-container rows for the levels with
-    ``packed[lvl]`` True (see ``pack_rows``; same length as pyramid, with
-    the unpacked levels' entries aliasing the bf16 rows) — what the kernel
-    reads, zero cotangent for the packed entries. coords_flat: (B, N, 1).
+    kernel_ops: the operands the kernel actually reads when any level
+    packs — pair-packed fp32-container rows, one per ``spec`` operand
+    index (a combined container carries TWO levels; see ``pack_rows``) —
+    zero cotangent. Empty when nothing packs (the kernel then reads the
+    pyramid rows directly). coords_flat: (B, N, 1).
     """
     fn = _partitioned_lookup(radius, widths, jnp.dtype(out_dtype).name,
-                             len(pyramid), packed, tile)
-    rows = packed_pyr if any(packed) else pyramid
+                             len(kernel_ops) or len(pyramid), spec, tile)
+    rows = kernel_ops if kernel_ops else pyramid
     return fn(coords_flat, *rows)
 
 
-def _lookup_fwd(pyramid, packed_pyr, coords_flat, radius, widths, out_dtype,
-                packed, tile):
-    return (_lookup(pyramid, packed_pyr, coords_flat, radius, widths,
-                    out_dtype, packed, tile),
-            (pyramid, coords_flat))
+def _lookup_fwd(pyramid, kernel_ops, coords_flat, radius, widths, out_dtype,
+                spec, tile):
+    return (_lookup(pyramid, kernel_ops, coords_flat, radius, widths,
+                    out_dtype, spec, tile),
+            (pyramid, kernel_ops, coords_flat))
 
 
-def _lookup_bwd(radius, widths, out_dtype, packed, tile, residuals, g):
-    pyramid, coords_flat = residuals
+def _lookup_bwd(radius, widths, out_dtype, spec, tile, residuals, g):
+    pyramid, kernel_ops, coords_flat = residuals
     _, vjp = jax.vjp(
         lambda p: _masked_lookup_xla(p, coords_flat, radius, widths), pyramid)
     # The oracle emits fp32; a bf16-out kernel hands back a bf16 cotangent.
     (d_pyramid,) = vjp(g.astype(jnp.float32))
-    d_packed = [jnp.zeros((*p.shape[:-1], p.shape[-1] // 2), jnp.float32)
-                if is_p else jnp.zeros_like(p)
-                for p, is_p in zip(pyramid, packed)] if any(packed) else []
-    return d_pyramid, d_packed, jnp.zeros_like(coords_flat)
+    # The containers are loop-invariant bit transports: zero cotangent
+    # (all gradient flows through the bf16 pyramid rows).
+    d_ops = [jnp.zeros_like(op) for op in kernel_ops]
+    return d_pyramid, d_ops, jnp.zeros_like(coords_flat)
 
 
 _lookup.defvjp(_lookup_fwd, _lookup_bwd)
@@ -531,6 +594,45 @@ def level_widths(w2: int, num_levels: int) -> Tuple[int, ...]:
     for _ in range(num_levels - 1):
         ws.append(ws[-1] // 2)
     return tuple(ws)
+
+
+def pack_plan(widths: Sequence[int], bf16: bool):
+    """Per-level packing plan: ``"plain"`` | ``"packed"`` (standalone
+    container) | ``("host", tail_lvl)`` | ``("tail", host_lvl)``.
+
+    A bf16 level pair-packs for free only when its 256-aligned pad equals
+    its 128-aligned pad (an EVEN number of 128-blocks); an odd-block level
+    packed standalone pays an extra zero half-vreg of DMA every grid step
+    (r5 measured the bloat eating the win: L1 384->512, L3 128->256 at
+    Middlebury-F, +17% pyramid DMA). But every odd-block level's packed
+    row is an ODD multiple of 64 container lanes, so TWO odd-block levels
+    concatenated are whole vregs with ZERO pad bloat: the deepest level
+    (whose packed rows fit one 64-lane tail, w <= 128) rides in the tail
+    of the widest odd-block level's container. The combined operand's
+    DMA equals the two unpacked levels' exactly, both levels get the
+    no-upcast packed gather, and the kernel reads one fewer operand.
+    Remaining odd-block levels (a third and beyond) stay plain.
+    """
+    plan: List = []
+    for w in widths:
+        if not bf16:
+            plan.append("plain")
+        elif pad_width(w, PACK_ALIGN) == pad_width(w):
+            plan.append("packed")
+        else:
+            plan.append("odd")  # placeholder, resolved below
+    odd = [i for i, p in enumerate(plan) if p == "odd"]
+    # Tail candidate: the deepest level overall, iff odd-block and its
+    # packed rows fit one 64-lane tail slot inside a slab.
+    last = len(widths) - 1
+    if (len(odd) >= 2 and odd[-1] == last
+            and pad_width(widths[last]) // 2 == 64):
+        host = odd[0]  # widest odd-block level hosts the container
+        base = pad_width(widths[host]) // 2
+        if base % LANE + 64 <= LANE:
+            plan[host] = ("host", last)
+            plan[last] = ("tail", host)
+    return ["plain" if p == "odd" else p for p in plan]
 
 
 def make_reg_tpu_corr_fn(fmap1: jax.Array, fmap2: jax.Array, *,
@@ -556,26 +658,28 @@ def make_reg_tpu_corr_fn(fmap1: jax.Array, fmap2: jax.Array, *,
     # bf16 pyramid levels pair-pack into fp32 containers ONCE here (outside
     # the GRU scan — 32 lookups amortize one bitcast pass) so the kernel
     # runs the half-width-scan / no-upcast gather path every iteration.
-    # Per-level decision: pack only when the 256-multiple alignment the
-    # container needs pads no further than the plain 128 alignment —
-    # otherwise (e.g. a 372-wide level padding 384 -> 512) the extra zero
-    # lanes cost more per-step DMA than the packed gather saves. A packed
-    # level's successor pools via ``_lohi_avg`` on the container
-    # (elementwise); unpacked levels pool conventionally. Padded zero
-    # lanes pool to zeros and every consumer masks by the true width, so
-    # pooling padded rows is value-identical to the pad-after-pool order.
+    # Per-level decision (``pack_plan``): pack standalone when the
+    # 256-multiple alignment the container needs pads no further than the
+    # plain 128 alignment; the two widest/deepest ODD-block levels (whose
+    # standalone containers would bloat, e.g. 372 padding 384 -> 512)
+    # share ONE combined container with zero pad bloat. A packed level's
+    # successor pools via ``_lohi_avg`` on the container (elementwise);
+    # unpacked levels pool conventionally. Padded zero lanes pool to
+    # zeros and every consumer masks by the true width, so pooling padded
+    # rows is value-identical to the pad-after-pool order.
     # (B, H*W1, W2p_l) rows: batch stays a real axis and H (major) merges
     # with W1 (minor, unsharded) — both mesh axes of a (data, space)
     # sharding survive the reshape, so the partitioned lookup runs
     # per-shard under any row mesh.
     bf16 = vol.dtype == jnp.bfloat16
-    packed = tuple(
-        bf16 and pad_width(w_, PACK_ALIGN) == pad_width(w_) for w_ in widths)
-    flat, kernel_rows = [], []
+    plan = pack_plan(widths, bf16)
+    any_packed = any(p != "plain" for p in plan)
+    flat, containers = [], {}  # containers: lvl -> packed rows
     cur = vol.reshape(b, h * w1, -1)
     for lvl in range(num_levels):
         wp = cur.shape[-1]
-        want = pad_width(widths[lvl], PACK_ALIGN if packed[lvl] else LANE)
+        want = pad_width(widths[lvl],
+                         PACK_ALIGN if plan[lvl] == "packed" else LANE)
         if wp < want:
             cur = jnp.pad(cur, ((0, 0), (0, 0), (0, want - wp)))
         elif wp > want:
@@ -583,21 +687,46 @@ def make_reg_tpu_corr_fn(fmap1: jax.Array, fmap2: jax.Array, *,
         # The kernel reads the containers on packed levels; the bf16 rows
         # stay the differentiable operand (DCE'd from no-grad programs).
         flat.append(cur)
-        if packed[lvl]:
+        if plan[lvl] != "plain":
             pk = pack_rows(cur)
-            kernel_rows.append(pk)
+            containers[lvl] = pk
             cur = (pool_next_level(cur, pk)
                    if lvl + 1 < num_levels else None)
         else:
-            kernel_rows.append(cur)
             cur = avg_pool_last(cur) if lvl + 1 < num_levels else None
 
+    # Assemble operands + the level -> (operand, packed, lane_base) spec.
+    kernel_ops, spec = [], [None] * num_levels
+    for lvl in range(num_levels):
+        p = plan[lvl]
+        if p == "plain":
+            if any_packed:
+                spec[lvl] = (len(kernel_ops), False, 0)
+                kernel_ops.append(flat[lvl])
+            else:
+                spec[lvl] = (lvl, False, 0)
+        elif p == "packed":
+            spec[lvl] = (len(kernel_ops), True, 0)
+            kernel_ops.append(containers[lvl])
+        elif isinstance(p, tuple) and p[0] == "host":
+            tail = p[1]
+            base = containers[lvl].shape[-1]
+            assert base % LANE + containers[tail].shape[-1] <= LANE, (
+                "tail level must fit one slab slot", base)
+            op = len(kernel_ops)
+            spec[lvl] = (op, True, 0)
+            spec[tail] = (op, True, base)
+            kernel_ops.append(jnp.concatenate(
+                [containers[lvl], containers[tail]], axis=-1))
+        # ("tail", host): spec written by its host above.
+
     tile = corr_tile()  # env override honored per corr-fn build (trace time)
+    spec = tuple(spec)
 
     def corr_fn(coords_x: jax.Array) -> jax.Array:
         coords_flat = coords_x.astype(jnp.float32).reshape(b, h * w1, 1)
-        out = _lookup(flat, kernel_rows if any(packed) else [], coords_flat,
-                      radius, widths, out_dtype, packed, tile)
+        out = _lookup(flat, kernel_ops if any_packed else [], coords_flat,
+                      radius, widths, out_dtype, spec, tile)
         return out.reshape(b, h, w1, -1)
 
     return corr_fn

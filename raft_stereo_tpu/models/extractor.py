@@ -39,6 +39,26 @@ def _apply_stage(stage: list, x: jax.Array, norm_fn: str, stride: int) -> jax.Ar
     return apply_residual_block(stage[1], x, norm_fn, stride=1)
 
 
+def _maybe_stream_block(blk: Params, x: jax.Array, norm_fn: str) -> jax.Array:
+    """Stride-1 second block of a stage: streamed Pallas passes when the
+    shape/dtype allow (ops/pallas_encoder.py streamed tail), XLA otherwise."""
+    from raft_stereo_tpu.ops.pallas_encoder import (
+        resblock_streamable, stream_resblock)
+    if resblock_streamable(blk, x, norm_fn):
+        return stream_resblock(norm_fn, blk, x)
+    return apply_residual_block(blk, x, norm_fn, stride=1)
+
+
+def _apply_stage_fused(stage: list, x: jax.Array, norm_fn: str,
+                       stride: int) -> jax.Array:
+    """Stage application on the FUSED encoder path: the stride-2 entry
+    block stays XLA (its strided reads don't fit the row-ring geometry);
+    the stride-1 second block streams. The ``fused=False`` oracle path
+    keeps using the all-XLA ``_apply_stage``."""
+    x = apply_residual_block(stage[0], x, norm_fn, stride=stride)
+    return _maybe_stream_block(stage[1], x, norm_fn)
+
+
 def _trunk_strides(downsample: int) -> Tuple[int, int, int]:
     return (1 + (downsample > 2), 1 + (downsample > 1), 1 + (downsample > 0))
 
@@ -62,9 +82,9 @@ def _fused_trunk_then_layer2(p: Params, x: jax.Array, norm_fn: str, s2: int,
     if s2 == 2 and _packed_l2_enabled():
         xp = trunk_packed(p, x)
         x = apply_residual_block_packed(p["layer2"][0], xp, norm_fn)
-        return apply_residual_block(p["layer2"][1], x, norm_fn, stride=1)
+        return _maybe_stream_block(p["layer2"][1], x, norm_fn)
     x = trunk_unpacked(p, x)
-    return _apply_stage(p["layer2"], x, norm_fn, s2)
+    return _apply_stage_fused(p["layer2"], x, norm_fn, s2)
 
 
 def init_basic_encoder(key: jax.Array, output_dim: int = 128,
@@ -100,8 +120,10 @@ def apply_basic_encoder(p: Params, x: jax.Array, *, norm_fn: str,
         # (planes//8).
         x = jax.nn.relu(apply_norm(norm_fn, p["norm1"], x, num_groups=8))
         x = _apply_stage(p["layer1"], x, norm_fn, 1)
-        x = _apply_stage(p["layer2"], x, norm_fn, s2)
-    x = _apply_stage(p["layer3"], x, norm_fn, s3)
+        x = (_apply_stage_fused if fused else _apply_stage)(
+            p["layer2"], x, norm_fn, s2)
+    x = (_apply_stage_fused if fused else _apply_stage)(
+        p["layer3"], x, norm_fn, s3)
     return apply_conv(p["conv2"], x)
 
 
@@ -154,18 +176,30 @@ def apply_multi_basic_encoder(p: Params, x: jax.Array, *, norm_fn: str,
         x = apply_conv(p["conv1"], x, stride=s_stem, padding=3)
         x = jax.nn.relu(apply_norm(norm_fn, p["norm1"], x, num_groups=8))
         x = _apply_stage(p["layer1"], x, norm_fn, 1)
-        x = _apply_stage(p["layer2"], x, norm_fn, s2)
-    x = _apply_stage(p["layer3"], x, norm_fn, s3)
+        x = (_apply_stage_fused if fused else _apply_stage)(
+            p["layer2"], x, norm_fn, s2)
+    x = (_apply_stage_fused if fused else _apply_stage)(
+        p["layer3"], x, norm_fn, s3)
     if dual_inp:
         v = x
         x = x[: x.shape[0] // 2]
 
-    def head(h, feat):
+    def head(h, feat, streamed=False):
+        from raft_stereo_tpu.ops.pallas_encoder import (
+            head_conv_streamable, stream_head_conv)
         if "res" in h:
-            feat = apply_residual_block(h["res"], feat, norm_fn, stride=1)
+            feat = (_maybe_stream_block(h["res"], feat, norm_fn) if streamed
+                    else apply_residual_block(h["res"], feat, norm_fn,
+                                              stride=1))
+        if streamed and head_conv_streamable(h["conv"], feat):
+            return stream_head_conv(h["conv"], feat)
         return apply_conv(h["conv"], feat, padding=1)
 
-    outputs08 = [head(h, x) for h in p["outputs08"]]
+    # Only the finest (1/4-res) heads stream: they carry ~16x the pixels
+    # of outputs16/32, whose XLA convs are already cheap — and each
+    # streamed pass is one more Mosaic kernel in an already
+    # compile-time-bound program.
+    outputs08 = [head(h, x, streamed=fused) for h in p["outputs08"]]
     if num_layers == 1:
         return (outputs08, v) if dual_inp else (outputs08,)
     y = _apply_stage(p["layer4"], x, norm_fn, 2)

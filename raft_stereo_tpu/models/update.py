@@ -219,9 +219,10 @@ def apply_update_block(p: Params, cfg: RAFTStereoConfig,
     """
     from jax.ad_checkpoint import checkpoint_name
     from raft_stereo_tpu.ops.pallas_stream import (
-        fused_conv_gru, fused_conv_gru_spatial, fused_gru_head,
-        fused_gru_head_spatial, fused_motion, fused_motion_spatial,
-        gru_is_fusable, motion_is_fusable, spatial_motion_is_fusable)
+        fused_conv_gru, fused_conv_gru_spatial, fused_gru1632,
+        fused_gru_head, fused_gru_head_spatial, fused_motion,
+        fused_motion_spatial, gru1632_is_fusable, gru_is_fusable,
+        motion_is_fusable, spatial_motion_is_fusable)
     fc = list(fused_ctx) if fused_ctx is not None else []
     fc += [None] * (3 - len(fc))
 
@@ -247,14 +248,31 @@ def apply_update_block(p: Params, cfg: RAFTStereoConfig,
 
     net = list(net)
     n = cfg.n_gru_layers
-    if iter32:
-        net[2] = gru(2, net[2], inp[2], pool2x(net[1]))
-    if iter16:
-        if n > 2:
-            net[1] = gru(1, net[1], inp[1], pool2x(net[0]),
-                         interp_align_corners(net[2], net[1].shape[1:3]))
-        else:
-            net[1] = gru(1, net[1], inp[1], pool2x(net[0]))
+    # The two coarse GRUs co-schedule in ONE streaming kernel when both
+    # fire in this call: gru32's fresh state feeds gru16's upsampled
+    # x-input straight from VMEM (bit-identical to the serial kernels +
+    # XLA interp — see pallas_stream.fused_gru1632). Their small spatial
+    # extents make the serial dispatch latency-bound (r5: 126 ms/frame
+    # vs ~50 MXU-bound at Middlebury-F).
+    if (iter32 and iter16 and n == 3 and space_mesh is None
+            and fc[1] is not None and fc[2] is not None
+            and gru1632_is_fusable(net[1], net[2],
+                                   any_batch=fuse_any_batch)):
+        x1p = pool2x(net[1])
+        x0p = pool2x(net[0])
+        net[1], net[2] = fused_gru1632(
+            p["gru16"], p["gru32"], net[1], net[2], fc[1], fc[2],
+            inp[1], inp[2], x0p, x1p)
+        net[1], net[2] = kname(net[1]), kname(net[2])
+    else:
+        if iter32:
+            net[2] = gru(2, net[2], inp[2], pool2x(net[1]))
+        if iter16:
+            if n > 2:
+                net[1] = gru(1, net[1], inp[1], pool2x(net[0]),
+                             interp_align_corners(net[2], net[1].shape[1:3]))
+            else:
+                net[1] = gru(1, net[1], inp[1], pool2x(net[0]))
     delta_x = None
     if iter08:
         # fuse_motion=False when a caller-supplied flow_init could carry a

@@ -1,0 +1,54 @@
+"""Version-bridging shims for the two JAX APIs this project straddles.
+
+The TPU host runs a current JAX (``pltpu.CompilerParams``,
+``custom_partitioning.def_partition(..., sharding_rule=)``); CPU-only CI
+images may carry an older release where the params class is still
+``TPUCompilerParams`` and ``def_partition`` predates Shardy sharding
+rules. Only the names/signatures changed — semantics are identical for
+everything this project uses — so each shim resolves the available form
+once at import time. Dropping ``sharding_rule`` on old JAX only loses
+Shardy-mode propagation, which the GSPMD callbacks (always passed) cover.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+from jax.experimental.pallas import tpu as pltpu
+
+_PARAMS_CLS = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams")
+
+
+def modern_jax() -> bool:
+    """True when ``def_partition`` understands Shardy sharding rules —
+    the proxy for the JAX generation this project targets. Old releases
+    still run the single-device paths correctly (the shims above), but
+    their XLA:CPU crashes (hard SIGSEGV, not an exception) compiling
+    custom-partitioned Pallas programs under a mesh, so mesh-heavy tests
+    skip on them rather than take down the whole pytest process."""
+    from jax.experimental.custom_partitioning import custom_partitioning
+    return "sharding_rule" in inspect.signature(
+        custom_partitioning.def_partition).parameters
+
+
+def compiler_params(**kwargs):
+    """``pltpu.CompilerParams`` on current JAX, ``TPUCompilerParams`` on
+    older releases (same fields, e.g. ``vmem_limit_bytes``)."""
+    return _PARAMS_CLS(**kwargs)
+
+
+def def_partition(fn, partition, infer_sharding_from_operands, *,
+                  sharding_rule=None, need_replication_factors=()):
+    """``custom_partitioning.def_partition`` across the Shardy transition:
+    pass the einsum-like rule where supported, silently omit it where the
+    signature predates it (GSPMD callbacks carry the semantics there)."""
+    params = inspect.signature(fn.def_partition).parameters
+    kwargs = {}
+    if "sharding_rule" in params and sharding_rule is not None:
+        kwargs["sharding_rule"] = sharding_rule
+        if "need_replication_factors" in params:
+            kwargs["need_replication_factors"] = need_replication_factors
+    fn.def_partition(partition,
+                     infer_sharding_from_operands=infer_sharding_from_operands,
+                     **kwargs)

@@ -67,6 +67,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from raft_stereo_tpu.ops.jax_compat import compiler_params
+
 from raft_stereo_tpu.ops.pallas_stream import (
     _conv_rows, _interpret, _row_mask, _zeros, _shift)
 
@@ -270,7 +272,7 @@ def _run_stem(halves, w, bias, hh, wp_total, dtype, stats: bool):
                    jax.ShapeDtypeStruct((2, 128), jnp.float32)),
         scratch_shapes=[pltpu.VMEM((2, 128), jnp.float32),
                         pltpu.VMEM((taps, th, wp_total), dtype)],
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_ENC_VMEM),
+        compiler_params=compiler_params(vmem_limit_bytes=_ENC_VMEM),
         interpret=_interpret(),
     )(even, odd, w, bias)
     return outs if stats else (outs[0], None)
@@ -298,7 +300,9 @@ def _pass_kernel(*refs, kind: str, th: int, nb: int, nwb: int, wp: int,
         k += n
         return r
 
-    if kind == "mid1":
+    if kind == "raw1":
+        (x_ref,), (w_ref, b_ref) = take(1), take(2)
+    elif kind == "mid1":
         (x_ref, m_ref, v_ref), (w_ref, b_ref) = take(3), take(2)
     else:  # mid2
         (a_ref, ma_ref, va_ref, b2_ref, mb_ref, vb_ref) = take(6)
@@ -329,7 +333,11 @@ def _pass_kernel(*refs, kind: str, th: int, nb: int, nwb: int, wp: int,
         # stats == instance norm; without it the m/v are identity by
         # construction (frozen BN folded into the conv weights), so the
         # transform collapses to a relu in the storage dtype.
-        if kind == "mid1":
+        if kind == "raw1":
+            # Input is already an activation (a block input / exact
+            # tensor): no transform.
+            v = x_ref[...]
+        elif kind == "mid1":
             v = (_normed(x_ref[...], m_ref[...], v_ref[...]) if stats
                  else jax.nn.relu(x_ref[...]))
         elif stats:
@@ -395,54 +403,78 @@ def _point3_kernel(s_ref, ms_ref, vs_ref, y2_ref, m2_ref, v2_ref,
     out_ref[...] = o2  # packed; the caller unpacks via one XLA reshape
 
 
-def _run_pass(kind, inputs, w, bias, hh, wp_total, wb, dtype,
-              stats: bool, *, norm: bool = False):
-    """One streamed pass over packed (H?, W/2, 128) chain tensors.
+def _point2_kernel(x_ref, y_ref, m_ref, v_ref, out_ref, *, norm: bool):
+    """Residual-block exit: out = relu(x + relu(norm(y2))) — ``x`` is the
+    block input (already an activation, identity transform), ``y2`` the
+    raw conv2 output. Same norm-vs-stats contract as point3."""
+    if norm:
+        out = jax.nn.relu(x_ref[...].astype(jnp.float32)
+                          + _normed(y_ref[...], m_ref[...], v_ref[...]))
+    else:
+        out = jax.nn.relu(x_ref[...].astype(jnp.float32)
+                          + jax.nn.relu(y_ref[...].astype(jnp.float32)))
+    out_ref[...] = out.astype(out_ref.dtype)
 
-    inputs: list of (raw, mean128, inv128) triples whose raw arrays may
+
+def _run_pass(kind, inputs, w, bias, hh, wp_total, wp, dtype,
+              stats: bool, *, norm: bool = False):
+    """One streamed pass over (H?, wp_total, C) chain tensors — the
+    parity-packed trunk layout (wp_total = W/2, C = 128) or the plain
+    unpacked layout of the deeper stages (wp_total = W, C = 96/128).
+
+    inputs: list of (raw, mean, inv) triples whose raw arrays may
     carry trailing trash rows (the upstream pass's lag block) — index
     maps only ever touch the first ``hh`` rows; mid outputs carry one
-    trash row-block themselves (only point3 exits exact).
+    trash row-block themselves (only the point kinds exit exact). m/v
+    are None for identity inputs (the raw1 conv and the point2 x side).
+    ``wp`` is the strip width in STORED columns.
 
     ``stats`` = accumulate/emit per-channel stats (conv kinds only);
-    ``norm`` = apply the computed instance norms in the point3 combine.
+    ``norm`` = apply the computed instance norms in the point combines.
     They are SEPARATE flags on purpose: conflating them silently skipped
     normalization on the instance trunk (the r4 point3 regression)."""
-    wp = wb // 2
     th = _enc_th(hh, wp)
     nb, nwb = hh // th, wp_total // wp
+    point = kind in ("point2", "point3")
+    ch_out = inputs[0][0].shape[-1] if point else w.shape[-1]
 
-    if kind == "point3":
+    if point:
         in_specs, args = [], []
         for raw, m, v in inputs:
-            in_specs.append(pl.BlockSpec((th, wp, 128),
+            in_specs.append(pl.BlockSpec((th, wp, raw.shape[-1]),
                                          lambda i, s: (i, s, 0),
                                          memory_space=pltpu.VMEM))
             args.append(raw)
             for t in (m, v):
+                if t is None:
+                    continue
                 in_specs.append(pl.BlockSpec(t.shape, lambda i, s: (0, 0),
                                              memory_space=pltpu.VMEM))
                 args.append(t)
+        pk = _point3_kernel if kind == "point3" else _point2_kernel
         return pl.pallas_call(
-            functools.partial(_point3_kernel, norm=norm),
+            functools.partial(pk, norm=norm),
             grid=(nb, nwb),
             in_specs=in_specs,
-            out_specs=pl.BlockSpec((th, wp, 128), lambda i, s: (i, s, 0),
+            out_specs=pl.BlockSpec((th, wp, ch_out), lambda i, s: (i, s, 0),
                                    memory_space=pltpu.VMEM),
-            out_shape=jax.ShapeDtypeStruct((hh, wp_total, 128), dtype),
-            compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_ENC_VMEM),
+            out_shape=jax.ShapeDtypeStruct((hh, wp_total, ch_out), dtype),
+            compiler_params=compiler_params(vmem_limit_bytes=_ENC_VMEM),
             interpret=_interpret(),
         )(*args)
 
     def idx_in(i, s):
         return (jnp.minimum(i, nb - 1), jnp.minimum(s, nwb - 1), 0)
 
+    ch_in = inputs[0][0].shape[-1]
     in_specs, args = [], []
     for raw, m, v in inputs:
-        in_specs.append(pl.BlockSpec((th, wp, 128), idx_in,
+        in_specs.append(pl.BlockSpec((th, wp, raw.shape[-1]), idx_in,
                                      memory_space=pltpu.VMEM))
         args.append(raw)
         for t in (m, v):
+            if t is None:
+                continue
             in_specs.append(pl.BlockSpec(t.shape, lambda i, s: (0, 0),
                                          memory_space=pltpu.VMEM))
             args.append(t)
@@ -458,19 +490,20 @@ def _run_pass(kind, inputs, w, bias, hh, wp_total, wb, dtype,
     # Conv of strip s-1 emits block (i-1, s-1); the i=0 and s=0 visits
     # park in the trash row-block nb, so no real block is revisited.
     out_specs = [pl.BlockSpec(
-        (th, wp, 128),
+        (th, wp, ch_out),
         lambda i, s: (jnp.where((i == 0) | (s == 0), nb, i - 1),
                       jnp.where(s == 0, 0, s - 1), 0),
         memory_space=pltpu.VMEM)]
-    out_shape = [jax.ShapeDtypeStruct(((nb + 1) * th, wp_total, 128), dtype)]
+    out_shape = [jax.ShapeDtypeStruct(((nb + 1) * th, wp_total, ch_out),
+                                      dtype)]
     if stats:
-        out_specs.append(pl.BlockSpec((2, 128), lambda i, s: (0, 0),
+        out_specs.append(pl.BlockSpec((2, ch_out), lambda i, s: (0, 0),
                                       memory_space=pltpu.VMEM))
-        out_shape.append(jax.ShapeDtypeStruct((2, 128), jnp.float32))
-    scratch = [pltpu.VMEM((th + 2, wp_total + 16, 128), dtype),
-               pltpu.VMEM((nwb, th, wp, 128), dtype)]
+        out_shape.append(jax.ShapeDtypeStruct((2, ch_out), jnp.float32))
+    scratch = [pltpu.VMEM((th + 2, wp_total + 16, ch_in), dtype),
+               pltpu.VMEM((nwb, th, wp, ch_out), dtype)]
     if stats:
-        scratch.append(pltpu.VMEM((2, 128), jnp.float32))
+        scratch.append(pltpu.VMEM((2, ch_out), jnp.float32))
     outs = pl.pallas_call(
         kernel,
         grid=(nb + 1, nwb + 1),
@@ -478,7 +511,7 @@ def _run_pass(kind, inputs, w, bias, hh, wp_total, wb, dtype,
         out_specs=tuple(out_specs) if stats else out_specs[0],
         out_shape=tuple(out_shape) if stats else out_shape[0],
         scratch_shapes=scratch,
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_ENC_VMEM),
+        compiler_params=compiler_params(vmem_limit_bytes=_ENC_VMEM),
         interpret=_interpret(),
     )(*args)
     if not stats:
@@ -524,19 +557,19 @@ def _trunk_passes(halves, convs, hh, width, dtype, instance: bool):
                          hh, wp_total, dtype, instance)
     m1, v1 = mv(st)
     y1, st = _run_pass("mid1", [(stem, m1, v1)], *wpk[0],
-                       hh, wp_total, wb, dtype, instance)
+                       hh, wp_total, wb // 2, dtype, instance)
     my, vy = mv(st)
     y2, st = _run_pass("mid1", [(y1, my, vy)], *wpk[1],
-                       hh, wp_total, wb, dtype, instance)
+                       hh, wp_total, wb // 2, dtype, instance)
     m2, v2 = mv(st)
     y3, st = _run_pass("mid2", [(stem, m1, v1), (y2, m2, v2)], *wpk[2],
-                       hh, wp_total, wb, dtype, instance)
+                       hh, wp_total, wb // 2, dtype, instance)
     m3, v3 = mv(st)
     y4, st = _run_pass("mid1", [(y3, m3, v3)], *wpk[3],
-                       hh, wp_total, wb, dtype, instance)
+                       hh, wp_total, wb // 2, dtype, instance)
     m4, v4 = mv(st)
     o2 = _run_pass("point3", [(stem, m1, v1), (y2, m2, v2), (y4, m4, v4)],
-                   None, None, hh, wp_total, wb, dtype, False,
+                   None, None, hh, wp_total, wb // 2, dtype, False,
                    norm=instance)
     return o2  # packed (H, W/2, 128); _unpack_exit restores (1, H, W, 64)
 
@@ -709,6 +742,146 @@ def _in_bwd(res, g):
 
 
 fused_in_stem_layer1.defvjp(_in_fwd, _in_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Streamed tail: the stride-1 residual blocks of layer2/layer3 and the
+# finest output heads, in the PLAIN (H', W', C) layout (C = 96/128 — at
+# these channel counts the unpacked layout already fills vregs; packing
+# buys nothing). One streamed pass per conv (raw1 -> mid1 -> point2), so
+# the XLA tail's separate norm/relu/add materializations — ~2 extra HBM
+# round trips per tensor per block at Middlebury-F's 1/2-res 288 MB
+# activations — never happen. Stride-2 entry blocks stay XLA: their
+# stride-2 reads don't fit the ring geometry, and at half the output
+# resolution XLA runs them acceptably (the packed layer2 entry already
+# consumes the trunk exit in place).
+# ---------------------------------------------------------------------------
+
+
+def _strip_cols(width: int) -> int:
+    """Width-strip size in STORED columns for unpacked tail passes
+    (0 = unsupported): <=384 columns per grid step keeps Mosaic code
+    size in the packed trunk's compile-time regime (its 768 true columns
+    = 384 stored); %8 keeps strip placement sublane-aligned."""
+    for nwb in range(1, 13):
+        wp = width // nwb
+        if width % nwb == 0 and wp <= 384 and (wp % 8 == 0 or nwb == 1):
+            return wp
+    return 0
+
+
+def _tail_enabled() -> bool:
+    return _os.environ.get("RAFT_STREAM_TAIL", "1").strip().lower() not in (
+        "0", "false", "no", "off")
+
+
+def _bias_row(b, ch: int):
+    return (jnp.zeros((1, ch), jnp.float32) if b is None
+            else jnp.asarray(b, jnp.float32).reshape(1, -1))
+
+
+def resblock_streamable(p: dict, x, norm_fn: str) -> bool:
+    """Stride-1 identity-shortcut block over a (1, H, W, C) activation."""
+    from raft_stereo_tpu.ops.pallas_stream import _dtype_ok
+    if not (ENABLE and _tail_enabled() and norm_fn in ("batch", "instance")):
+        return False
+    if "downsample" in p or x.ndim != 4 or x.shape[0] != 1 or x.shape[1] < 8:
+        return False
+    ch = x.shape[-1]
+    wp = _strip_cols(x.shape[2])
+    return (_dtype_ok(x) and wp > 0 and _enc_th(x.shape[1], wp) > 0
+            and p["conv1"]["w"].shape[2:] == (ch, ch))
+
+
+def head_conv_streamable(pc: dict, x) -> bool:
+    """3x3 pad-1 head conv over a (1, H, W, C) activation."""
+    from raft_stereo_tpu.ops.pallas_stream import _dtype_ok
+    if not (ENABLE and _tail_enabled()):
+        return False
+    if x.ndim != 4 or x.shape[0] != 1 or x.shape[1] < 8:
+        return False
+    wp = _strip_cols(x.shape[2])
+    return (_dtype_ok(x) and wp > 0 and _enc_th(x.shape[1], wp) > 0
+            and pc["w"].shape[:2] == (3, 3) and pc["w"].shape[2] == x.shape[-1])
+
+
+def _stream_resblock_impl(p: dict, x: jax.Array, norm_fn: str) -> jax.Array:
+    _, hh, width, ch = x.shape
+    dtype = x.dtype
+    instance = norm_fn == "instance"
+    if instance:
+        w1, b1 = p["conv1"]["w"], p["conv1"].get("b")
+        w2, b2 = p["conv2"]["w"], p["conv2"].get("b")
+    else:
+        w1, b1 = _fold_bn(p["conv1"], p["norm1"])
+        w2, b2 = _fold_bn(p["conv2"], p["norm2"])
+    wp = _strip_cols(width)
+    n = hh * width
+    x3 = x[0]
+
+    def mv(st):
+        return _stats_to_mv(st, n) if instance else _ident_mv(ch)
+
+    y1, st = _run_pass("raw1", [(x3, None, None)], w1.astype(dtype),
+                       _bias_row(b1, ch), hh, width, wp, dtype, instance)
+    m1, v1 = mv(st)
+    y2, st = _run_pass("mid1", [(y1, m1, v1)], w2.astype(dtype),
+                       _bias_row(b2, ch), hh, width, wp, dtype, instance)
+    m2, v2 = mv(st)
+    out = _run_pass("point2", [(x3, None, None), (y2, m2, v2)],
+                    None, None, hh, width, wp, dtype, False, norm=instance)
+    return out[None]
+
+
+def _stream_head_conv_impl(pc: dict, x: jax.Array) -> jax.Array:
+    _, hh, width, ch = x.shape
+    wp = _strip_cols(width)
+    y, _ = _run_pass("raw1", [(x[0], None, None)], pc["w"].astype(x.dtype),
+                     _bias_row(pc.get("b"), pc["w"].shape[-1]),
+                     hh, width, wp, x.dtype, False)
+    return y[:hh][None]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def stream_resblock(norm_fn: str, p: dict, x):
+    """Streamed stride-1 residual block (identity shortcut); backward via
+    the XLA oracle (``apply_residual_block``)."""
+    return _stream_resblock_impl(p, x, norm_fn)
+
+
+def _rb_fwd(norm_fn, p, x):
+    return stream_resblock(norm_fn, p, x), (p, x)
+
+
+def _rb_bwd(norm_fn, res, g):
+    from raft_stereo_tpu.models.layers import apply_residual_block
+    p, x = res
+    out, vjp = jax.vjp(
+        lambda p_, x_: apply_residual_block(p_, x_, norm_fn, stride=1), p, x)
+    return vjp(g.astype(out.dtype))
+
+
+stream_resblock.defvjp(_rb_fwd, _rb_bwd)
+
+
+@jax.custom_vjp
+def stream_head_conv(pc: dict, x):
+    """Streamed 3x3 pad-1 output-head conv; backward via the XLA oracle."""
+    return _stream_head_conv_impl(pc, x)
+
+
+def _hc_fwd(pc, x):
+    return stream_head_conv(pc, x), (pc, x)
+
+
+def _hc_bwd(res, g):
+    from raft_stereo_tpu.models.layers import apply_conv
+    pc, x = res
+    out, vjp = jax.vjp(lambda p_, x_: apply_conv(p_, x_, padding=1), pc, x)
+    return vjp(g.astype(out.dtype))
+
+
+stream_head_conv.defvjp(_hc_fwd, _hc_bwd)
 
 
 def _packed_cotangent(g: jax.Array) -> jax.Array:

@@ -50,6 +50,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from raft_stereo_tpu.ops.jax_compat import compiler_params
+
 _VMEM_LIMIT = 100 * 2**20  # v5e has 128M physical; default scoped cap is 16M
 
 
@@ -286,7 +288,7 @@ def _gru_pallas(h, parts, czrq, whzr, whq, wx_full, th: int, head):
                 jax.ShapeDtypeStruct((arrs[0].shape[0],) + out_shape[0]
                                      .shape[1:], out_shape[0].dtype)),
             scratch_shapes=scratch,
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=compiler_params(
                 vmem_limit_bytes=_VMEM_LIMIT),
             interpret=_interpret(),
         )(*arrs)
@@ -459,6 +461,369 @@ def gru_is_fusable(h, *x_list, any_batch: bool = False) -> bool:
     the save-kernel-outputs remat policy), so it fuses at any batch."""
     return (_dtype_ok(h) and (any_batch or _batch_worthwhile(h))
             and pick_th(h.shape[1], h.shape[2]) > 0 and h.shape[1] >= 8)
+
+
+# ---------------------------------------------------------------------------
+# Fused gru16+gru32: the two coarse-scale ConvGRUs co-scheduled in ONE
+# streaming kernel. Their small spatial extents (1/8- and 1/16-res) leave
+# the chip latency-bound when the scan body dispatches them serially
+# (r5 profile: 126 ms/frame vs a ~50 ms MXU bound): each kernel pays its
+# own pipeline ramp, and the cross-scale upsample between them is a
+# separate XLA dispatch whose output round-trips HBM every iteration.
+# Here one grid step advances the gru32 stream by TH/2 rows, appends its
+# fresh hidden rows to a VMEM window, and runs the gru16 stream ONE ROW
+# BLOCK behind, building its aligned-corners upsampled x-input from the
+# window in-register: H-interp as a 3-slot row lerp (each output row
+# reads window rows c-1, c, c+1 with per-row weights riding as
+# constants — the drift of floor(j*(H32-1)/(H16-1)) around j/2 never
+# exceeds one row), W-interp as per-row banded-matrix MXU dots (the same
+# matrices ops/resize.py builds, so the arithmetic — exact bf16
+# products, fp32 accumulation, bf16 round between the H and W passes —
+# is BIT-IDENTICAL to the serial kernels + XLA interp it replaces).
+# The upsampled tensor never touches HBM, and both GRUs' DMA and MXU
+# work share one pipeline.
+# ---------------------------------------------------------------------------
+
+
+def gru1632_th(h16: int, w16: int) -> int:
+    """Row block for the fused gru16+gru32 stream (0 = unsupported):
+    gru16's block must be even (gru32 advances TH/2 rows per step) and
+    at least 8 (the availability bound needs TH/2 >= 4)."""
+    th = pick_th(h16, w16)
+    return th if th >= 8 and th % 2 == 0 and h16 % th == 0 else 0
+
+
+def _upsample_weights(h32: int, h16: int, th16: int, dtype=jnp.bfloat16):
+    """Per-block 3-slot H-interp weights (nb16, 6, th32, 1, 1) in the
+    compute dtype.
+
+    Output row j of the aligned-corners upsample lerps source rows
+    lo(j) = floor(j*(H32-1)/(H16-1)) and min(lo+1, H32-1). Relative to
+    the window slot center c = j//2 both taps live in {c-1, c, c+1};
+    weight slots are (even rows: 0..2, odd rows: 3..5) x (c-1, c, c+1).
+    Built in fp32 and rounded to bf16 exactly like ops/resize.py's
+    banded matrix (slot sums in fp32, ONE bf16 round per entry), so the
+    kernel's lerp reproduces the XLA einsum bit-for-bit. Returns None
+    when any tap falls outside {c-1, c, c+1} (never for H16 == 2*H32)."""
+    import numpy as np
+    th32 = th16 // 2
+    nb16 = h16 // th16
+    scale = (h32 - 1) / (h16 - 1) if h16 > 1 else 0.0
+    wh = np.zeros((nb16, 6, th32, 1, 1), np.float32)
+    for blk in range(nb16):
+        for r in range(th16):
+            j = blk * th16 + r
+            src = j * scale
+            lo = min(int(np.floor(src)), h32 - 1)
+            hi = min(lo + 1, h32 - 1)
+            wt = np.float32(src - lo)
+            c = j // 2
+            base = 3 * (r % 2)
+            k = r // 2
+            for tap, twt in ((lo, np.float32(1.0) - wt), (hi, wt)):
+                slot = tap - (c - 1)
+                if not 0 <= slot <= 2:
+                    return None
+                wh[blk, base + slot, k, 0, 0] += twt
+    # One round per entry from the fp32 slot sum — exactly how
+    # ops/resize.py builds its banded matrix (fp32 accumulate, then
+    # astype), so the kernel lerp matches the XLA einsum bit-for-bit.
+    return jnp.asarray(wh).astype(dtype)
+
+
+def _gru1632_kernel(h16_ref, h32_ref, czrq16_ref, czrq32_ref, x0_ref, x1_ref,
+                    whzr16_ref, whq16_ref, wx16_ref,
+                    whzr32_ref, whq32_ref, wx32_ref,
+                    mw_ref, wh_ref, out16_ref, out32_ref,
+                    s32_h, s32_rh, s32_z, s32_aqx, s32_x, s_up,
+                    s16_h, s16_rh, s16_z, s16_aqx, s16_x, *,
+                    th16: int, nb16: int, w16: int, w32: int,
+                    c16: int, c32: int, cx0: int):
+    th32 = th16 // 2
+    win = s_up.shape[0]
+    i = pl.program_id(1)  # row step; program_id(0) is the batch sample
+    dtype = h16_ref.dtype
+
+    @pl.when(i == 0)
+    def _init():
+        for s in (s32_h, s32_rh, s32_z, s32_aqx, s32_x, s_up,
+                  s16_h, s16_rh, s16_z, s16_aqx, s16_x):
+            _zeros(s)
+
+    # ---- gru32 stream: block i (same structure as _gru_kernel at TH/2).
+    _shift(s32_h, 3)
+    _shift(s32_x, 2)
+
+    @pl.when(i < nb16)
+    def _place32():
+        s32_h[3:3 + th32, 1:w32 + 1] = h32_ref[0]
+        s32_x[2:2 + th32, 1:w32 + 1] = x1_ref[0]
+
+    @pl.when(i >= nb16)
+    def _flush32():
+        _zeros(s32_h, slice(3, 3 + th32))
+        _zeros(s32_x, slice(2, 2 + th32))
+
+    acc_x = _conv_rows(s32_x, wx32_ref, th32, w32)
+    acc_x = acc_x + czrq32_ref[0].astype(jnp.float32)
+    acc_h = _conv_rows(s32_h[1:], whzr32_ref, th32, w32)
+    z_new = jax.nn.sigmoid(acc_h[..., :c32] + acc_x[..., :c32]).astype(dtype)
+    r_new = jax.nn.sigmoid(acc_h[..., c32:]
+                           + acc_x[..., c32:2 * c32]).astype(dtype)
+    rh_new = r_new * s32_h[2:2 + th32, 1:w32 + 1]
+    _shift(s32_rh, 3)
+    s32_rh[3:3 + th32, 1:w32 + 1] = rh_new
+    _shift(s32_z, 2)
+    s32_z[2:2 + th32] = z_new
+    _shift(s32_aqx, 2)
+    s32_aqx[2:2 + th32] = acc_x[..., 2 * c32:]
+    acc_q = _conv_rows(s32_rh, whq32_ref, th32, w32, None) + s32_aqx[0:th32]
+    q32 = jnp.tanh(acc_q).astype(dtype)
+    z32 = s32_z[0:th32]
+    h32_new = (1 - z32) * s32_h[0:th32, 1:w32 + 1] + z32 * q32
+    out32_ref[0] = h32_new
+    # Append the fresh h32' rows to the upsample window: after this the
+    # window holds global rows [(i+1)*TH/2 - 3 - win, (i+1)*TH/2 - 3).
+    _shift(s_up, win - th32)
+    s_up[win - th32:win] = h32_new
+
+    # ---- gru16 stream: block i-1 (one block behind, so every upsample
+    # source row is already in the window). Fully gated on i >= 1 — its
+    # ring writes at i == 0 would inject czrq-biased junk the real
+    # stream would then consume.
+    @pl.when(i >= 1)
+    def _gru16_phase():
+        i16 = i - 1
+        _shift(s16_h, 3)
+        _shift(s16_x, 2)
+
+        @pl.when(i16 < nb16)
+        def _place16():
+            s16_h[3:3 + th16, 1:w16 + 1] = h16_ref[0]
+            s16_x[2:2 + th16, 1:w16 + 1, 0:cx0] = x0_ref[0]
+            # Upsampled x part, computed in-register from the window.
+            # Window index of slot center c = j//2 for j in block i16:
+            # c - (b - win) with b = (i16+2)*TH/2 - 3 -> r//2 + win + 3
+            # - TH, independent of the step. H-lerp (3 static slices x
+            # per-row weights, fp32, ONE bf16 round — the XLA H-einsum's
+            # bf16 intermediate), then the banded W matrix per row.
+            o = win + 3 - th16
+            sm = s_up[o - 1:o - 1 + th32].astype(jnp.float32)
+            s0 = s_up[o:o + th32].astype(jnp.float32)
+            sp = s_up[o + 1:o + 1 + th32].astype(jnp.float32)
+            whw = wh_ref[0].astype(jnp.float32)  # (6, th32, 1, 1)
+            even = whw[0] * sm + whw[1] * s0 + whw[2] * sp
+            odd = whw[3] * sm + whw[4] * s0 + whw[5] * sp
+            xh = jnp.stack([even, odd], axis=1).reshape(
+                th16, w32, c32).astype(dtype)
+            rows = [jax.lax.dot_general(
+                mw_ref[...], xh[r], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32) for r in range(th16)]
+            up = jnp.stack(rows).astype(dtype)  # (th16, w16, c32)
+            s16_x[2:2 + th16, 1:w16 + 1, cx0:cx0 + c32] = up
+
+        @pl.when(i16 >= nb16)
+        def _flush16():
+            _zeros(s16_h, slice(3, 3 + th16))
+            _zeros(s16_x, slice(2, 2 + th16))
+
+        acc_x16 = _conv_rows(s16_x, wx16_ref, th16, w16)
+        acc_x16 = acc_x16 + czrq16_ref[0].astype(jnp.float32)
+        acc_h16 = _conv_rows(s16_h[1:], whzr16_ref, th16, w16)
+        z16n = jax.nn.sigmoid(acc_h16[..., :c16]
+                              + acc_x16[..., :c16]).astype(dtype)
+        r16n = jax.nn.sigmoid(acc_h16[..., c16:]
+                              + acc_x16[..., c16:2 * c16]).astype(dtype)
+        rh16n = r16n * s16_h[2:2 + th16, 1:w16 + 1]
+        _shift(s16_rh, 3)
+        s16_rh[3:3 + th16, 1:w16 + 1] = rh16n
+        _shift(s16_z, 2)
+        s16_z[2:2 + th16] = z16n
+        _shift(s16_aqx, 2)
+        s16_aqx[2:2 + th16] = acc_x16[..., 2 * c16:]
+        acc_q16 = (_conv_rows(s16_rh, whq16_ref, th16, w16, None)
+                   + s16_aqx[0:th16])
+        q16 = jnp.tanh(acc_q16).astype(dtype)
+        z16 = s16_z[0:th16]
+        out16_ref[0] = ((1 - z16) * s16_h[0:th16, 1:w16 + 1] + z16 * q16)
+
+
+def gru1632_is_fusable(h16, h32, *, any_batch: bool = False) -> bool:
+    """Fused co-schedule engages when both coarse GRUs are individually
+    fusable, the scales nest exactly 2x (the padder's /32 rule guarantees
+    it for real inputs), and a supported even row block exists. The x
+    inputs need no separate guard: pool2x of the checked net states has
+    their exact geometry by construction.
+    ``RAFT_FUSE_GRU1632=0`` forces the serial two-kernel path (A/B)."""
+    import os
+    if os.environ.get("RAFT_FUSE_GRU1632", "1").strip().lower() in (
+            "0", "false", "no", "off"):
+        return False
+    b16, hh16, ww16, c16 = h16.shape
+    b32, hh32, ww32, c32 = h32.shape
+    # Equal hidden dims required: the kernel sizes gru32's x input
+    # (pool2x of the gru16 state) and scratch at c32 — unequal per-level
+    # hidden_dims fall back to the serial kernels, which handle them.
+    return (_dtype_ok(h16) and _dtype_ok(h32) and b16 == b32 and c16 == c32
+            and (any_batch or _batch_worthwhile(h16))
+            and hh16 == 2 * hh32 and ww16 == 2 * ww32
+            and hh32 >= 8 and gru1632_th(hh16, ww16) > 0
+            and _upsample_weights(hh32, hh16, gru1632_th(hh16, ww16))
+            is not None)
+
+
+def fused_gru1632_fwd_impl(p16: dict, p32: dict, h16, h32, czrq16, czrq32,
+                           x0p, x1p):
+    """Kernel forward: (h16', h32') with x inputs pool2x(net0) / pool2x(
+    net1) supplied by the caller (cheap XLA pools; keeping them outside
+    preserves bit-identity with the serial path) and the cross-scale
+    upsample computed in-kernel."""
+    from raft_stereo_tpu.ops.resize import _lerp_matrix
+    b, hh16, w16, c16 = h16.shape
+    _, hh32, w32, c32 = h32.shape
+    cx0 = x0p.shape[-1]
+    dtype = h16.dtype
+    th16 = gru1632_th(hh16, w16)
+    th32 = th16 // 2
+    nb16 = hh16 // th16
+    grid = nb16 + 2
+    win = th16 + 4
+
+    whzr16, whq16, wx16 = (w.astype(dtype) for w in gru_weights(p16, c16))
+    whzr32, whq32, wx32 = (w.astype(dtype) for w in gru_weights(p32, c32))
+    mw = _lerp_matrix(w32, w16, dtype)  # (w16, w32), the XLA W matrix
+    wh = _upsample_weights(hh32, hh16, th16, dtype)
+
+    # czrq rows must cover every block index the schedule touches
+    # (prepare_gru_context padded for the SERIAL kernels' geometry, whose
+    # row block may differ); re-pad here is loop-invariant — XLA hoists
+    # it out of the scan.
+    def pad_rows(czrq, rows):
+        return (jnp.pad(czrq, ((0, 0), (0, rows - czrq.shape[1]),
+                               (0, 0), (0, 0)))
+                if czrq.shape[1] < rows else czrq)
+
+    czrq16 = pad_rows(czrq16, (nb16 + 1) * th16)
+    czrq32 = pad_rows(czrq32, grid * th32)
+
+    def i16c(i):
+        return jnp.clip(i - 1, 0, nb16 - 1)
+
+    in_specs = [
+        pl.BlockSpec((1, th16, w16, c16),
+                     lambda bi, i: (bi, i16c(i), 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, th32, w32, c32),
+                     lambda bi, i: (bi, jnp.minimum(i, nb16 - 1), 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, th16, w16, 3 * c16),
+                     lambda bi, i: (bi, jnp.clip(i - 1, 0, nb16), 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, th32, w32, 3 * c32),
+                     lambda bi, i: (bi, jnp.minimum(i, grid - 1), 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, th16, w16, cx0),
+                     lambda bi, i: (bi, i16c(i), 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, th32, w32, c32),
+                     lambda bi, i: (bi, jnp.minimum(i, nb16 - 1), 0, 0),
+                     memory_space=pltpu.VMEM),
+    ] + [pl.BlockSpec(w.shape, lambda bi, i, nd=w.ndim: (0,) * nd,
+                      memory_space=pltpu.VMEM)
+         for w in (whzr16, whq16, wx16, whzr32, whq32, wx32, mw)] + [
+        pl.BlockSpec((1,) + wh.shape[1:],
+                     lambda bi, i: (i16c(i), 0, 0, 0, 0),
+                     memory_space=pltpu.VMEM)]
+    out_specs = (
+        pl.BlockSpec((1, th16, w16, c16),
+                     lambda bi, i: (bi, jnp.where(i == 0, nb16 + 1, i - 1),
+                                    0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, th32, w32, c32),
+                     lambda bi, i: (bi, jnp.minimum(i, nb16 + 1), 0, 0),
+                     memory_space=pltpu.VMEM))
+    out_shape = (
+        jax.ShapeDtypeStruct((b, (nb16 + 2) * th16, w16, c16), dtype),
+        jax.ShapeDtypeStruct((b, (nb16 + 2) * th32, w32, c32), dtype))
+    scratch = [
+        pltpu.VMEM((th32 + 3, w32 + 2, c32), dtype),      # gru32 h window
+        pltpu.VMEM((th32 + 3, w32 + 2, c32), dtype),      # gru32 r*h
+        pltpu.VMEM((th32 + 2, w32, c32), dtype),          # gru32 z ring
+        pltpu.VMEM((th32 + 2, w32, c32), jnp.float32),    # gru32 aq_x
+        pltpu.VMEM((th32 + 2, w32 + 2, c32), dtype),      # gru32 x
+        pltpu.VMEM((win, w32, c32), dtype),               # h32' up window
+        pltpu.VMEM((th16 + 3, w16 + 2, c16), dtype),      # gru16 h window
+        pltpu.VMEM((th16 + 3, w16 + 2, c16), dtype),      # gru16 r*h
+        pltpu.VMEM((th16 + 2, w16, c16), dtype),          # gru16 z ring
+        pltpu.VMEM((th16 + 2, w16, c16), jnp.float32),    # gru16 aq_x
+        pltpu.VMEM((th16 + 2, w16 + 2, cx0 + c32), dtype)]  # gru16 x
+    kernel = functools.partial(
+        _gru1632_kernel, th16=th16, nb16=nb16, w16=w16, w32=w32,
+        c16=c16, c32=c32, cx0=cx0)
+    inputs = [h16, h32, czrq16, czrq32, x0p, x1p,
+              whzr16, whq16, wx16, whzr32, whq32, wx32, mw, wh]
+
+    def call(*arrs):
+        return pl.pallas_call(
+            kernel,
+            grid=(arrs[0].shape[0], grid),
+            in_specs=in_specs,
+            out_specs=out_specs,
+            out_shape=tuple(
+                jax.ShapeDtypeStruct((arrs[0].shape[0],) + o.shape[1:],
+                                     o.dtype) for o in out_shape),
+            scratch_shapes=scratch,
+            compiler_params=compiler_params(vmem_limit_bytes=_VMEM_LIMIT),
+            interpret=_interpret(),
+        )(*arrs)
+
+    from raft_stereo_tpu.corr.pallas_reg import make_batch_partitioned
+    call_p = make_batch_partitioned(
+        call, [0] * 6 + [None] * 8, [a.ndim for a in inputs],
+        [0, 0], [4, 4])
+    o16, o32 = call_p(*inputs)
+    return o16[:, 3:3 + hh16], o32[:, 3:3 + hh32]
+
+
+def _gru1632_oracle(p16, p32, h16, h32, ctx16, ctx32, x0p, x1p):
+    from raft_stereo_tpu.models.update import apply_conv_gru
+    from raft_stereo_tpu.ops.resize import interp_align_corners
+    h32n = apply_conv_gru(p32, h32, ctx32, x1p)
+    up = interp_align_corners(h32n, h16.shape[1:3])
+    h16n = apply_conv_gru(p16, h16, ctx16, x0p, up)
+    return h16n, h32n
+
+
+@jax.custom_vjp
+def fused_gru1632(p16: dict, p32: dict, h16, h32, czrq16, czrq32,
+                  ctx16, ctx32, x0p, x1p):
+    """gru32 step + aligned-corners upsample + gru16 step in ONE streaming
+    kernel. ``ctx16``/``ctx32`` ride along unused in the forward so the
+    VJP can rebuild the XLA composition (czrq is derived from them, zero
+    cotangent — same contract as ``fused_conv_gru``)."""
+    return fused_gru1632_fwd_impl(p16, p32, h16, h32, czrq16, czrq32,
+                                  x0p, x1p)
+
+
+def _fused_gru1632_fwd(p16, p32, h16, h32, czrq16, czrq32, ctx16, ctx32,
+                       x0p, x1p):
+    return (fused_gru1632(p16, p32, h16, h32, czrq16, czrq32, ctx16, ctx32,
+                          x0p, x1p),
+            (p16, p32, h16, h32, czrq16, czrq32, ctx16, ctx32, x0p, x1p))
+
+
+def _fused_gru1632_bwd(res, g):
+    p16, p32, h16, h32, czrq16, czrq32, ctx16, ctx32, x0p, x1p = res
+    (h16n, h32n), vjp = jax.vjp(_gru1632_oracle, p16, p32, h16, h32,
+                                ctx16, ctx32, x0p, x1p)
+    g16, g32 = g
+    dp16, dp32, dh16, dh32, dctx16, dctx32, dx0, dx1 = vjp(
+        (g16.astype(h16n.dtype), g32.astype(h32n.dtype)))
+    return (dp16, dp32, dh16, dh32, jnp.zeros_like(czrq16),
+            jnp.zeros_like(czrq32), dctx16, dctx32, dx0, dx1)
+
+
+fused_gru1632.defvjp(_fused_gru1632_fwd, _fused_gru1632_bwd)
 
 
 # ---------------------------------------------------------------------------
@@ -810,7 +1175,7 @@ def fused_motion_fwd_impl(p: dict, flow, corr):
                 pltpu.VMEM((th + 2, width + 2, ns1), dtype),
                 pltpu.VMEM((th + 2, width + 2, ns1), dtype),
                 pltpu.VMEM((th + 2, width, flow.shape[-1]), dtype)],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=compiler_params(
                 vmem_limit_bytes=_VMEM_LIMIT),
             interpret=_interpret(),
         )(*arrs)

@@ -1,0 +1,64 @@
+#!/usr/bin/env bash
+# Mechanical release gate (VERDICT r5 item 5) — ONE command folding:
+#   1. the tier-1 suite (scripts/run_tier1.sh — includes the kernel
+#      oracle parity batteries in interpret mode),
+#   2. a bench wiring smoke on CPU (no pin writes),
+#   3. on a TPU host: the pinned-checksum bench gate for all three
+#      recorded configs (headline reg_tpu, alt_tpu, realtime) and the
+#      compiled-on-chip kernel battery.
+# Nonzero exit on any failure; run it in the final hour BEFORE committing
+# (the failure mode this prevents is exactly how r4 broke).
+set -uo pipefail
+cd "$(dirname "$0")/.."
+fail=0
+step() { echo; echo "== $* =="; }
+
+step "tier-1 suite"
+bash scripts/run_tier1.sh || { echo "FAIL: tier-1"; fail=1; }
+
+backend=$(python - <<'EOF'
+import jax
+print(jax.default_backend())
+EOF
+)
+echo "backend: $backend"
+
+if [ "$backend" != "tpu" ]; then
+    step "bench wiring smoke (CPU, tiny shape, no pin writes)"
+    RAFT_BENCH_AUTOPIN=0 RAFT_BENCH_H=64 RAFT_BENCH_W=96 \
+        RAFT_BENCH_ITERS=2 RAFT_BENCH_FRAMES=2 JAX_PLATFORMS=cpu \
+        python bench.py || { echo "FAIL: bench smoke"; fail=1; }
+    echo "SKIP: pinned-config bench gate + on-chip battery (no TPU here)"
+else
+    # RAFT_BENCH_AUTOPIN=1 here is the ONLY sanctioned first-pin path: a
+    # missing config/statistic gets recorded (loudly, never overwriting an
+    # existing value) as an explicit gate step — a bare `python bench.py`
+    # never mutates bench_checksum_ref.json. Check `git diff
+    # bench_checksum_ref.json` after a run that printed "PINNED".
+    step "bench checksum gate: headline (reg_tpu bf16)"
+    RAFT_BENCH_AUTOPIN=1 python bench.py \
+        || { echo "FAIL: headline bench gate"; fail=1; }
+
+    step "bench checksum gate: alt_tpu"
+    RAFT_BENCH_AUTOPIN=1 RAFT_BENCH_CORR=alt_tpu python bench.py \
+        || { echo "FAIL: alt_tpu bench gate"; fail=1; }
+
+    step "bench checksum gate: realtime config"
+    RAFT_BENCH_AUTOPIN=1 \
+        RAFT_BENCH_SHARED=1 RAFT_BENCH_DOWNSAMPLE=3 RAFT_BENCH_GRU_LAYERS=2 \
+        RAFT_BENCH_SLOW_FAST=1 RAFT_BENCH_ITERS=7 \
+        RAFT_BENCH_H=384 RAFT_BENCH_W=1248 python bench.py \
+        || { echo "FAIL: realtime bench gate"; fail=1; }
+
+    step "compiled-on-chip kernel battery"
+    bash scripts/run_onchip_battery.sh \
+        || { echo "FAIL: on-chip battery"; fail=1; }
+fi
+
+echo
+if [ "$fail" -ne 0 ]; then
+    echo "RELEASE GATE: FAIL"
+else
+    echo "RELEASE GATE: PASS ($backend)"
+fi
+exit $fail

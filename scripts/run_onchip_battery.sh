@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Compiled-on-TPU certification of the Pallas kernel oracle batteries
+# (VERDICT r5 item 2): the same parity tests the CPU tier runs in
+# interpret mode, executed through the real Mosaic/XLA:TPU stack — the
+# regression class interpret mode cannot see (r4's packed-stem bug).
+#
+# Usage: scripts/run_onchip_battery.sh [logfile]
+# Run on a host with a reachable TPU backend; commits its log under
+# scratch/ (e.g. scratch/onchip_pytest_r6.log) for the round record.
+set -o pipefail
+cd "$(dirname "$0")/.."
+log="${1:-scratch/onchip_pytest_$(date +%Y%m%d).log}"
+
+RAFT_TEST_ONCHIP=1 python -m pytest -m 'kernel_battery and not slow' -q \
+    -p no:cacheprovider tests/test_corr.py tests/test_fused_stream.py \
+    2>&1 | tee "$log"
+rc=${PIPESTATUS[0]}
+
+python - <<'EOF'
+import jax
+backend = jax.default_backend()
+print(f"battery backend: {backend}"
+      + ("" if backend == "tpu"
+         else "  (WARNING: not a TPU — kernels ran in interpret mode; "
+              "this log does NOT certify the compiled path)"))
+EOF
+exit $rc

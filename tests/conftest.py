@@ -1,22 +1,36 @@
 """Test harness configuration.
 
-Tests run on CPU with 8 virtual XLA devices so multi-chip sharding logic is
-exercised without TPU hardware (the TPU-world substitute for distributed
-tests). Environment must be set before jax is imported anywhere.
+Default: tests run on CPU with 8 virtual XLA devices so multi-chip
+sharding logic is exercised without TPU hardware (the TPU-world
+substitute for distributed tests), and every Pallas kernel runs in
+interpret mode. Environment must be set before jax is imported anywhere.
+
+``RAFT_TEST_ONCHIP=1`` keeps the real backend instead: the kernel oracle
+batteries then run COMPILED through the Mosaic/XLA:TPU stack — the
+one-command on-chip certification (``scripts/run_onchip_battery.sh``)
+that guards the compiled-path-only regression class (r4's packed-stem
+bug was invisible to interpret mode). Only the kernel_battery marker is
+meant to run on-chip; the mesh tests assume the 8-device CPU topology.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+_ONCHIP = os.environ.get("RAFT_TEST_ONCHIP", "").strip().lower() in (
+    "1", "true", "yes", "on")
+
+if not _ONCHIP:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax
 
-# The image's sitecustomize pins JAX_PLATFORMS=axon (the TPU tunnel); override
-# via config so tests always run on the 8-device virtual-CPU topology.
-jax.config.update("jax_platforms", "cpu")
+if not _ONCHIP:
+    # The image's sitecustomize pins JAX_PLATFORMS=axon (the TPU tunnel);
+    # override via config so tests run on the 8-device virtual-CPU topology.
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

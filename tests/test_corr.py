@@ -16,6 +16,10 @@ import jax
 import jax.numpy as jnp
 
 from raft_stereo_tpu.corr import make_corr_fn
+
+# Correlation oracle battery: compiled-on-TPU via RAFT_TEST_ONCHIP=1
+# (scripts/run_onchip_battery.sh), interpret-mode on CPU otherwise.
+pytestmark = pytest.mark.kernel_battery
 from raft_stereo_tpu.corr.reg import build_pyramid, build_volume, lookup_pyramid
 
 B, H, W, D = 2, 6, 32, 16
@@ -297,6 +301,112 @@ def test_reg_tpu_packed_multi_call_grad_linearity(rng):
         assert np.isfinite(gb).all()
         scale = np.abs(ga + gc).max() + 1e-6
         assert np.abs(gb - (ga + gc)).max() / scale < 0.05
+
+
+def test_pack_plan_combines_odd_block_levels():
+    """The packing rule: even-128-block widths pack standalone; the widest
+    and deepest ODD-block widths share one combined container (zero pad
+    bloat); any further odd-block level stays plain. Middlebury-F's
+    744-wide pyramid is the motivating case: L0+L2 standalone, L1 hosts
+    L3's 64-lane tail — every level packed, total DMA unchanged."""
+    from raft_stereo_tpu.corr.pallas_reg import level_widths, pack_plan
+    assert pack_plan(level_widths(744, 4), True) == [
+        "packed", ("host", 3), "packed", ("tail", 1)]
+    # KITTI realtime: 312 -> L0 hosts, L2 (78, odd-block) stays plain.
+    assert pack_plan(level_widths(312, 4), True) == [
+        ("host", 3), "packed", "plain", ("tail", 0)]
+    # fp32 never packs.
+    assert pack_plan(level_widths(744, 4), False) == ["plain"] * 4
+
+
+@pytest.mark.parametrize("w", [372, 373, 365, 744, 130])
+def test_reg_tpu_combined_container_matches_reg(rng, w):
+    """Widths whose plans pair two odd-block levels into ONE combined
+    container (the L1-hosts-L3 layout at Middlebury-F): all four levels
+    must match the fp32 reg path to bf16 rounding. Odd widths (373, 365)
+    exercise the padding rule and the pooled-boundary artifact that the
+    true-width mask must hide; 130 puts the host level at level 1 with a
+    single-vreg standalone level 0."""
+    from raft_stereo_tpu.corr.pallas_reg import level_widths, pack_plan
+    plan = pack_plan(level_widths(w, LEVELS), True)
+    assert any(isinstance(p, tuple) and p[0] == "host" for p in plan), plan
+    b, h, d = 1, 3, 16
+    f1 = jnp.asarray(rng.standard_normal((b, h, w, d), dtype=np.float32))
+    f2 = jnp.asarray(rng.standard_normal((b, h, w, d), dtype=np.float32))
+    coords = jnp.asarray(
+        rng.uniform(-8, w + 6, size=(b, h, w)).astype(np.float32))
+    ref = make_corr_fn("reg", f1, f2, num_levels=LEVELS, radius=RADIUS)(coords)
+    out = make_corr_fn("reg_tpu", f1.astype(jnp.bfloat16),
+                       f2.astype(jnp.bfloat16), num_levels=LEVELS,
+                       radius=RADIUS)(coords)
+    np.testing.assert_allclose(np.asarray(out, np.float32), np.asarray(ref),
+                               atol=0.25, rtol=0.05)
+
+
+def test_reg_tpu_combined_container_exact_vs_oracle(rng):
+    """The combined host+tail container transports the SAME bf16 tap
+    values as unpacked rows — bit-exact agreement per level against the
+    masked one-hot oracle on the identical bf16 rows, isolating the
+    tail-lane gather (static slab + lane offset) from volume rounding."""
+    from raft_stereo_tpu.corr.pallas_reg import (
+        _masked_lookup_xla, level_widths, make_reg_tpu_corr_fn, pack_plan,
+        pad_width)
+    from raft_stereo_tpu.corr.reg import build_pyramid
+    b, h, w, d = 1, 3, 372, 16  # plan: [host(3), packed, plain, tail(0)]
+    widths = level_widths(w, LEVELS)
+    plan = pack_plan(widths, True)
+    assert plan[0] == ("host", 3) and plan[3] == ("tail", 0), plan
+    f1 = jnp.asarray(
+        rng.standard_normal((b, h, w, d), dtype=np.float32)).astype(
+            jnp.bfloat16)
+    f2 = jnp.asarray(
+        rng.standard_normal((b, h, w, d), dtype=np.float32)).astype(
+            jnp.bfloat16)
+    coords = jnp.asarray(
+        rng.uniform(-8, w + 6, size=(b, h, w)).astype(np.float32))
+    out = make_reg_tpu_corr_fn(f1, f2, num_levels=LEVELS,
+                               radius=RADIUS)(coords)
+    # Rebuild the identical bf16 rows the kernel saw and run the oracle.
+    f2p = jnp.pad(f2, ((0, 0), (0, 0), (0, pad_width(w) - w), (0, 0)))
+    vol = jnp.einsum("bhid,bhjd->bhij", f1, f2p) * (1.0 / d ** 0.5)
+    rows = []
+    for lvl, v in enumerate(build_pyramid(vol, LEVELS)):
+        align = 256 if plan[lvl] == "packed" else 128
+        want = -(-widths[lvl] // align) * align
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, 0), (0, want - v.shape[-1])))
+        rows.append(v.reshape(b, h * w, -1))
+    ref = _masked_lookup_xla(rows, coords.reshape(b, h * w, 1), RADIUS,
+                             widths).reshape(b, h, w, -1)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+
+def test_reg_tpu_combined_container_grads_match_reg(rng):
+    """Gradients through the combined-container lookup (zero cotangent on
+    the container, all flow through the bf16 rows) track the reg path's,
+    including from the tail level's output channels alone."""
+    b, h, w, d = 1, 4, 372, 16
+    k = 2 * RADIUS + 1
+    f1 = jnp.asarray(rng.standard_normal((b, h, w, d), dtype=np.float32))
+    f2 = jnp.asarray(rng.standard_normal((b, h, w, d), dtype=np.float32))
+    coords = jnp.asarray(
+        rng.uniform(0, w, size=(b, h, w)).astype(np.float32))
+
+    def loss(impl, f1_, f2_, sl):
+        fn = make_corr_fn(impl, f1_.astype(jnp.bfloat16),
+                          f2_.astype(jnp.bfloat16), num_levels=LEVELS,
+                          radius=RADIUS)
+        return jnp.sum(fn(coords).astype(jnp.float32)[..., sl] ** 2)
+
+    for sl in (slice(3 * k, 4 * k), slice(None)):  # tail level alone; all
+        g1, g2 = jax.grad(lambda a, c: loss("reg_tpu", a, c, sl),
+                          argnums=(0, 1))(f1, f2)
+        r1, r2 = jax.grad(lambda a, c: loss("reg", a, c, sl),
+                          argnums=(0, 1))(f1, f2)
+        for a_, b_ in ((g1, r1), (g2, r2)):
+            a_, b_ = np.asarray(a_, np.float32), np.asarray(b_, np.float32)
+            scale = np.abs(b_).max() + 1e-8
+            assert np.abs(a_ - b_).max() / scale < 0.05, \
+                np.abs(a_ - b_).max() / scale
 
 
 def test_pack_unpack_rows_roundtrip(rng):

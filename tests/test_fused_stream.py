@@ -17,6 +17,11 @@ import jax
 import jax.numpy as jnp
 
 from raft_stereo_tpu.config import RAFTStereoConfig
+
+# The whole module is a kernel oracle battery: on a TPU host,
+# RAFT_TEST_ONCHIP=1 (scripts/run_onchip_battery.sh) runs every test
+# COMPILED through Mosaic instead of interpret-mode on CPU.
+pytestmark = pytest.mark.kernel_battery
 from raft_stereo_tpu.models import init_raft_stereo, raft_stereo_forward
 from raft_stereo_tpu.models.update import (
     apply_conv_gru, apply_flow_head, apply_motion_encoder, init_conv_gru,
@@ -96,6 +101,194 @@ def test_fused_gru_and_motion_batched_match_per_sample():
     for b in range(B):
         g1 = fused_motion_fwd_impl(pm, flow[b:b + 1], corr[b:b + 1])
         assert float(jnp.abs(gotm[b:b + 1] - g1).max()) == 0.0
+
+
+def _gru1632_case(key, h16_, w16_, ch, dtype, b=1):
+    from raft_stereo_tpu.models.update import init_conv_gru
+    h32_, w32_ = h16_ // 2, w16_ // 2
+    kp = jax.random.split(key, 12)
+    p16 = init_conv_gru(kp[0], ch, 2 * ch)   # x parts: pool(net0) + up
+    p32 = init_conv_gru(kp[1], ch, ch)       # x part: pool(net1)
+    h16 = jax.random.normal(kp[2], (b, h16_, w16_, ch), dtype) * 0.5
+    h32 = jax.random.normal(kp[3], (b, h32_, w32_, ch), dtype) * 0.5
+    ctx16 = tuple(jax.random.normal(k, (b, h16_, w16_, ch), dtype) * 0.3
+                  for k in kp[4:7])
+    ctx32 = tuple(jax.random.normal(k, (b, h32_, w32_, ch), dtype) * 0.3
+                  for k in kp[7:10])
+    x0p = jax.random.normal(kp[10], (b, h16_, w16_, ch), dtype)
+    x1p = jax.random.normal(kp[11], (b, h32_, w32_, ch), dtype)
+    return p16, p32, h16, h32, ctx16, ctx32, x0p, x1p
+
+
+@pytest.mark.parametrize("h16_,w16_,ch,dtype,tol", [
+    (16, 24, 128, jnp.float32, 1e-4),
+    (32, 18, 64, jnp.float32, 1e-4),
+    (48, 16, 32, jnp.float32, 1e-4),
+    (16, 24, 128, jnp.bfloat16, 5e-2),
+])
+def test_fused_gru1632_matches_oracle(h16_, w16_, ch, dtype, tol):
+    """Co-scheduled gru16+gru32 kernel vs the serial XLA composition
+    (gru32 -> aligned-corners upsample -> gru16)."""
+    from raft_stereo_tpu.ops.pallas_stream import (
+        _gru1632_oracle, fused_gru1632_fwd_impl, gru1632_th)
+    assert gru1632_th(h16_, w16_) > 0
+    p16, p32, h16, h32, ctx16, ctx32, x0p, x1p = _gru1632_case(
+        jax.random.PRNGKey(0), h16_, w16_, ch, dtype)
+    czrq16 = prepare_gru_context(p16, ctx16, dtype)
+    czrq32 = prepare_gru_context(p32, ctx32, dtype)
+    ref16, ref32 = _gru1632_oracle(p16, p32, h16, h32, ctx16, ctx32,
+                                   x0p, x1p)
+    got16, got32 = fused_gru1632_fwd_impl(p16, p32, h16, h32, czrq16,
+                                          czrq32, x0p, x1p)
+    for got, ref in ((got32, ref32), (got16, ref16)):
+        err = float(jnp.max(jnp.abs(got.astype(jnp.float32)
+                                    - ref.astype(jnp.float32))))
+        assert err < tol, err
+
+
+def test_fused_gru1632_bitwise_matches_serial_kernels():
+    """The co-scheduled kernel must be BIT-IDENTICAL to the serial fused
+    path it replaces (fused_conv_gru x2 + XLA interp_align_corners in
+    bf16): the in-kernel upsample reuses resize.py's banded-matrix
+    weights and rounds to bf16 between the H and W passes exactly where
+    the XLA einsum pair does, so any mismatch is a real scheduling or
+    windowing bug — not tolerance."""
+    from raft_stereo_tpu.ops.pallas_stream import (
+        fused_conv_gru_fwd_impl, fused_gru1632_fwd_impl)
+    from raft_stereo_tpu.ops.resize import interp_align_corners
+    dtype = jnp.bfloat16
+    p16, p32, h16, h32, ctx16, ctx32, x0p, x1p = _gru1632_case(
+        jax.random.PRNGKey(1), 32, 24, 128, dtype)
+    czrq16 = prepare_gru_context(p16, ctx16, dtype)
+    czrq32 = prepare_gru_context(p32, ctx32, dtype)
+    ser32, _ = fused_conv_gru_fwd_impl(p32, h32, czrq32, x1p)
+    up = interp_align_corners(ser32, h16.shape[1:3])
+    ser16, _ = fused_conv_gru_fwd_impl(p16, h16, czrq16, x0p, up)
+    got16, got32 = fused_gru1632_fwd_impl(p16, p32, h16, h32, czrq16,
+                                          czrq32, x0p, x1p)
+    assert (np.asarray(got32, np.float32)
+            == np.asarray(ser32, np.float32)).all()
+    assert (np.asarray(got16, np.float32)
+            == np.asarray(ser16, np.float32)).all()
+
+
+def test_fused_gru1632_integer_exact():
+    """Integer inputs are exact in fp32: any lag/window/boundary bug in
+    the co-schedule shows as an integer-sized error."""
+    from raft_stereo_tpu.models.update import init_conv_gru
+    from raft_stereo_tpu.ops.pallas_stream import (
+        _gru1632_oracle, fused_gru1632_fwd_impl)
+    rng = np.random.default_rng(0)
+    ch, h16_, w16_ = 32, 16, 24
+
+    def ints(shape):
+        return jnp.asarray(rng.integers(-2, 3, shape), jnp.float32)
+
+    p16 = jax.tree.map(lambda t: ints(t.shape),
+                       init_conv_gru(jax.random.PRNGKey(0), ch, 2 * ch))
+    p32 = jax.tree.map(lambda t: ints(t.shape),
+                       init_conv_gru(jax.random.PRNGKey(1), ch, ch))
+    h16 = ints((1, h16_, w16_, ch))
+    h32 = ints((1, h16_ // 2, w16_ // 2, ch))
+    ctx16 = tuple(ints((1, h16_, w16_, ch)) for _ in range(3))
+    ctx32 = tuple(ints((1, h16_ // 2, w16_ // 2, ch)) for _ in range(3))
+    x0p = ints((1, h16_, w16_, ch))
+    x1p = ints((1, h16_ // 2, w16_ // 2, ch))
+    czrq16 = prepare_gru_context(p16, ctx16, jnp.float32)
+    czrq32 = prepare_gru_context(p32, ctx32, jnp.float32)
+    ref16, ref32 = _gru1632_oracle(p16, p32, h16, h32, ctx16, ctx32,
+                                   x0p, x1p)
+    got16, got32 = fused_gru1632_fwd_impl(p16, p32, h16, h32, czrq16,
+                                          czrq32, x0p, x1p)
+    # Unlike the relu-chain motion encoder, the GRU runs integer preacts
+    # through sigmoid/tanh, so fp32 conv reassociation (XLA's one-pass
+    # conv vs the ring's 9 dots) survives as ~1e-5 noise — same envelope
+    # as test_fused_gru_matches_oracle. A mis-schedule would be O(1) and
+    # LOCALIZED; the bitwise-vs-serial-kernels test pins exactness.
+    d32 = np.asarray(jnp.abs(got32 - ref32))
+    d16 = np.asarray(jnp.abs(got16 - ref16))
+    assert d32.max() < 1e-4, d32.max()
+    assert d16.max() < 1e-4, d16.max()
+    assert d16[0].max(axis=(1, 2)).std() < d16.max()  # no row stands out
+
+
+def test_fused_gru1632_batched_matches_per_sample():
+    """B > 1 rides the outer grid dim: batched run must BIT-match
+    per-sample runs (a window/ring leaking across samples shows here)."""
+    from raft_stereo_tpu.ops.pallas_stream import fused_gru1632_fwd_impl
+    p16, p32, h16, h32, ctx16, ctx32, x0p, x1p = _gru1632_case(
+        jax.random.PRNGKey(2), 16, 16, 64, jnp.float32, b=3)
+    czrq16 = prepare_gru_context(p16, ctx16, jnp.float32)
+    czrq32 = prepare_gru_context(p32, ctx32, jnp.float32)
+    got16, got32 = fused_gru1632_fwd_impl(p16, p32, h16, h32, czrq16,
+                                          czrq32, x0p, x1p)
+    for b in range(3):
+        g16, g32 = fused_gru1632_fwd_impl(
+            p16, p32, h16[b:b + 1], h32[b:b + 1], czrq16[b:b + 1],
+            czrq32[b:b + 1], x0p[b:b + 1], x1p[b:b + 1])
+        assert float(jnp.abs(got16[b:b + 1] - g16).max()) == 0.0
+        assert float(jnp.abs(got32[b:b + 1] - g32).max()) == 0.0
+
+
+def test_fused_gru1632_grads_match_oracle():
+    """custom_vjp backward == grads of the XLA composition."""
+    from raft_stereo_tpu.ops.pallas_stream import (
+        _gru1632_oracle, fused_gru1632)
+    import raft_stereo_tpu.ops.pallas_stream as ps
+    p16, p32, h16, h32, ctx16, ctx32, x0p, x1p = _gru1632_case(
+        jax.random.PRNGKey(3), 16, 16, 64, jnp.float32)
+    czrq16 = prepare_gru_context(p16, ctx16, jnp.float32)
+    czrq32 = prepare_gru_context(p32, ctx32, jnp.float32)
+    old = ps.FORCE_FUSABLE_DTYPE
+    ps.FORCE_FUSABLE_DTYPE = True
+    try:
+        def loss_fused(h16_, h32_, p16_, p32_):
+            a, b = fused_gru1632(p16_, p32_, h16_, h32_, czrq16, czrq32,
+                                 ctx16, ctx32, x0p, x1p)
+            return (jnp.sum(a.astype(jnp.float32) ** 2)
+                    + jnp.sum(b.astype(jnp.float32) ** 2))
+
+        def loss_ref(h16_, h32_, p16_, p32_):
+            a, b = _gru1632_oracle(p16_, p32_, h16_, h32_, ctx16, ctx32,
+                                   x0p, x1p)
+            return (jnp.sum(a.astype(jnp.float32) ** 2)
+                    + jnp.sum(b.astype(jnp.float32) ** 2))
+
+        gf = jax.grad(loss_fused, argnums=(0, 1, 2, 3))(h16, h32, p16, p32)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(h16, h32, p16, p32)
+    finally:
+        ps.FORCE_FUSABLE_DTYPE = old
+    for a, b in zip(jax.tree.leaves(gf), jax.tree.leaves(gr)):
+        d = np.abs(np.asarray(a) - np.asarray(b)).max()
+        s = np.abs(np.asarray(b)).max() + 1e-8
+        assert d / s < 5e-3, (d, s)
+
+
+def test_fused_gru1632_end_to_end_matches_serial(rng, monkeypatch):
+    """Full bf16 test-mode forward with the co-scheduled gru16+gru32
+    engaged vs the same forward forced onto the serial two-kernel path
+    (RAFT_FUSE_GRU1632=0): bit-identical disparities, by construction.
+    128x128 input -> 16x16 / 8x8 coarse scales, the smallest shapes the
+    co-schedule supports."""
+    from raft_stereo_tpu.ops.pallas_stream import gru1632_is_fusable
+    cfg = RAFTStereoConfig(mixed_precision=True)
+    params = init_raft_stereo(jax.random.key(0), cfg)
+    img1 = jnp.asarray(rng.uniform(0, 255, size=(1, 128, 128, 3)),
+                       dtype=jnp.float32)
+    img2 = jnp.asarray(rng.uniform(0, 255, size=(1, 128, 128, 3)),
+                       dtype=jnp.float32)
+    h16 = jnp.zeros((1, 16, 16, 128), jnp.bfloat16)
+    h32 = jnp.zeros((1, 8, 8, 128), jnp.bfloat16)
+    assert gru1632_is_fusable(h16, h32)  # the site engages at this size
+    lr_f, up_f = raft_stereo_forward(params, cfg, img1, img2, iters=1,
+                                     test_mode=True)
+    monkeypatch.setenv("RAFT_FUSE_GRU1632", "0")
+    lr_s, up_s = raft_stereo_forward(params, cfg, img1, img2, iters=1,
+                                     test_mode=True)
+    assert (np.asarray(up_f, np.float32) == np.asarray(up_s,
+                                                       np.float32)).all()
+    assert (np.asarray(lr_f, np.float32) == np.asarray(lr_s,
+                                                       np.float32)).all()
 
 
 def test_fused_motion_integer_exact():
@@ -262,6 +455,106 @@ def test_fused_encoder_end_to_end_packed_layer2(norm_fn):
             fused=True)[0][0])
     assert got.shape == ref.shape
     assert np.abs(got - ref).max() < 5e-2, np.abs(got - ref).max()
+
+
+@pytest.mark.parametrize("hw,norm_fn,ch", [
+    ((16, 24), "instance", 96), ((16, 24), "instance", 128),
+    ((16, 24), "batch", 96), ((16, 24), "batch", 128),
+    ((16, 800), "instance", 96), ((16, 800), "batch", 128),
+])
+def test_stream_resblock_matches_oracle(hw, norm_fn, ch, monkeypatch):
+    """Streamed stride-1 residual block (raw1 -> mid1 -> point2 passes)
+    vs apply_residual_block, at the tail's real channel counts (96 =
+    layer2, 128 = layer3/heads). (16, 800) is the multi-strip path."""
+    import raft_stereo_tpu.ops.pallas_stream as ps
+    from raft_stereo_tpu.models.layers import (
+        apply_residual_block, init_residual_block)
+    from raft_stereo_tpu.ops.pallas_encoder import (
+        resblock_streamable, stream_resblock)
+    monkeypatch.setattr(ps, "FORCE_FUSABLE_DTYPE", True)
+    h_, w_ = hw
+    p = init_residual_block(jax.random.PRNGKey(0), ch, ch, norm_fn, stride=1)
+    x = jax.random.normal(jax.random.PRNGKey(1), (1, h_, w_, ch))
+    assert resblock_streamable(p, x, norm_fn)
+    ref = np.asarray(apply_residual_block(p, x, norm_fn, stride=1))
+    got = np.asarray(stream_resblock(norm_fn, p, x))
+    d = np.abs(got - ref)
+    assert d.max() < 5e-4, d.max()
+    assert d[0].max(axis=(1, 2)).std() < d.max() + 1e-9  # diffuse, not a row
+
+
+def test_stream_head_conv_matches_oracle(monkeypatch):
+    """Streamed 3x3 head conv (raw output, Cout != Cin) vs apply_conv."""
+    import raft_stereo_tpu.ops.pallas_stream as ps
+    from raft_stereo_tpu.models.layers import apply_conv, init_conv
+    from raft_stereo_tpu.ops.pallas_encoder import (
+        head_conv_streamable, stream_head_conv)
+    monkeypatch.setattr(ps, "FORCE_FUSABLE_DTYPE", True)
+    pc = init_conv(jax.random.PRNGKey(0), 3, 3, 128, 64)
+    x = jax.random.normal(jax.random.PRNGKey(1), (1, 24, 40, 128))
+    assert head_conv_streamable(pc, x)
+    ref = np.asarray(apply_conv(pc, x, padding=1))
+    got = np.asarray(stream_head_conv(pc, x))
+    assert got.shape == ref.shape
+    assert np.abs(got - ref).max() < 5e-4
+
+
+def test_stream_resblock_grads_match_oracle(monkeypatch):
+    """custom_vjp backward == the XLA block's gradients."""
+    import raft_stereo_tpu.ops.pallas_stream as ps
+    from raft_stereo_tpu.models.layers import (
+        apply_residual_block, init_residual_block)
+    from raft_stereo_tpu.ops.pallas_encoder import stream_resblock
+    monkeypatch.setattr(ps, "FORCE_FUSABLE_DTYPE", True)
+    p = init_residual_block(jax.random.PRNGKey(2), 96, 96, "instance",
+                            stride=1)
+    x = jax.random.normal(jax.random.PRNGKey(3), (1, 16, 24, 96))
+
+    def loss(fused):
+        def f(p_, x_):
+            out = (stream_resblock("instance", p_, x_) if fused
+                   else apply_residual_block(p_, x_, "instance", stride=1))
+            return jnp.sum(out * out)
+        return jax.grad(f, argnums=(0, 1))(p, x)
+
+    g_ref, gx_ref = loss(False)
+    g_got, gx_got = loss(True)
+    ref_leaves = jax.tree.leaves((g_ref, gx_ref))
+    # Global scale: IN-cancelled bias leaves have true gradient zero, so
+    # their values are rounding noise in both programs (same exclusion as
+    # test_fused_train_grads_match_xla).
+    gmax = max(float(np.abs(np.asarray(b)).max()) for b in ref_leaves)
+    for a, b in zip(jax.tree.leaves((g_got, gx_got)), ref_leaves):
+        d = np.abs(np.asarray(a) - np.asarray(b)).max()
+        assert d / gmax < 1e-3, (d, gmax)
+
+
+def test_streamed_tail_end_to_end_matches_xla(monkeypatch):
+    """Full encoders with the streamed tail ENGAGED (layer2/layer3 second
+    blocks + finest heads) vs the pure-XLA chain, both norm types."""
+    import raft_stereo_tpu.ops.pallas_stream as ps
+    from raft_stereo_tpu.models.extractor import (
+        apply_basic_encoder, apply_multi_basic_encoder, init_basic_encoder,
+        init_multi_basic_encoder)
+    monkeypatch.setattr(ps, "FORCE_FUSABLE_DTYPE", True)
+    key = jax.random.PRNGKey(9)
+    x = jax.random.normal(jax.random.PRNGKey(10), (1, 48, 32, 3))
+    pf = init_basic_encoder(key, output_dim=256, norm_fn="instance",
+                            downsample=2)
+    ref = np.asarray(apply_basic_encoder(pf, x, norm_fn="instance",
+                                         downsample=2, fused=False))
+    got = np.asarray(apply_basic_encoder(pf, x, norm_fn="instance",
+                                         downsample=2, fused=True))
+    assert np.abs(got - ref).max() < 5e-2, np.abs(got - ref).max()
+    pc = init_multi_basic_encoder(key, output_dim=[[128] * 3, [128] * 3],
+                                  norm_fn="batch", downsample=2)
+    refs = apply_multi_basic_encoder(pc, x, norm_fn="batch", downsample=2,
+                                     num_layers=3, fused=False)
+    gots = apply_multi_basic_encoder(pc, x, norm_fn="batch", downsample=2,
+                                     num_layers=3, fused=True)
+    for rlist, glist in zip(refs, gots):
+        for r, g in zip(rlist, glist):
+            assert np.abs(np.asarray(g) - np.asarray(r)).max() < 5e-2
 
 
 def test_fused_encoder_packed_grad_matches_oracle():

@@ -10,7 +10,16 @@ from raft_stereo_tpu.config import RAFTStereoConfig
 from raft_stereo_tpu.engine.optimizer import make_optimizer
 from raft_stereo_tpu.engine.steps import make_eval_step, make_train_step
 from raft_stereo_tpu.models import init_raft_stereo
+from raft_stereo_tpu.ops.jax_compat import modern_jax
 from raft_stereo_tpu.parallel import make_mesh, shard_batch
+
+# Old-JAX XLA:CPU hard-crashes (SIGSEGV, not an exception) compiling
+# custom-partitioned Pallas programs under a mesh; the single-device
+# compat shims (ops/jax_compat.py) cover everything else. These paths
+# are certified on the modern-JAX TPU host.
+requires_partitionable_kernels = pytest.mark.skipif(
+    not modern_jax(),
+    reason="custom-partitioned Pallas under a mesh segfaults old XLA:CPU")
 
 
 def _batch(rng, b, h, w):
@@ -137,6 +146,7 @@ def test_spatial_sharded_train_step_matches_single(rng):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
+@requires_partitionable_kernels
 def test_spatial_fused_train_step_runs(rng):
     """A spatially-sharded TRAIN step accepts fused_update untouched
     (r4): no config is stripped any more — mesh_config_overrides is
@@ -174,6 +184,7 @@ def test_spatial_fused_train_step_runs(rng):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
 
 
+@requires_partitionable_kernels
 def test_spatial_sharded_fused_eval_matches_single(rng):
     """fused_update SURVIVES space>1 (VERDICT r3 #2, the r3 perf cliff):
     the streaming GRU/motion kernels run per-shard behind a ppermute
@@ -201,6 +212,7 @@ def test_spatial_sharded_fused_eval_matches_single(rng):
                                atol=5e-3)
 
 
+@requires_partitionable_kernels
 @pytest.mark.parametrize("impl", ["reg_tpu", "alt_tpu"])
 @pytest.mark.parametrize("n_data,n_space", [(8, 1), (2, 4), (1, 8)])
 def test_partitioned_corr_kernels_match_reg(rng, impl, n_data, n_space):
