@@ -3,6 +3,12 @@
 
 Glob a left/right image list, run the model in test mode, save the disparity
 as a jet-colormap PNG (sign-flipped back to positive) and optionally ``.npy``.
+
+Runs through ``raft_stereo_tpu.serve.InferenceSession`` (``--bucket``): a
+mixed-size glob shares compiled programs instead of recompiling per frame;
+the default bucket of 32 reproduces the reference per-shape padding formula
+exactly, so outputs are byte-identical to the pre-session path (test-pinned
+in ``tests/test_serve.py``).
 """
 
 from __future__ import annotations
@@ -31,6 +37,13 @@ def build_parser() -> argparse.ArgumentParser:
                         help="directory to save output", default="demo_output")
     parser.add_argument('--valid_iters', type=int, default=32,
                         help='number of flow-field updates during forward pass')
+    parser.add_argument('--bucket', type=int, default=32,
+                        help="pad shapes to multiples of this (multiple of "
+                        "32) so a mixed-size glob shares compiled programs; "
+                        "the default 32 reproduces the reference per-shape "
+                        "padding formula exactly (bit-identical output), "
+                        "larger buckets trade a little edge padding for "
+                        "fewer compiles")
     add_model_args(parser)
     return parser
 
@@ -39,20 +52,23 @@ def demo(args) -> None:
     import jax
     import numpy as np
 
-    from raft_stereo_tpu.config import RAFTStereoConfig
+    from raft_stereo_tpu.config import RAFTStereoConfig, with_eval_precision
     from raft_stereo_tpu.data.frame_utils import read_image_rgb
     from raft_stereo_tpu.engine.checkpoint import load_params
-    from raft_stereo_tpu.engine.evaluate import make_eval_forward
     from raft_stereo_tpu.models import init_raft_stereo
-    from raft_stereo_tpu.ops.padder import InputPadder
+    from raft_stereo_tpu.serve import InferenceSession, SessionConfig
 
     cfg = RAFTStereoConfig.from_namespace(args)
     template = (None if args.restore_ckpt.endswith(".pth")
                 else init_raft_stereo(jax.random.PRNGKey(0), cfg))
     params = load_params(args.restore_ckpt, cfg, template)
-    mixed_prec = (cfg.mixed_precision
-                  or args.corr_implementation.endswith(("_cuda", "_tpu")))
-    forward = make_eval_forward(params, cfg, args.valid_iters, mixed_prec)
+    cfg = with_eval_precision(cfg)
+    # The session runs the SAME single-scan program make_eval_forward
+    # compiled (byte-identical output, test-pinned) but bucket-caches
+    # compilations, so a mixed-size glob stops recompiling per frame.
+    session = InferenceSession(params, cfg, SessionConfig(
+        valid_iters=args.valid_iters, bucket=args.bucket, segments=1,
+        canary=False))
 
     output_directory = Path(args.output_directory)
     output_directory.mkdir(exist_ok=True)
@@ -94,14 +110,25 @@ def demo(args) -> None:
     # resolution the jet-PNG encode alone costs about as much host time as
     # the forward costs device time. At most one save is in flight, awaited
     # in order, so outputs and memory stay bounded.
+    from raft_stereo_tpu.serve import InputRejected, SessionError
+
     loader = _PairLoader(list(zip(left_images, right_images)))
+    skipped = 0
     with ThreadPoolExecutor(max_workers=1) as saver:
         pending_save = None
         for imfile1, image1, image2 in prefetch_samples(loader):
-            padder = InputPadder(image1.shape, divis_by=32)
-            image1, image2 = padder.pad_np(image1, image2)
-            flow_up, _ = forward(image1, image2)
-            flow_up = np.asarray(padder.unpad(flow_up))[0, ..., 0]
+            try:
+                result = session.infer(image1, image2)
+            except (InputRejected, SessionError) as e:
+                # One bad frame (NaN pixels, non-finite disparity) must
+                # not abort the rest of the glob — log and keep going.
+                logging.error("skipping %s: %s", imfile1, e)
+                skipped += 1
+                continue
+            # result.disparity is the positive disparity; the save path
+            # below keeps the reference sign conventions (npy stores the
+            # raw negative-disparity flow; -(-x) is bitwise x).
+            flow_up = -result.disparity
 
             if pending_save is not None:
                 pending_save.result()
@@ -109,6 +136,9 @@ def demo(args) -> None:
                                         flow_up)
         if pending_save is not None:
             pending_save.result()
+    if skipped:
+        print(f"Skipped {skipped} of {len(left_images)} pairs "
+              "(structured per-frame errors above)")
 
 
 def main(argv=None) -> None:

@@ -34,6 +34,11 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument('--bucket', type=int, default=None,
                         help="pad eval shapes up to multiples of this size "
                         "to share compilations (must be a multiple of 32)")
+    parser.add_argument('--segments', type=int, default=1,
+                        help="run the refinement scan as this many chained "
+                        "segments (must divide valid_iters) — the eval-scale "
+                        "A/B for the serving layer's anytime degradation; "
+                        "metrics are bit-identical to --segments 1")
     parser.add_argument('--spatial_shard', type=int, default=1,
                         help="shard image height (and the correlation "
                         "volume) over this many devices — full-resolution "
@@ -77,11 +82,21 @@ def main(argv=None) -> None:
           "learnable parameters.")
 
     # Kernel-backed corr lookups accumulate in fp32, making full-network
-    # mixed precision safe (reference :227-230).
-    use_mixed_precision = args.corr_implementation.endswith(("_cuda", "_tpu"))
+    # mixed precision safe (reference :227-230). One shared policy with
+    # demo.py/serve_stereo.py; unlike the old inline expression it also
+    # honors an explicit --mixed_precision with an XLA corr choice.
+    from raft_stereo_tpu.config import eval_mixed_precision
+    use_mixed_precision = eval_mixed_precision(cfg)
 
     common = dict(iters=args.valid_iters, mixed_prec=use_mixed_precision,
                   root=args.dataset_root)
+    if args.segments != 1:
+        if args.valid_iters % args.segments:
+            raise SystemExit("--segments must divide --valid_iters")
+        if args.spatial_shard > 1:
+            raise SystemExit(
+                "--segments > 1 is not supported with --spatial_shard")
+        common["segments"] = args.segments
     if args.spatial_shard > 1:
         from raft_stereo_tpu.parallel import make_mesh
         from raft_stereo_tpu.parallel.mesh import validate_spatial_shard

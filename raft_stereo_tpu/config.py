@@ -143,6 +143,25 @@ class TrainConfig:
         return cls(**{k: v for k, v in vars(ns).items() if k in fields})
 
 
+def eval_mixed_precision(cfg: RAFTStereoConfig) -> bool:
+    """The inference-CLI bf16 policy, in ONE place (evaluate/demo/serve all
+    call this — reference ``evaluate_stereo.py:227-230``): full-network
+    mixed precision is safe when explicitly requested or when a
+    kernel-backed corr implementation is selected (their lookups
+    accumulate in fp32 in-kernel)."""
+    return (cfg.mixed_precision
+            or cfg.corr_implementation.endswith(("_cuda", "_tpu")))
+
+
+def with_eval_precision(cfg: RAFTStereoConfig) -> RAFTStereoConfig:
+    """``cfg`` with :func:`eval_mixed_precision` applied (same object when
+    nothing changes)."""
+    mp = eval_mixed_precision(cfg)
+    if mp == cfg.mixed_precision:
+        return cfg
+    return type(cfg)(**{**cfg.__dict__, "mixed_precision": mp})
+
+
 def add_model_args(parser: argparse.ArgumentParser) -> None:
     """Architecture flags, identical to the reference CLIs plus TPU corr choices."""
     parser.add_argument('--corr_implementation', choices=list(CORR_IMPLEMENTATIONS),

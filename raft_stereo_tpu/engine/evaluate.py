@@ -42,7 +42,8 @@ def count_parameters(params) -> int:
 
 
 def make_eval_forward(params, cfg: RAFTStereoConfig, iters: int,
-                      mixed_prec: bool = False, mesh=None):
+                      mixed_prec: bool = False, mesh=None,
+                      segments: int = 1):
     """Per-shape-cached jitted forward: (1,H,W,3)x2 -> (disparity map, checksum).
 
     ``mixed_prec`` mirrors the reference's autocast flag: bf16 compute for the
@@ -53,8 +54,15 @@ def make_eval_forward(params, cfg: RAFTStereoConfig, iters: int,
     is sharded across chips (SURVEY §5 long-context; XLA inserts the conv
     halo exchanges), letting full-resolution frames that exceed one chip's
     HBM evaluate across the pod.
+
+    ``segments``: run the refinement scan as this many chained segments
+    (``raft_stereo_inference``) instead of one — the eval-scale A/B for the
+    serving layer's anytime property (metrics must not move; the segmented
+    composition is bit-identical, test-pinned). Unsharded eval only.
     """
     from raft_stereo_tpu.parallel.mesh import mesh_safe_cfg
+    if segments > 1 and mesh is not None:
+        raise ValueError("segments > 1 is not supported with --spatial_shard")
     extra = ({} if cfg.mixed_precision == mixed_prec else
              {"mixed_precision": mixed_prec})
     if mesh is not None:
@@ -72,9 +80,15 @@ def make_eval_forward(params, cfg: RAFTStereoConfig, iters: int,
     @functools.lru_cache(maxsize=None)
     def compiled(h: int, w: int):
         def fwd(p, image1, image2):
-            _, flow_up = raft_stereo_forward(p, run_cfg, image1, image2,
-                                             iters=iters, test_mode=True,
-                                             space_mesh=space_mesh)
+            if segments > 1:
+                from raft_stereo_tpu.models import raft_stereo_inference
+                _, flow_up = raft_stereo_inference(p, run_cfg, image1, image2,
+                                                   iters=iters,
+                                                   segments=segments)
+            else:
+                _, flow_up = raft_stereo_forward(p, run_cfg, image1, image2,
+                                                 iters=iters, test_mode=True,
+                                                 space_mesh=space_mesh)
             return flow_up, jnp.sum(flow_up.astype(jnp.float32))
         if mesh is None:
             return jax.jit(fwd)
@@ -134,13 +148,14 @@ def _run_pair(forward, sample, bucket: Optional[int]):
     padder = InputPadder(image1.shape, divis_by=32, bucket=bucket)
     image1, image2 = padder.pad_np(image1, image2)
     flow_pr, elapsed = forward(image1, image2)
-    flow_pr = np.asarray(padder.unpad(jnp.asarray(flow_pr)))[0]
+    flow_pr = padder.unpad_np(np.asarray(flow_pr))[0]
     return flow_pr, elapsed
 
 
 def validate_eth3d(params, cfg, iters: int = 32, mixed_prec: bool = False,
                    root: Optional[str] = None, mesh=None,
-                   bucket: Optional[int] = None) -> Dict[str, float]:
+                   bucket: Optional[int] = None,
+                   segments: int = 1) -> Dict[str, float]:
     """ETH3D train split: EPE + D1(>1px), per-image averaging.
 
     ``root`` is the datasets/ tree root for every validator (the per-class
@@ -148,7 +163,8 @@ def validate_eth3d(params, cfg, iters: int = 32, mixed_prec: bool = False,
     """
     kw = {"root": f"{root}/ETH3D"} if root else {}
     val_dataset = datasets.ETH3D(aug_params=None, **kw)
-    forward = make_eval_forward(params, cfg, iters, mixed_prec, mesh=mesh)
+    forward = make_eval_forward(params, cfg, iters, mixed_prec, mesh=mesh,
+                                segments=segments)
 
     out_list, epe_list = [], []
     for val_id, sample in enumerate(prefetch_samples(val_dataset)):
@@ -170,7 +186,8 @@ def validate_eth3d(params, cfg, iters: int = 32, mixed_prec: bool = False,
 
 def validate_kitti(params, cfg, iters: int = 32, mixed_prec: bool = False,
                    root: Optional[str] = None, mesh=None,
-                   bucket: Optional[int] = None) -> Dict[str, float]:
+                   bucket: Optional[int] = None,
+                   segments: int = 1) -> Dict[str, float]:
     """KITTI-2015 train split: EPE + D1(>3px, per-pixel), FPS protocol.
 
     The default is the reference-exact protocol (per-shape /32 padding,
@@ -183,7 +200,8 @@ def validate_kitti(params, cfg, iters: int = 32, mixed_prec: bool = False,
     """
     kw = {"root": f"{root}/KITTI"} if root else {}
     val_dataset = datasets.KITTI(aug_params=None, image_set="training", **kw)
-    forward = make_eval_forward(params, cfg, iters, mixed_prec, mesh=mesh)
+    forward = make_eval_forward(params, cfg, iters, mixed_prec, mesh=mesh,
+                                segments=segments)
 
     out_list, epe_list, elapsed_list = [], [], []
     # No decode prefetch here, unlike the other validators: the KITTI FPS
@@ -217,12 +235,14 @@ def validate_kitti(params, cfg, iters: int = 32, mixed_prec: bool = False,
 
 def validate_things(params, cfg, iters: int = 32, mixed_prec: bool = False,
                     root: Optional[str] = None, mesh=None,
-                    bucket: Optional[int] = None) -> Dict[str, float]:
+                    bucket: Optional[int] = None,
+                    segments: int = 1) -> Dict[str, float]:
     """FlyingThings3D finalpass TEST subset: EPE + D1(>1px, |gt|<192)."""
     kw = {"root": root} if root else {}
     val_dataset = datasets.SceneFlowDatasets(
         aug_params=None, dstype="frames_finalpass", things_test=True, **kw)
-    forward = make_eval_forward(params, cfg, iters, mixed_prec, mesh=mesh)
+    forward = make_eval_forward(params, cfg, iters, mixed_prec, mesh=mesh,
+                                segments=segments)
 
     out_list, epe_list = [], []
     for val_id, sample in enumerate(prefetch_samples(val_dataset)):
@@ -243,11 +263,13 @@ def validate_things(params, cfg, iters: int = 32, mixed_prec: bool = False,
 def validate_middlebury(params, cfg, iters: int = 32, split: str = "F",
                         mixed_prec: bool = False, root: Optional[str] = None,
                         mesh=None,
-                        bucket: Optional[int] = None) -> Dict[str, float]:
+                        bucket: Optional[int] = None,
+                        segments: int = 1) -> Dict[str, float]:
     """Middlebury V3: EPE + D1(>2px), per-image averaging."""
     kw = {"root": f"{root}/Middlebury"} if root else {}
     val_dataset = datasets.Middlebury(aug_params=None, split=split, **kw)
-    forward = make_eval_forward(params, cfg, iters, mixed_prec, mesh=mesh)
+    forward = make_eval_forward(params, cfg, iters, mixed_prec, mesh=mesh,
+                                segments=segments)
 
     out_list, epe_list = [], []
     for val_id, sample in enumerate(prefetch_samples(val_dataset)):

@@ -10,7 +10,7 @@ value — no environment-variable side channels, no wall-clock, no global
 state — so an injected fault fires at exactly the same sample/step/byte on
 every run, every host, every worker-thread schedule.
 
-The four injectors map one-to-one onto the recovery paths:
+The four training injectors map one-to-one onto the recovery paths:
 
 - ``io_errors``      -> loader retry + quarantine + deterministic substitution;
 - ``nan_at_steps``   -> ``optax.apply_if_finite`` skip policy + bounded abort;
@@ -18,6 +18,12 @@ The four injectors map one-to-one onto the recovery paths:
   fallback to the previous good bundle;
 - ``sigterm_at_step``-> ``PreemptGuard`` checkpoint-and-exit + schedule-exact
   resume.
+
+The serving injectors (:class:`ServeFaultPlan` et al., bottom of this
+module) do the same for ``raft_stereo_tpu/serve/``: plan-driven compile
+failures / RESOURCE_EXHAUSTED on the Nth program build, injected slow
+forwards on a deterministic :class:`FakeClock` (deadline overruns),
+NaN-poisoned outputs, and malformed-input generators.
 """
 
 from __future__ import annotations
@@ -115,3 +121,188 @@ def truncate_file(path: str, keep_bytes: Optional[int] = None,
     with open(path, "rb+") as f:
         f.truncate(keep)
     return keep
+
+
+# ---------------------------------------------------------------------------
+# Serving-layer injectors (raft_stereo_tpu/serve/). Same stance as the
+# training injectors above: every fault is driven by an explicit plan value
+# keyed on deterministic ordinals — no env vars, no randomness — so a storm
+# replays identically on every run. The three injectors map onto the three
+# serving recovery paths:
+#
+# - ``compile_errors``  -> circuit-breaker trip + fallback-ladder rebuild
+#                          (serve/guard.py);
+# - ``slow_forwards``   -> deadline-aware anytime degradation
+#                          (serve/degrade.py best-so-far early return);
+# - ``poison_outputs`` / ``malformed_pairs`` -> output validation + parity
+#                          canary, and admission control (serve/validate.py).
+
+
+class InjectedKernelError(RuntimeError):
+    """Stands in for the compile/runtime failures a TPU fast path can
+    throw (Mosaic lowering failure, XLA ``RESOURCE_EXHAUSTED``). The
+    message carries the same marker substrings the circuit breaker
+    classifies real failures by."""
+
+    def __init__(self, kind: str, detail: str = ""):
+        self.kind = kind
+        messages = {
+            "mosaic": "Mosaic lowering failed (injected)",
+            "oom": "RESOURCE_EXHAUSTED: out of memory while allocating "
+                   "(injected)",
+        }
+        msg = messages.get(kind, kind)
+        if detail:
+            msg = f"{msg} [{detail}]"
+        super().__init__(msg)
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeFaultPlan:
+    """Declarative fault schedule for one :class:`~raft_stereo_tpu.serve.
+    session.InferenceSession`; all coordinates are deterministic ordinals.
+
+    compile_errors: program-build ordinal (0-based count of compile
+        attempts in the session, across breaker rebuilds) -> failure kind:
+        ``'mosaic'`` / ``'oom'``, optionally suffixed ``':<detail>'``
+        whose detail text lets the breaker's matchers attribute the
+        failure to a specific fast path (e.g. ``'mosaic:gru1632'``).
+    slow_builds: program-build ordinal -> real seconds to sleep inside the
+        (per-bucket-locked) compile, widening the race window the compile
+        locks must close.
+    slow_forwards: device-invocation ordinal (0-based count of program
+        executions: warmups, canary runs and request forwards all count)
+        -> seconds of injected device-time, advanced on the session's
+        clock (a :class:`FakeClock` makes deadline tests instantaneous
+        and exact).
+    poison_outputs: device-invocation ordinals whose disparity output is
+        NaN-corrupted after the forward — models a silently wrong kernel;
+        must be caught by output validation or the parity canary, never
+        served.
+    """
+
+    compile_errors: Mapping[int, str] = dataclasses.field(default_factory=dict)
+    slow_builds: Mapping[int, float] = dataclasses.field(default_factory=dict)
+    slow_forwards: Mapping[int, float] = dataclasses.field(default_factory=dict)
+    poison_outputs: Tuple[int, ...] = ()
+
+
+class ServeFaults:
+    """Lock-protected ordinal counters binding a :class:`ServeFaultPlan`
+    to one session (mirrors :class:`FaultyDataset` for the loader)."""
+
+    def __init__(self, plan: Optional[ServeFaultPlan], clock=None):
+        self.plan = plan
+        self.clock = clock
+        self.builds = 0
+        self.forwards = 0
+        self._lock = threading.Lock()
+
+    def on_build(self) -> int:
+        """Fire at each program-compile attempt; raises the injected
+        compile failure for this ordinal, if any."""
+        with self._lock:
+            n = self.builds
+            self.builds = n + 1
+        if self.plan is None:
+            return n
+        slow = self.plan.slow_builds.get(n)
+        if slow:
+            import time
+            time.sleep(slow)
+        kind = self.plan.compile_errors.get(n)
+        if kind is not None:
+            base, _, detail = kind.partition(":")
+            raise InjectedKernelError(base, detail)
+        return n
+
+    def on_forward(self) -> int:
+        """Fire after each device-program invocation; advances the
+        session clock by any injected slowness. Returns the ordinal so
+        the caller can apply ``poisoned()``."""
+        with self._lock:
+            n = self.forwards
+            self.forwards = n + 1
+        if self.plan is not None:
+            slow = self.plan.slow_forwards.get(n)
+            if slow and self.clock is not None:
+                self.clock.sleep(slow)
+        return n
+
+    def poisoned(self, ordinal: int) -> bool:
+        return self.plan is not None and ordinal in self.plan.poison_outputs
+
+
+def poison_disparity(arr: np.ndarray) -> np.ndarray:
+    """NaN-corrupt a disparity field (injected silently-wrong kernel).
+    Poisons the CENTER pixel — corner pixels sit in the bucket padding and
+    would be sliced away before output validation ever saw them."""
+    out = np.array(arr, copy=True)
+    out[tuple(s // 2 for s in out.shape)] = np.nan
+    return out
+
+
+class FakeClock:
+    """Deterministic clock for deadline tests: ``now()`` advances only via
+    ``sleep()``, so an injected 10-second overrun costs zero wall time and
+    deadline arithmetic is exact on any machine. The serving layer takes
+    any object with this interface; production uses :class:`RealClock`."""
+
+    def __init__(self, start: float = 0.0):
+        self._now = float(start)
+        self._lock = threading.Lock()
+
+    def now(self) -> float:
+        with self._lock:
+            return self._now
+
+    def sleep(self, seconds: float) -> None:
+        with self._lock:
+            self._now += float(seconds)
+
+
+class RealClock:
+    """Monotonic wall clock (the serving default)."""
+
+    @staticmethod
+    def now() -> float:
+        import time
+        return time.monotonic()
+
+    @staticmethod
+    def sleep(seconds: float) -> None:
+        import time
+        time.sleep(seconds)
+
+
+def malformed_pairs(h: int = 48, w: int = 64,
+                    oversize_pixels: Optional[int] = None) -> Dict[str, Tuple]:
+    """Generators for the admission-control test battery: each entry is a
+    ``name -> (left, right)`` pair that a serving session must REJECT with
+    a structured error (never crash on, never silently serve).
+
+    ``oversize_pixels``: admission limit to exceed for the ``oversized``
+    case (omitted when None — building a >limit array may be expensive)."""
+    rng = np.random.default_rng(7)
+
+    def img(hh=h, ww=w, c=3):
+        return rng.uniform(0, 255, size=(hh, ww, c)).astype(np.float32)
+
+    good = img()
+    nan_img = img()
+    nan_img[0, 0, 0] = np.nan
+    inf_img = img()
+    inf_img[-1, -1, -1] = np.inf
+    pairs: Dict[str, Tuple] = {
+        "nan_pixels": (nan_img, img()),
+        "inf_pixels": (good, inf_img),
+        "five_channel": (img(c=5), img(c=5)),
+        "zero_area": (img(hh=0), img(hh=0)),
+        "mismatched_shapes": (img(), img(ww=w + 4)),
+        "wrong_rank": (rng.uniform(0, 255, size=(h, w)).astype(np.float32),) * 2,
+        "not_an_array": ([[1.0, 2.0], [3.0, 4.0]], good),
+    }
+    if oversize_pixels is not None:
+        side = int(np.ceil(np.sqrt(oversize_pixels))) + 1
+        pairs["oversized"] = (img(hh=side, ww=side), img(hh=side, ww=side))
+    return pairs

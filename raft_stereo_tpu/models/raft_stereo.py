@@ -118,30 +118,26 @@ def _context_and_features(params: Params, cfg: RAFTStereoConfig,
     return net_list, inp_list, fmap1, fmap2
 
 
-def raft_stereo_forward(params: Params, cfg: RAFTStereoConfig,
-                        image1: jax.Array, image2: jax.Array, *,
-                        iters: int = 12,
-                        flow_init: Optional[jax.Array] = None,
-                        test_mode: bool = False,
-                        unroll: bool = False,
-                        space_mesh=None):
-    """Estimate disparity for a rectified stereo pair.
+def _refinement_closures(params: Params, cfg: RAFTStereoConfig,
+                         net, inp, fmap1: jax.Array, fmap2: jax.Array, *,
+                         compute_dtype, test_mode: bool,
+                         flow_init: Optional[jax.Array] = None,
+                         space_mesh=None):
+    """Scan-body machinery shared by the single-scan forward and the
+    segmented inference path (:func:`raft_stereo_segment`).
 
-    image1/image2: (B, H, W, 3) in [0, 255].
-    Train mode returns per-iteration upsampled predictions
-    ``(iters, B, H, W, 1)``; test mode returns ``(low_res_flow, final_up)``
-    (reference :126-141). Disparity is ``-flow[..., 0]``.
-
-    ``space_mesh``: the mesh whose ``space`` axis shards image height in
-    the enclosing jit. The streaming scan-body kernels then run their
-    halo-exchange shard_map variants (the encoder kernels stay XLA —
-    their global instance-norm stats and full-H row streams do not cut).
+    ``net`` is the hidden-state tuple (used only for kernel fusability
+    shape/dtype checks — its values are carried by the caller); ``inp`` is
+    the post-zqr context triple list already cast to ``compute_dtype``;
+    ``fmap1``/``fmap2`` are the feature maps at 1/``downsample_factor``
+    resolution. Builds the correlation lookup, the loop-invariant
+    streaming-GRU context, and the ``one_iteration`` / ``upsampled``
+    closures — everything that, given a carried ``(net, coords1)``,
+    advances the refinement by one step. Returns
+    ``(coords0, one_iteration, upsampled, fused_engaged)`` where
+    ``fused_engaged`` says whether any streaming kernel context was built
+    (the train scan picks its remat policy from it).
     """
-    compute_dtype = jnp.bfloat16 if cfg.mixed_precision else jnp.float32
-    net_list, inp_list, fmap1, fmap2 = _context_and_features(
-        params, cfg, image1, image2, compute_dtype,
-        fused=cfg.fused_update and space_mesh is None)
-
     corr_fp32 = cfg.corr_implementation in ("reg", "alt")
     corr_dtype = jnp.float32 if corr_fp32 else compute_dtype
     # out_dtype = compute dtype: the Pallas kernels downcast in-kernel (an
@@ -152,14 +148,8 @@ def raft_stereo_forward(params: Params, cfg: RAFTStereoConfig,
                            num_levels=cfg.corr_levels, radius=cfg.corr_radius,
                            out_dtype=compute_dtype)
 
-    b, h, w, _ = net_list[0].shape
+    b, h, w, _ = fmap1.shape
     coords0 = coords_grid(b, h, w)
-    coords1 = coords_grid(b, h, w)
-    if flow_init is not None:
-        coords1 = coords1 + flow_init
-
-    net = tuple(x.astype(compute_dtype) for x in net_list)
-    inp = [tuple(c.astype(compute_dtype) for c in triple) for triple in inp_list]
     factor = cfg.downsample_factor
 
     # Pre-folded per-level GRU context for the streaming Pallas kernels —
@@ -243,6 +233,43 @@ def raft_stereo_forward(params: Params, cfg: RAFTStereoConfig,
         flow_x = (coords1 - coords0)[..., :1].astype(jnp.float32)
         return convex_upsample(flow_x, up_mask.astype(jnp.float32), factor)
 
+    fused_engaged = any(c is not None for c in fused_ctx)
+    return coords0, one_iteration, upsampled, fused_engaged
+
+
+def raft_stereo_forward(params: Params, cfg: RAFTStereoConfig,
+                        image1: jax.Array, image2: jax.Array, *,
+                        iters: int = 12,
+                        flow_init: Optional[jax.Array] = None,
+                        test_mode: bool = False,
+                        unroll: bool = False,
+                        space_mesh=None):
+    """Estimate disparity for a rectified stereo pair.
+
+    image1/image2: (B, H, W, 3) in [0, 255].
+    Train mode returns per-iteration upsampled predictions
+    ``(iters, B, H, W, 1)``; test mode returns ``(low_res_flow, final_up)``
+    (reference :126-141). Disparity is ``-flow[..., 0]``.
+
+    ``space_mesh``: the mesh whose ``space`` axis shards image height in
+    the enclosing jit. The streaming scan-body kernels then run their
+    halo-exchange shard_map variants (the encoder kernels stay XLA —
+    their global instance-norm stats and full-H row streams do not cut).
+    """
+    compute_dtype = jnp.bfloat16 if cfg.mixed_precision else jnp.float32
+    net_list, inp_list, fmap1, fmap2 = _context_and_features(
+        params, cfg, image1, image2, compute_dtype,
+        fused=cfg.fused_update and space_mesh is None)
+
+    net = tuple(x.astype(compute_dtype) for x in net_list)
+    inp = [tuple(c.astype(compute_dtype) for c in triple) for triple in inp_list]
+    coords0, one_iteration, upsampled, fused_engaged = _refinement_closures(
+        params, cfg, net, inp, fmap1, fmap2, compute_dtype=compute_dtype,
+        test_mode=test_mode, flow_init=flow_init, space_mesh=space_mesh)
+    coords1 = coords0
+    if flow_init is not None:
+        coords1 = coords1 + flow_init
+
     if unroll:  # reference-style Python loop, for debugging and parity checks
         flow_predictions = []
         up_mask = None
@@ -283,7 +310,7 @@ def raft_stereo_forward(params: Params, cfg: RAFTStereoConfig,
     # policy additionally saves their tagged outputs so each kernel
     # forward runs ONCE — remat would otherwise re-run every pallas_call
     # on top of the XLA-oracle backward.
-    if any(c is not None for c in fused_ctx):
+    if fused_engaged:
         ckpt = jax.checkpoint(
             step, policy=jax.checkpoint_policies.save_only_these_names(
                 "stream_kernel"))
@@ -292,3 +319,110 @@ def raft_stereo_forward(params: Params, cfg: RAFTStereoConfig,
     (net, coords1), flow_predictions = lax.scan(
         ckpt, (net, coords1), None, length=iters)
     return flow_predictions
+
+
+# ---------------------------------------------------------------------------
+# Segmented (anytime) inference. RAFT-Stereo's refinement is an anytime
+# algorithm — every GRU iteration yields a valid disparity field — and the
+# serving layer (raft_stereo_tpu/serve/) exploits that for deadline-aware
+# degradation: the scan runs as k host-visible segments of m iterations, the
+# wall clock is checked between segments, and the best-so-far upsampled field
+# is returned when the budget runs out. The split point is the refinement
+# carry ``(net, coords1)``: the segment program below runs the SAME scan body
+# as the single-scan test-mode forward, so k segments of m iters compose
+# bit-identically to one k*m-iter scan (pinned by tests/test_serve.py).
+
+def raft_stereo_prepare(params: Params, cfg: RAFTStereoConfig,
+                        image1: jax.Array, image2: jax.Array, *,
+                        flow_init: Optional[jax.Array] = None):
+    """Encoder half of test-mode inference: everything outside the GRU scan.
+
+    Runs the context/feature networks and the zqr context convs, and builds
+    the initial refinement carry. Returns a dict pytree of arrays only —
+    ``net`` (tuple of hidden states), ``inp`` (tuple of context (z, r, q)
+    triples), ``fmap1``/``fmap2`` (feature maps the correlation volume is
+    rebuilt from), ``coords1`` — so it crosses ``jax.jit`` boundaries and
+    feeds :func:`raft_stereo_segment`.
+    """
+    compute_dtype = jnp.bfloat16 if cfg.mixed_precision else jnp.float32
+    net_list, inp_list, fmap1, fmap2 = _context_and_features(
+        params, cfg, image1, image2, compute_dtype, fused=cfg.fused_update)
+    net = tuple(x.astype(compute_dtype) for x in net_list)
+    inp = tuple(tuple(c.astype(compute_dtype) for c in triple)
+                for triple in inp_list)
+    b, h, w, _ = fmap1.shape
+    coords1 = coords_grid(b, h, w)
+    if flow_init is not None:
+        coords1 = coords1 + flow_init
+    return {"net": net, "inp": inp, "fmap1": fmap1, "fmap2": fmap2,
+            "coords1": coords1}
+
+
+def raft_stereo_segment(params: Params, cfg: RAFTStereoConfig, state, *,
+                        iters: int, warm_start: bool = False):
+    """Advance the refinement scan ``iters`` steps from a carried state.
+
+    ``state`` is the carry from :func:`raft_stereo_prepare` or a previous
+    segment. The scan body is the one the single-scan test-mode forward
+    compiles — the correlation pyramid is rebuilt from the carried feature
+    maps by the same deterministic ops, so composing segments never changes
+    a bit relative to one long scan. Returns ``(new_state, flow_low,
+    flow_up)``: the low-res flow and the convex-upsampled disparity field
+    after these iterations (the mask head runs once at the segment end,
+    exactly like the single-scan path runs it once after its scan).
+
+    ``warm_start`` mirrors ``flow_init is not None`` in the single-scan
+    forward (it disables motion-encoder fusion the same way).
+    """
+    compute_dtype = jnp.bfloat16 if cfg.mixed_precision else jnp.float32
+    net = tuple(state["net"])
+    inp = [tuple(triple) for triple in state["inp"]]
+    # flow_init only steers the fuse_motion flag here; the carried coords1
+    # already contains any warm-start offset.
+    fake_init = state["coords1"] if warm_start else None
+    coords0, one_iteration, upsampled, _ = _refinement_closures(
+        params, cfg, net, inp, state["fmap1"], state["fmap2"],
+        compute_dtype=compute_dtype, test_mode=True, flow_init=fake_init)
+
+    def step(carry, _):
+        net, coords1 = carry
+        net, coords1, _ = one_iteration(net, coords1, compute_mask=False)
+        return (net, coords1), None
+
+    (net, coords1), _ = lax.scan(step, (net, state["coords1"]), None,
+                                 length=iters)
+    up_mask = apply_mask_head(params["update_block"], net[0])
+    new_state = dict(state, net=net, coords1=coords1)
+    return new_state, coords1 - coords0, upsampled(coords1, up_mask)
+
+
+def raft_stereo_inference(params: Params, cfg: RAFTStereoConfig,
+                          image1: jax.Array, image2: jax.Array, *,
+                          iters: int = 32, segments: int = 1,
+                          flow_init: Optional[jax.Array] = None):
+    """Test-mode forward with the scan split into ``segments`` chunks.
+
+    ``segments=1`` delegates to :func:`raft_stereo_forward` in test mode —
+    the exact single-scan program, byte-identical outputs. ``segments=k``
+    chains k scans of ``iters // k`` steps through the carried state
+    (``iters`` must divide evenly). Traceable either way, so callers can
+    jit the whole thing; the serving layer instead jits prepare and segment
+    separately to get host control between segments. Returns
+    ``(flow_low, flow_up)``.
+    """
+    if segments < 1:
+        raise ValueError(f"segments must be >= 1, got {segments}")
+    if segments == 1:
+        return raft_stereo_forward(params, cfg, image1, image2, iters=iters,
+                                   flow_init=flow_init, test_mode=True)
+    if iters % segments:
+        raise ValueError(
+            f"iters ({iters}) must be divisible by segments ({segments})")
+    state = raft_stereo_prepare(params, cfg, image1, image2,
+                                flow_init=flow_init)
+    flow_low = flow_up = None
+    for _ in range(segments):
+        state, flow_low, flow_up = raft_stereo_segment(
+            params, cfg, state, iters=iters // segments,
+            warm_start=flow_init is not None)
+    return flow_low, flow_up

@@ -64,3 +64,17 @@ class InputPadder:
         l, r, t, b = self._pad
         ht, wd = x.shape[1], x.shape[2]
         return x[:, t:ht - b, l:wd - r, :]
+
+    def unpad_np(self, x: np.ndarray) -> np.ndarray:
+        """``unpad`` for host arrays — basic slicing works identically on
+        numpy, returning a view, so callers that already fetched the
+        result don't round-trip it through a device array."""
+        return self.unpad(x)
+
+
+def bucket_shape(dims: Sequence[int], bucket: int,
+                 divis_by: int = 8) -> Tuple[int, int]:
+    """Padded (H, W) that ``InputPadder(dims, bucket=bucket)`` would produce
+    — the serving layer's way to enumerate its compiled-shape buckets
+    without building padders."""
+    return InputPadder(dims, divis_by=divis_by, bucket=bucket).padded_shape

@@ -76,8 +76,13 @@ _ENC_VMEM = 110 * 2**20  # v5e has 128M physical
 
 import os as _os
 
-ENABLE = _os.environ.get("RAFT_FUSED_ENCODERS", "1").lower() not in (
-    "0", "false", "no", "")
+def ENABLE() -> bool:
+    """``RAFT_FUSED_ENCODERS`` kill switch, read at TRACE time (was an
+    import-time constant; the serving circuit breaker flips the env var at
+    runtime and rebuilds, which only works if every trace re-reads it —
+    same pattern as ``_tail_enabled``)."""
+    return _os.environ.get("RAFT_FUSED_ENCODERS", "1").lower() not in (
+        "0", "false", "no", "")
 
 
 def _strip_wb(width: int) -> int:
@@ -662,7 +667,7 @@ def packed_entry_conv(xp: jax.Array, w: jax.Array, b, *, window_w: int):
 
 def _fusable(p: dict, x, stride: int) -> bool:
     from raft_stereo_tpu.ops.pallas_stream import _dtype_ok
-    if not ENABLE:
+    if not ENABLE():
         return False
     if x.ndim != 4 or x.shape[2] % 2:
         return False
@@ -783,7 +788,7 @@ def _bias_row(b, ch: int):
 def resblock_streamable(p: dict, x, norm_fn: str) -> bool:
     """Stride-1 identity-shortcut block over a (1, H, W, C) activation."""
     from raft_stereo_tpu.ops.pallas_stream import _dtype_ok
-    if not (ENABLE and _tail_enabled() and norm_fn in ("batch", "instance")):
+    if not (ENABLE() and _tail_enabled() and norm_fn in ("batch", "instance")):
         return False
     if "downsample" in p or x.ndim != 4 or x.shape[0] != 1 or x.shape[1] < 8:
         return False
@@ -796,7 +801,7 @@ def resblock_streamable(p: dict, x, norm_fn: str) -> bool:
 def head_conv_streamable(pc: dict, x) -> bool:
     """3x3 pad-1 head conv over a (1, H, W, C) activation."""
     from raft_stereo_tpu.ops.pallas_stream import _dtype_ok
-    if not (ENABLE and _tail_enabled()):
+    if not (ENABLE() and _tail_enabled()):
         return False
     if x.ndim != 4 or x.shape[0] != 1 or x.shape[1] < 8:
         return False

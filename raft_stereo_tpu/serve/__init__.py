@@ -1,0 +1,41 @@
+"""Resilient inference subsystem (DESIGN.md "Serving & degradation").
+
+Layers, bottom up:
+
+- ``validate``  — admission control: structured rejection of malformed
+                  requests before they touch a device;
+- ``guard``     — kernel circuit breaker: risky fast paths declared with
+                  their XLA fallbacks; trips degrade the session one rung
+                  instead of killing the process;
+- ``session``   — shape-bucketed compile cache + output validation +
+                  breaker-driven rebuild/retry; the unit that owns params;
+- ``degrade``   — deadline-aware anytime policy over the segmented
+                  refinement scan (``models.raft_stereo_segment``);
+- ``service``   — bounded queue, backpressure, per-request deadlines,
+                  /healthz status.
+
+Everything is CPU-testable with deterministic injected faults
+(``raft_stereo_tpu.faults.ServeFaultPlan``).
+"""
+
+from raft_stereo_tpu.serve.guard import (  # noqa: F401
+    DEFAULT_LADDER,
+    FastPath,
+    KernelCircuitBreaker,
+)
+from raft_stereo_tpu.serve.service import (  # noqa: F401
+    ServiceConfig,
+    StereoService,
+)
+from raft_stereo_tpu.serve.session import (  # noqa: F401
+    DeadlineExceeded,
+    InferenceFailed,
+    InferenceResult,
+    InferenceSession,
+    SessionConfig,
+    SessionError,
+)
+from raft_stereo_tpu.serve.validate import (  # noqa: F401
+    AdmissionConfig,
+    InputRejected,
+)

@@ -1,0 +1,275 @@
+"""Kernel circuit breaker: trip a risky fast path, fall back, keep serving.
+
+The inference stack layers four hand-written fast paths over a plain-XLA
+baseline (DESIGN.md r6): the streamed encoder tail, the co-scheduled
+gru16+32 kernel, the packed Pallas correlation gather, and the Pallas corr
+implementations themselves. Each already has a kill switch — an env var or
+a config field — but as shipped those are operator knobs: a compile
+failure, a ``RESOURCE_EXHAUSTED``, or a parity drift in any one of them
+kills the process. This module turns the kill switches into a structured
+**fallback ladder**: every risky path is declared once with its switch and
+its XLA fallback; on a classified kernel failure the breaker trips one
+rung, the session rebuilds one step closer to plain XLA, and the trip is
+recorded in metrics. The process degrades; it does not die. The bottom of
+the ladder is the pure-XLA program, which has no rung below it by
+construction.
+
+Trips are one-way within a session lifetime (a tripped kernel is assumed
+broken until an operator resets — flapping between a crashing kernel and
+its fallback would re-pay a compile per flap).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, Mapping, Optional, Tuple, Union
+
+from raft_stereo_tpu.faults import InjectedKernelError
+
+# Parity canary drift band: the fast-path forward is compared against the
+# plain-XLA program on one bucketed pair at session startup. The bench gate
+# pins kernel checksums at rtol 5e-3 (BASELINE.md, r6); per-pixel disparity
+# gets the same relative band plus an absolute floor of 0.05 px — below any
+# metric threshold (the tightest eval threshold is D1 at 1 px).
+CANARY_RTOL = 5e-3
+CANARY_ATOL = 5e-2
+
+
+@dataclasses.dataclass(frozen=True)
+class FastPath:
+    """One risky fast path: its kill switch and its fallback.
+
+    env_var/env_off: existing env-var kill switch — tripping exports
+        ``env_var=env_off`` for every subsequent trace.
+    cfg_field/cfg_fallback: config-field switch — tripping rewrites the
+        session's run config. A dict fallback maps current -> fallback
+        value (e.g. ``reg_tpu -> reg``); a plain value replaces outright.
+    matchers: lowercase substrings that attribute a failure message to
+        THIS path (kept specific — a generic failure falls back to
+        ladder order instead of guessing).
+    """
+
+    name: str
+    description: str
+    env_var: Optional[str] = None
+    env_off: str = "0"
+    cfg_field: Optional[str] = None
+    cfg_fallback: Union[None, bool, Mapping[str, str]] = None
+    matchers: Tuple[str, ...] = ()
+
+
+# Ladder order = cheapest capability loss first. Tripping fuse_gru1632
+# costs ~latency only; the last two rungs abandon the streaming encoder
+# stems and every streaming scan-body kernel — with corr already mapped
+# to its XLA twin, the bottom of the ladder is a genuinely kernel-free
+# forward (this is also what the parity canary compares against).
+DEFAULT_LADDER: Tuple[FastPath, ...] = (
+    FastPath(
+        name="fuse_gru1632",
+        description="co-scheduled gru16+32 streaming kernel "
+                    "(ops/pallas_stream.py fused_gru1632)",
+        env_var="RAFT_FUSE_GRU1632",
+        matchers=("gru1632", "gru16+32"),
+    ),
+    FastPath(
+        name="stream_tail",
+        description="streamed encoder tail — raw1/mid1/point2 passes "
+                    "(ops/pallas_encoder.py)",
+        env_var="RAFT_STREAM_TAIL",
+        matchers=("stream_tail", "raw1", "point2"),
+    ),
+    FastPath(
+        name="packed_l2",
+        description="packed layer2 bit-layout in the encoder stems "
+                    "(models/extractor.py RAFT_PACKED_L2)",
+        env_var="RAFT_PACKED_L2",
+        matchers=("packed_l2", "packed stem"),
+    ),
+    FastPath(
+        name="corr_kernel",
+        description="Pallas correlation gather, packed pyramid "
+                    "(corr/pallas_reg.py / pallas_alt.py) -> XLA twin",
+        cfg_field="corr_implementation",
+        cfg_fallback={"reg_tpu": "reg", "alt_tpu": "alt",
+                      "reg_cuda": "reg", "alt_cuda": "alt"},
+        matchers=("pallas_reg", "pallas_alt", "gather_lerp",
+                  "corr_kernel", "corr lookup"),
+    ),
+    FastPath(
+        name="fused_encoders",
+        description="one-pass-per-conv streaming encoder stems "
+                    "(ops/pallas_encoder.py RAFT_FUSED_ENCODERS)",
+        env_var="RAFT_FUSED_ENCODERS",
+        matchers=("fused_encoder", "pallas_encoder", "encoder stem"),
+    ),
+    FastPath(
+        name="fused_update",
+        description="streaming scan-body kernels — fused ConvGRU / motion "
+                    "encoder / flow head (cfg.fused_update) -> plain XLA",
+        cfg_field="fused_update",
+        cfg_fallback=False,
+        matchers=("pallas_stream", "fused_motion", "fused_gru",
+                  "flow_head"),
+    ),
+)
+
+# Failure-classifier markers: a raised exception is a *kernel* failure —
+# breaker territory — only if it is an injected kernel error, an XLA
+# runtime error, or its message carries one of these. Anything else
+# (a TypeError in our own code, a KeyboardInterrupt) must propagate.
+_KERNEL_FAILURE_MARKERS = (
+    "resource_exhausted", "out of memory", "mosaic", "pallas",
+    "internal: ", "xla runtime error",
+)
+
+
+def is_kernel_failure(exc: BaseException) -> bool:
+    if isinstance(exc, InjectedKernelError):
+        return True
+    if type(exc).__name__ in ("XlaRuntimeError", "JaxRuntimeError"):
+        return True
+    msg = str(exc).lower()
+    return any(m in msg for m in _KERNEL_FAILURE_MARKERS)
+
+
+@dataclasses.dataclass
+class TripRecord:
+    path: str
+    reason: str          # 'compile_failure' | 'runtime_failure' |
+                         # 'canary_mismatch' | 'manual'
+    error: str = ""
+    count: int = 1
+    at: float = dataclasses.field(default_factory=time.time)
+
+
+class LadderExhausted(RuntimeError):
+    """Every rung is tripped and the plain-XLA program still failed."""
+
+
+class KernelCircuitBreaker:
+    """Trip registry + fallback ladder for one serving process.
+
+    Thread-safe; shared between an :class:`~raft_stereo_tpu.serve.session.
+    InferenceSession` and its service wrapper so /healthz sees trips the
+    moment they happen.
+    """
+
+    def __init__(self, ladder: Tuple[FastPath, ...] = DEFAULT_LADDER):
+        self.ladder = tuple(ladder)
+        self._by_name = {p.name: p for p in self.ladder}
+        if len(self._by_name) != len(self.ladder):
+            raise ValueError("duplicate fast-path names in ladder")
+        self._tripped: Dict[str, TripRecord] = {}
+        self._lock = threading.Lock()
+
+    # -- state ------------------------------------------------------------
+
+    @property
+    def tripped_names(self) -> Tuple[str, ...]:
+        with self._lock:
+            return tuple(self._tripped)
+
+    @property
+    def trip_count(self) -> int:
+        with self._lock:
+            return sum(r.count for r in self._tripped.values())
+
+    @property
+    def exhausted(self) -> bool:
+        """True when the session is already at plain XLA (no rung left)."""
+        with self._lock:
+            return len(self._tripped) == len(self.ladder)
+
+    def fingerprint(self) -> Tuple[str, ...]:
+        """Stable component of compile-cache keys: programs traced under a
+        different trip set must never be served for this one."""
+        with self._lock:
+            return tuple(sorted(self._tripped))
+
+    # -- classification ---------------------------------------------------
+
+    def classify(self, exc: BaseException) -> Optional[FastPath]:
+        """The rung to trip for this failure: the first *untripped* path
+        whose matchers hit the message, else the first untripped path in
+        ladder order (a generic OOM/compile failure walks the ladder top
+        down), else None — the ladder is exhausted."""
+        msg = str(exc).lower()
+        with self._lock:
+            untripped = [p for p in self.ladder if p.name not in self._tripped]
+        for p in untripped:
+            if any(m in msg for m in p.matchers):
+                return p
+        return untripped[0] if untripped else None
+
+    # -- transitions ------------------------------------------------------
+
+    def trip(self, name: str, reason: str,
+             error: Optional[BaseException] = None) -> TripRecord:
+        if name not in self._by_name:
+            raise KeyError(f"unknown fast path {name!r}")
+        with self._lock:
+            rec = self._tripped.get(name)
+            if rec is None:
+                rec = TripRecord(path=name, reason=reason,
+                                 error=str(error) if error else "")
+                self._tripped[name] = rec
+            else:  # repeated failure attributed to an already-dark path
+                rec.count += 1
+            return rec
+
+    def reset(self) -> None:
+        """Operator action: forget all trips (e.g. after a driver fix)."""
+        with self._lock:
+            self._tripped.clear()
+
+    # -- application ------------------------------------------------------
+
+    def apply(self, cfg, tripped: Optional[Tuple[str, ...]] = None):
+        """Project a trip set (default: the current one) onto a run config
+        + env overrides.
+
+        Returns ``(run_cfg, env)`` where ``run_cfg`` is ``cfg`` with every
+        tripped config-field switch rewritten and ``env`` maps each
+        tripped env-var switch to its off value — to be exported around
+        every trace of a serving program.
+        """
+        overrides = {}
+        env: Dict[str, str] = {}
+        if tripped is None:
+            with self._lock:
+                tripped = tuple(self._tripped)
+        for name in tripped:
+            p = self._by_name[name]
+            if p.env_var is not None:
+                env[p.env_var] = p.env_off
+            if p.cfg_field is not None:
+                if isinstance(p.cfg_fallback, Mapping):
+                    cur = getattr(cfg, p.cfg_field)
+                    new = p.cfg_fallback.get(cur, cur)
+                else:
+                    new = p.cfg_fallback
+                overrides[p.cfg_field] = new
+        run_cfg = (cfg if not overrides
+                   else type(cfg)(**{**cfg.__dict__, **overrides}))
+        return run_cfg, env
+
+    def plain_xla_cfg(self, cfg):
+        """``cfg`` with EVERY ladder switch at its fallback — the parity
+        canary's reference program, independent of the current trip set."""
+        return self.apply(cfg, tripped=tuple(p.name for p in self.ladder))
+
+    # -- reporting --------------------------------------------------------
+
+    def status(self) -> Dict:
+        with self._lock:
+            return {
+                "ladder": [p.name for p in self.ladder],
+                "tripped": {
+                    name: {"reason": r.reason, "error": r.error,
+                           "count": r.count, "at": r.at}
+                    for name, r in self._tripped.items()},
+                "trip_count": sum(r.count for r in self._tripped.values()),
+                "exhausted": len(self._tripped) == len(self.ladder),
+            }

@@ -1,0 +1,280 @@
+"""Stdlib-only request service over an InferenceSession.
+
+Queueing discipline for a latency-bound model server, with nothing but
+``threading`` + ``queue``:
+
+- **bounded depth + explicit backpressure**: a full queue rejects the
+  request *immediately* (``queue_full``) instead of stretching every
+  caller's latency without bound;
+- **admission control at submit time**: malformed inputs (validate.py)
+  never occupy a queue slot or a device;
+- **per-request deadlines**: requests that expire while queued are
+  rejected on dequeue without touching the device; live ones carry their
+  absolute deadline into the session's degrade policy;
+- **crash-proof workers**: a worker turns *any* session failure into a
+  structured error response — one poisoned request cannot take the
+  process down (fault-storm-pinned in tests/test_serve.py);
+- **/healthz**: ``status()`` folds session state (bucket cache, breaker
+  trips, canary), queue depth, request counters by rejection/error code,
+  degraded-request count, and p50/p99 latency over a sliding window.
+
+Every response is a plain dict: ``{"status": "ok" | "rejected" |
+"error", ...}`` — ``ok`` always carries a finite disparity and an honest
+``quality`` label.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+from collections import Counter, deque
+from concurrent.futures import Future
+from typing import Dict, Optional
+
+from raft_stereo_tpu.serve.session import (DeadlineExceeded, InferenceSession,
+                                           SessionError)
+from raft_stereo_tpu.serve.validate import InputRejected, validate_pair
+
+
+@dataclasses.dataclass(frozen=True)
+class ServiceConfig:
+    max_queue: int = 8
+    workers: int = 1
+    # Applied when a request carries no deadline_ms of its own; None means
+    # undegraded full-iteration serving by default.
+    default_deadline_ms: Optional[float] = None
+    latency_window: int = 512
+
+
+def _reject(code: str, message: str) -> Dict:
+    return {"status": "rejected", "code": code, "message": message}
+
+
+def _error(code: str, message: str) -> Dict:
+    return {"status": "error", "code": code, "message": message}
+
+
+class StereoService:
+    """Request queue + worker pool around one :class:`InferenceSession`."""
+
+    def __init__(self, session: InferenceSession,
+                 service_cfg: Optional[ServiceConfig] = None):
+        self.session = session
+        self.cfg = service_cfg or ServiceConfig()
+        self._queue: "queue.Queue" = queue.Queue(maxsize=self.cfg.max_queue)
+        self._workers = []
+        self._stop = threading.Event()
+        self._counts: Counter = Counter()
+        self._latencies: deque = deque(maxlen=self.cfg.latency_window)
+        self._lock = threading.Lock()
+        self._started = False
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> "StereoService":
+        with self._lock:
+            if self._started:
+                return self
+            self._started = True
+            # Fresh event per generation: a worker that outlives a timed-out
+            # join (e.g. mid-compile on a cold bucket) still holds its OWN
+            # generation's set event and exits when its request finishes —
+            # it can never be revived as an untracked extra worker.
+            self._stop = threading.Event()
+            stop_event = self._stop
+        for i in range(self.cfg.workers):
+            t = threading.Thread(target=self._worker_loop,
+                                 args=(stop_event,),
+                                 name=f"stereo-worker-{i}", daemon=True)
+            t.start()
+            self._workers.append(t)
+        return self
+
+    def stop(self) -> None:
+        with self._lock:
+            # Flip first, under the same lock submit() enqueues under: any
+            # submit that saw started=True has already enqueued, so the
+            # drain below provably sees it; later submits are rejected.
+            self._started = False
+            self._stop.set()
+        for _ in self._workers:
+            try:
+                self._queue.put_nowait(None)  # wake sentinel
+            except queue.Full:
+                break  # queue backlog itself will wake the workers
+        for t in self._workers:
+            t.join(timeout=10)
+        # Resolve every still-queued Future with a structured rejection —
+        # an abandoned Future deadlocks any caller blocked on .result().
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if item is None:
+                continue
+            request, fut = item
+            resp = _reject("service_stopped",
+                           "service stopped before this request ran")
+            if request.get("id") is not None:
+                resp["id"] = request["id"]
+            with self._lock:
+                self._counts["rejected:service_stopped"] += 1
+            try:
+                fut.set_result(resp)
+            except Exception:  # already resolved/cancelled
+                pass
+        self._workers = [t for t in self._workers if t.is_alive()]
+
+    def __enter__(self) -> "StereoService":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- request path -----------------------------------------------------
+
+    def _admit(self, request: Dict) -> Optional[Dict]:
+        """Validation + deadline stamping; returns a rejection dict or
+        None. Mutates ``request``: the absolute ``_deadline`` is stamped
+        and left/right are replaced with their validated canonical form,
+        so the session skips a second O(N) validation pass on dequeue."""
+        try:
+            request["left"], request["right"] = validate_pair(
+                request["left"], request["right"],
+                self.session.cfg.admission)
+        except InputRejected as e:
+            return _reject(f"invalid_input:{e.code}", str(e))
+        except KeyError as e:
+            return _reject("invalid_input:missing_field",
+                           f"request missing {e}")
+        deadline_ms = request.get("deadline_ms",
+                                  self.cfg.default_deadline_ms)
+        request["_deadline"] = (
+            None if deadline_ms is None
+            else self.session.clock.now() + deadline_ms / 1e3)
+        return None
+
+    def _respond(self, request: Dict) -> Dict:
+        """One request, synchronously, never raising."""
+        rid = request.get("id")
+        try:
+            deadline = request.get("_deadline")
+            if deadline is not None and self.session.clock.now() >= deadline:
+                resp = _reject("deadline_exceeded_in_queue",
+                               "deadline expired before the request "
+                               "reached a device")
+            else:
+                t0 = self.session.clock.now()
+                result = self.session.infer(
+                    request["left"], request["right"], deadline=deadline,
+                    allow_half_res=request.get("allow_half_res"),
+                    prevalidated=True)
+                with self._lock:
+                    self._latencies.append(
+                        self.session.clock.now() - t0)
+                resp = {
+                    "status": "ok",
+                    "quality": result.quality,
+                    "disparity": result.disparity,
+                    "iters": result.iters,
+                    "elapsed_ms": result.elapsed_s * 1e3,
+                    "deadline_missed": result.deadline_missed,
+                }
+        except InputRejected as e:
+            resp = _reject(f"invalid_input:{e.code}", str(e))
+        except DeadlineExceeded as e:
+            resp = _reject(e.code, str(e))
+        except SessionError as e:
+            resp = _error(e.code, str(e))
+        except Exception as e:  # noqa: BLE001 — the crash-proofing boundary
+            resp = _error("internal", f"{type(e).__name__}: {e}")
+        if rid is not None:
+            resp["id"] = rid
+        with self._lock:
+            key = resp["status"]
+            if resp["status"] != "ok":
+                key = f'{resp["status"]}:{resp["code"]}'
+            elif resp.get("quality") != "full":
+                self._counts["degraded"] += 1
+            self._counts[key] += 1
+        return resp
+
+    def handle(self, request: Dict) -> Dict:
+        """Synchronous path (no queue): admit, run, respond. The
+        fault-storm battery drives this for deterministic ordering."""
+        rejection = self._admit(request)
+        if rejection is not None:
+            if request.get("id") is not None:
+                rejection["id"] = request["id"]
+            with self._lock:
+                self._counts[f'rejected:{rejection["code"]}'] += 1
+            return rejection
+        return self._respond(request)
+
+    def submit(self, request: Dict) -> Future:
+        """Async path: admission + bounded enqueue. The returned Future
+        always resolves to a response dict (rejections included)."""
+        fut: Future = Future()
+        rejection = self._admit(request)
+        if rejection is None:
+            # started-check + enqueue under the lifecycle lock: stop()
+            # flips _started under the same lock before draining, so a
+            # request can never land in the queue after the drain.
+            with self._lock:
+                if not self._started:
+                    rejection = _reject("not_running",
+                                        "service is not started")
+                else:
+                    try:
+                        self._queue.put_nowait((request, fut))
+                    except queue.Full:
+                        rejection = _reject(
+                            "queue_full",
+                            f"queue depth {self.cfg.max_queue} reached — "
+                            "retry with backoff")
+        if rejection is not None:
+            if request.get("id") is not None:
+                rejection["id"] = request["id"]
+            with self._lock:
+                self._counts[f'rejected:{rejection["code"]}'] += 1
+            fut.set_result(rejection)
+        return fut
+
+    def _worker_loop(self, stop_event: threading.Event) -> None:
+        while not stop_event.is_set():
+            item = self._queue.get()
+            if item is None:  # stop sentinel
+                break
+            request, fut = item
+            try:
+                fut.set_result(self._respond(request))
+            except Exception as e:  # noqa: BLE001 — worker must survive
+                try:
+                    fut.set_result(_error("internal",
+                                          f"{type(e).__name__}: {e}"))
+                except Exception:  # future already resolved/cancelled
+                    pass
+
+    # -- health -----------------------------------------------------------
+
+    def status(self) -> Dict:
+        with self._lock:
+            lat = sorted(self._latencies)
+            counts = dict(self._counts)
+
+        def pct(p: float) -> Optional[float]:
+            if not lat:
+                return None
+            return lat[min(len(lat) - 1, int(p * len(lat)))] * 1e3
+
+        return {
+            "queue": {"depth": self._queue.qsize(),
+                      "max": self.cfg.max_queue,
+                      "workers": self.cfg.workers},
+            "requests": counts,
+            "latency_ms": {"p50": pct(0.50), "p99": pct(0.99),
+                           "n": len(lat)},
+            "session": self.session.status(),
+        }

@@ -1,0 +1,688 @@
+"""InferenceSession: params + config + a bounded cache of compiled shapes.
+
+The eval CLIs compile one program per padded shape and die on the first
+kernel failure; a server cannot. The session owns:
+
+- **shape bucketing**: every admitted pair is padded with ``InputPadder``
+  onto a multiple-of-``bucket`` shape, so arbitrary request sizes collapse
+  onto a handful of compiled programs (``bucket=32`` reproduces the
+  reference per-shape padding exactly — same formula — while still sharing
+  programs between requests that round to the same shape);
+- **an LRU-bounded compile cache** keyed by *(program kind, padded shape,
+  iteration count, full config fingerprint)* — the fingerprint covers every
+  forward-relevant config field plus the effective kernel env switches
+  (circuit-breaker trips are projected into those two, so an effective
+  trip re-keys), so two configs differing only in (say)
+  ``corr_implementation`` can never share a program (regression-pinned in
+  tests/test_serve.py);
+- **per-bucket compile locks**: two concurrent first requests for one
+  bucket compile once, requests for different buckets don't serialize
+  behind each other's compiles (tracing itself is serialized — env-switch
+  reads at trace time are process-global);
+- **output validation**: a non-finite disparity is a structured
+  ``InferenceFailed('nonfinite_output')``, never a silently served frame;
+- **the circuit breaker** (serve/guard.py): a classified kernel failure
+  trips one fallback rung, the session rebuilds and retries the same
+  request; an optional startup **parity canary** checks one bucketed
+  forward against the plain-XLA program inside the pinned drift band.
+
+All hooks are plan-driven (``faults.ServeFaultPlan``), so every recovery
+path here is CPU-testable with deterministic injected faults.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import logging
+import os
+import threading
+from collections import OrderedDict
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from raft_stereo_tpu.config import RAFTStereoConfig
+from raft_stereo_tpu.faults import (RealClock, ServeFaultPlan, ServeFaults,
+                                    poison_disparity)
+from raft_stereo_tpu.ops.padder import InputPadder
+from raft_stereo_tpu.serve.guard import (KernelCircuitBreaker, CANARY_ATOL,
+                                         CANARY_RTOL, is_kernel_failure)
+from raft_stereo_tpu.serve.validate import AdmissionConfig, validate_pair
+
+logger = logging.getLogger(__name__)
+
+# Env switches whose trace-time values shape the compiled program — part of
+# every cache key so a flipped switch (breaker trip or operator export) can
+# never be served a stale program (the compile-cache-staleness bug class).
+_ENV_KNOBS = ("RAFT_STREAM_TAIL", "RAFT_FUSE_GRU1632", "RAFT_FUSED_ENCODERS",
+              "RAFT_PACKED_L2", "RAFT_CORR_TILE", "RAFT_BATCH_FUSE_PIXELS")
+
+# Tracing mutates process-global env (the kernel kill switches are read at
+# trace time), so traces are serialized even across buckets.
+_TRACE_LOCK = threading.Lock()
+
+
+class SessionError(RuntimeError):
+    """Structured serving failure; ``code`` is machine-readable."""
+
+    def __init__(self, code: str, message: str):
+        self.code = code
+        super().__init__(message)
+
+
+class InferenceFailed(SessionError):
+    """The forward ran but its result cannot be served (non-finite
+    disparity), or every fallback rung failed (``ladder_exhausted``)."""
+
+
+class DeadlineExceeded(SessionError):
+    def __init__(self, message: str):
+        super().__init__("deadline_exceeded", message)
+
+
+@dataclasses.dataclass(frozen=True)
+class SessionConfig:
+    """Serving knobs, orthogonal to the model config.
+
+    valid_iters: refinement iterations for an undegraded request.
+    segments: how many host-visible chunks a deadline-carrying request
+        splits ``valid_iters`` into (must divide it). Between segments the
+        degrade policy checks the budget and can return best-so-far.
+    bucket: pad request shapes up to multiples of this (a multiple of 32);
+        32 == the reference per-shape padding formula.
+    max_programs: LRU bound on cached compiled programs.
+    warmup_shapes: (H, W) image shapes whose full-scan programs compile at
+        construction, so first requests don't pay the compile.
+    warmup_segmented: also pre-compile the prepare/segment programs for
+        each warmup shape (deadline-serving deployments want this).
+    canary: run the startup parity canary (fast path vs plain XLA within
+        the pinned drift band; mismatch trips the breaker).
+    canary_shape / canary_iters: geometry of the canary forward (small and
+        cheap by default; iteration count does not change which kernels
+        engage).
+    allow_half_res: let the degrade policy drop to half resolution when
+        the budget cannot fit even one full-res segment.
+    """
+
+    valid_iters: int = 32
+    segments: int = 4
+    bucket: int = 32
+    max_programs: int = 8
+    warmup_shapes: Tuple[Tuple[int, int], ...] = ()
+    warmup_segmented: bool = False
+    canary: bool = False
+    canary_shape: Tuple[int, int] = (64, 96)
+    canary_iters: int = 2
+    allow_half_res: bool = True
+    admission: AdmissionConfig = dataclasses.field(
+        default_factory=AdmissionConfig)
+
+    def __post_init__(self):
+        if self.bucket % 32:
+            raise ValueError(f"bucket must be a multiple of 32, "
+                             f"got {self.bucket}")
+        if self.valid_iters % self.segments:
+            raise ValueError(
+                f"segments ({self.segments}) must divide valid_iters "
+                f"({self.valid_iters})")
+
+
+@dataclasses.dataclass
+class InferenceResult:
+    """One served disparity field with an honest quality label."""
+
+    disparity: np.ndarray        # (H, W) float32, positive disparity
+    quality: str                 # 'full' | 'reduced_iters:<k>' | 'half_res'
+    iters: int                   # refinement iterations actually run
+    elapsed_s: float
+    padded_shape: Tuple[int, int]
+    deadline_missed: bool = False
+
+    @property
+    def degraded(self) -> bool:
+        return self.quality != "full"
+
+
+class _Program:
+    """One cached compiled program + its first-call lock. ``env`` is the
+    switch set the program must be TRACED under — the canary's plain-XLA
+    reference carries all-off switches regardless of the session's own."""
+
+    __slots__ = ("key", "fn", "kind", "env", "warmed", "lock")
+
+    def __init__(self, key, fn, kind, env):
+        self.key = key
+        self.fn = fn
+        self.kind = kind
+        self.env = dict(env)
+        self.warmed = False
+        self.lock = threading.Lock()
+
+
+@contextlib.contextmanager
+def _env_overrides(env: Dict[str, Optional[str]]):
+    """Export a FULLY RESOLVED switch set for the duration of a trace.
+    ``None`` means "unset" (several switches distinguish unset from empty),
+    so the trace provably sees exactly the values its program was keyed
+    under — even if another thread mutated the process env meanwhile."""
+    old = {k: os.environ.get(k) for k in env}
+    for k, v in env.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    try:
+        yield
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def resolve_env(overrides: Dict[str, str],
+                base: Optional[Dict[str, Optional[str]]] = None
+                ) -> Dict[str, Optional[str]]:
+    """A full kernel-switch mapping: the breaker override where present,
+    the ``base`` snapshot otherwise (``None`` value = unset; ``base=None``
+    reads the live process env). Both the cache key and the trace use THIS
+    mapping, so a program can never be keyed under one switch set and
+    traced under another. The session passes its construction-time env
+    snapshot as ``base`` — another thread's in-flight ``_env_overrides``
+    (which temporarily mutates the process env around a trace) can then
+    never bleed into a concurrent key. Override keys outside
+    ``_ENV_KNOBS`` are kept, never dropped — a ladder rung with a new env
+    var must actually reach the trace."""
+    keys = tuple(_ENV_KNOBS) + tuple(k for k in overrides
+                                     if k not in _ENV_KNOBS)
+    if base is None:
+        base = {k: os.environ.get(k) for k in keys}
+    return {k: (overrides[k] if k in overrides else base.get(k))
+            for k in keys}
+
+
+def config_fingerprint(cfg: RAFTStereoConfig,
+                       env: Dict[str, str]) -> Tuple:
+    """Every forward-relevant degree of freedom, hashable.
+
+    All config dataclass fields (not a hand-picked subset — a new field is
+    conservative-by-default in the key) and the effective value of each
+    kernel env switch (pass a :func:`resolve_env` mapping to pin one
+    snapshot). The breaker trip set is deliberately NOT part of the key:
+    ``breaker.apply`` projects every trip into cfg/env, so two trip sets
+    with the same projection compile the same program — keying on the
+    projection lets them share it (e.g. the canary's plain-XLA reference
+    survives a ladder walk instead of recompiling per trip).
+    """
+    cfg_part = tuple(sorted(
+        (f.name, repr(getattr(cfg, f.name)))
+        for f in dataclasses.fields(cfg)))
+    if set(env) >= set(_ENV_KNOBS):  # already a resolve_env snapshot
+        env_part = tuple(sorted(env.items()))
+    else:
+        env_part = tuple(sorted(resolve_env(env).items()))
+    return cfg_part, env_part
+
+
+class InferenceSession:
+    """Owns params + config; admits arbitrary pairs, serves disparity."""
+
+    def __init__(self, params, cfg: RAFTStereoConfig,
+                 session_cfg: Optional[SessionConfig] = None, *,
+                 breaker: Optional[KernelCircuitBreaker] = None,
+                 fault_plan: Optional[ServeFaultPlan] = None,
+                 clock=None):
+        import jax
+        self._jax = jax
+        self.cfg = session_cfg or SessionConfig()
+        self.clock = clock if clock is not None else RealClock()
+        self._params = params
+        self._base_cfg = cfg
+        # Kernel switches are captured ONCE, here: every cache key and
+        # every trace resolves against this snapshot (plus breaker
+        # overrides), so concurrent _env_overrides windows and operator
+        # env flips mid-process can never skew a key. Changing switches
+        # means a new session (or tripping the breaker).
+        self._env_base: Dict[str, Optional[str]] = {
+            k: os.environ.get(k) for k in _ENV_KNOBS}
+        self.breaker = breaker or KernelCircuitBreaker()
+        # Defense for the fingerprint/trace contract: a ladder rung whose
+        # env var the knob list didn't know about would still reach the
+        # trace (resolve_env keeps override keys), but keep the two lists
+        # visibly in sync anyway.
+        for p in self.breaker.ladder:
+            if p.env_var is not None and p.env_var not in _ENV_KNOBS:
+                logger.warning(
+                    "ladder rung %s uses env var %s not in the session "
+                    "knob list — add it to _ENV_KNOBS so untripped "
+                    "programs key on it too", p.name, p.env_var)
+        self.faults = ServeFaults(fault_plan, clock=self.clock)
+        self._cache: "OrderedDict[Tuple, _Program]" = OrderedDict()
+        self._cache_lock = threading.Lock()
+        self._key_locks: Dict[Tuple, threading.Lock] = {}
+        self._estimates: Dict[Tuple, float] = {}
+        self._est_lock = threading.Lock()
+        self._metrics = {
+            "compiles": 0, "evictions": 0, "requests_ok": 0,
+            "requests_failed": 0, "degraded": 0, "nonfinite_outputs": 0,
+            "rebuilds": 0,
+        }
+        self._metrics_lock = threading.Lock()
+        self._canary_state = {"enabled": self.cfg.canary, "ran": False,
+                              "passed": None, "attempts": 0}
+        self._run_cfg, self._env = self.breaker.apply(cfg)
+        self.start()
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        """Warm the configured buckets and run the parity canary. Called
+        from the constructor; safe to call again after ``breaker.reset()``.
+        Kernel failures here already walk the fallback ladder — a session
+        whose fast paths are broken comes up degraded, not dead."""
+        for (h, w) in self.cfg.warmup_shapes:
+            self._warm_shape(h, w)
+        if self.cfg.canary:
+            self._run_canary()
+
+    def _rebuild(self, why: str) -> None:
+        """Project the new trip set onto the run config. Cached programs
+        keyed under the old fingerprint become unreachable (and age out of
+        the LRU) — they are never served for the new config."""
+        self._run_cfg, self._env = self.breaker.apply(self._base_cfg)
+        with self._metrics_lock:
+            self._metrics["rebuilds"] += 1
+        logger.warning("session rebuilt one rung down (%s); tripped=%s",
+                       why, list(self.breaker.tripped_names))
+
+    def _breaker_retry(self, exc: Exception, phase: str) -> None:
+        """Classify a kernel failure, trip the rung, rebuild — or give up
+        with a structured error when the ladder is exhausted."""
+        path = self.breaker.classify(exc)
+        if path is None:
+            raise InferenceFailed(
+                "ladder_exhausted",
+                f"plain-XLA program still failing: {exc}") from exc
+        self.breaker.trip(path.name, phase, exc)
+        self._rebuild(f"{path.name}: {exc}")
+
+    # -- padding / bucketing ----------------------------------------------
+
+    def padder_for(self, shape) -> InputPadder:
+        return InputPadder(shape, divis_by=32, bucket=self.cfg.bucket)
+
+    # -- program cache ----------------------------------------------------
+
+    def _resolve(self, env: Dict[str, str]) -> Dict[str, Optional[str]]:
+        return resolve_env(env, self._env_base)
+
+    def _fingerprint(self, cfg=None, env=None) -> Tuple:
+        env = env if env is not None else self._env
+        if not (set(env) >= set(_ENV_KNOBS)):
+            env = self._resolve(env)
+        return config_fingerprint(
+            cfg if cfg is not None else self._run_cfg, env)
+
+    def cache_key(self, kind: str, h: int, w: int, iters: int,
+                  cfg=None, env=None) -> Tuple:
+        return (kind, h, w, iters, self._fingerprint(cfg, env))
+
+    def _build_fn(self, kind: str, cfg, iters: int):
+        import jax.numpy as jnp
+        from raft_stereo_tpu.models import (raft_stereo_forward,
+                                            raft_stereo_prepare,
+                                            raft_stereo_segment)
+        jax = self._jax
+        if kind == "full":
+            # The exact program engine/evaluate.make_eval_forward compiles
+            # (flow plus a checksum whose host fetch is the completion
+            # barrier) — byte-identical serving vs the eval/demo path.
+            def fwd(p, image1, image2):
+                _, flow_up = raft_stereo_forward(
+                    p, cfg, image1, image2, iters=iters, test_mode=True)
+                return flow_up, jnp.sum(flow_up.astype(jnp.float32))
+            return jax.jit(fwd)
+        if kind == "prepare":
+            def prep(p, image1, image2):
+                # 1-tuple so every program returns a tuple (invoke()'s
+                # fetch iterates outputs; the carry dict is one output).
+                return (raft_stereo_prepare(p, cfg, image1, image2),)
+            return jax.jit(prep)
+        if kind == "segment":
+            def seg(p, state):
+                state, _, flow_up = raft_stereo_segment(
+                    p, cfg, state, iters=iters)
+                return state, flow_up, jnp.sum(flow_up.astype(jnp.float32))
+            return jax.jit(seg)
+        raise ValueError(f"unknown program kind {kind!r}")
+
+    def get_program(self, kind: str, h: int, w: int, iters: int,
+                    cfg=None, env=None) -> _Program:
+        """Fetch-or-compile under the per-bucket lock; LRU-bounded.
+
+        The kernel switch set is resolved ONCE here (breaker overrides ∪
+        live env) and that same snapshot both keys the program and is
+        exported around its trace — key and trace cannot diverge."""
+        cfg = cfg if cfg is not None else self._run_cfg
+        env = env if env is not None else self._env
+        trace_env = self._resolve(env)
+        key = self.cache_key(kind, h, w, iters, cfg, trace_env)
+        with self._cache_lock:
+            prog = self._cache.get(key)
+            if prog is not None:
+                self._cache.move_to_end(key)
+                return prog
+            lock = self._key_locks.setdefault(key, threading.Lock())
+        with lock:
+            with self._cache_lock:  # double-checked: loser of the race
+                prog = self._cache.get(key)
+                if prog is not None:
+                    self._cache.move_to_end(key)
+                    return prog
+            try:
+                self.faults.on_build()  # injected compile failure fires here
+                fn = self._build_fn(kind, cfg, iters)
+            except Exception as e:
+                setattr(e, "_raft_phase", "compile_failure")
+                with self._cache_lock:
+                    # the key never reaches the cache, so its lock entry
+                    # would otherwise leak for the process lifetime
+                    self._key_locks.pop(key, None)
+                raise
+            with self._metrics_lock:
+                self._metrics["compiles"] += 1
+            prog = _Program(key, fn, kind, trace_env)
+            evicted = 0
+            with self._cache_lock:
+                self._cache[key] = prog
+                while len(self._cache) > self.cfg.max_programs:
+                    old_key, _ = self._cache.popitem(last=False)
+                    self._key_locks.pop(old_key, None)
+                    with self._est_lock:
+                        self._estimates.pop(old_key, None)
+                    evicted += 1
+            if evicted:
+                with self._metrics_lock:
+                    self._metrics["evictions"] += evicted
+            return prog
+
+    def has_program(self, kind: str, h: int, w: int, iters: int) -> bool:
+        """Whether this program is already compiled (no side effects) —
+        the degrade policy refuses to route a deadline request onto a
+        cold bucket whose compile would dwarf the budget."""
+        key = self.cache_key(kind, h, w, iters)
+        with self._cache_lock:
+            prog = self._cache.get(key)
+        return prog is not None and prog.warmed
+
+    def invoke(self, prog: _Program, *args) -> Tuple[np.ndarray, ...]:
+        """Run a cached program, fetch results to host, apply fault hooks.
+
+        The first invocation (which triggers the actual XLA compile under
+        jit) holds the program's compile lock and the global trace lock
+        with the program's OWN switch set exported, so concurrent first
+        requests for one bucket compile once and trace-time env reads see
+        the switches this program was keyed under (the breaker's overrides
+        for serving programs; all-off for the canary reference).
+        """
+        # Array outputs come back as host numpy (the fetch doubles as the
+        # completion barrier); dict outputs (the segment carry) stay on
+        # device — they only ever feed the next segment.
+        def fetch(out):
+            return tuple(o if isinstance(o, dict) else np.asarray(o)
+                         for o in out)
+
+        was_warm = prog.warmed
+        t0 = self.clock.now()
+        try:
+            if not prog.warmed:
+                with prog.lock:
+                    with _TRACE_LOCK, _env_overrides(prog.env):
+                        out = fetch(prog.fn(self._params, *args))
+                    prog.warmed = True
+            else:
+                out = fetch(prog.fn(self._params, *args))
+        except Exception as e:
+            if not hasattr(e, "_raft_phase"):
+                setattr(e, "_raft_phase", "runtime_failure")
+            raise
+        ordinal = self.faults.on_forward()
+        if was_warm:
+            # The warming invocation's time includes the XLA compile
+            # (minutes on TPU) — feeding it into the latency EMA would
+            # make the degrade policy reject/halve requests for dozens of
+            # calls after every cold bucket. Only steady-state runs count.
+            self._record_time(prog.key, self.clock.now() - t0)
+        if self.faults.poisoned(ordinal):
+            flow_i = {"full": 0, "segment": 1}.get(prog.kind)
+            if flow_i is not None:
+                out = (out[:flow_i] + (poison_disparity(out[flow_i]),)
+                       + out[flow_i + 1:])
+        return out
+
+    # -- latency estimates (EMA per program) ------------------------------
+
+    def _record_time(self, key: Tuple, dt: float) -> None:
+        with self._est_lock:
+            prev = self._estimates.get(key)
+            self._estimates[key] = dt if prev is None else (
+                0.7 * prev + 0.3 * dt)
+
+    def estimate(self, key: Tuple) -> Optional[float]:
+        with self._est_lock:
+            return self._estimates.get(key)
+
+    # -- serving ----------------------------------------------------------
+
+    def infer(self, left, right, *, deadline: Optional[float] = None,
+              budget_s: Optional[float] = None,
+              allow_half_res: Optional[bool] = None,
+              prevalidated: bool = False) -> InferenceResult:
+        """Serve one stereo pair.
+
+        ``deadline`` is absolute on the session clock; ``budget_s`` is
+        relative sugar. With neither, the full ``valid_iters`` single-scan
+        program runs. With a deadline, the refinement runs in segments and
+        the degrade policy may return a reduced-iteration or half-res
+        field (quality-labeled). Raises :class:`~raft_stereo_tpu.serve.
+        validate.InputRejected`, :class:`DeadlineExceeded` or
+        :class:`InferenceFailed`; any disparity returned is finite.
+        """
+        try:
+            return self._infer(left, right, deadline=deadline,
+                               budget_s=budget_s,
+                               allow_half_res=allow_half_res,
+                               prevalidated=prevalidated)
+        except Exception:
+            with self._metrics_lock:
+                self._metrics["requests_failed"] += 1
+            raise
+
+    def _infer(self, left, right, *, deadline: Optional[float],
+               budget_s: Optional[float],
+               allow_half_res: Optional[bool],
+               prevalidated: bool = False) -> InferenceResult:
+        from raft_stereo_tpu.serve import degrade
+
+        t_start = self.clock.now()
+        if deadline is None and budget_s is not None:
+            deadline = t_start + budget_s
+        if not prevalidated:
+            # ``prevalidated`` lets the service layer (which validates at
+            # admission, before queueing) skip the second O(N) finite scan
+            # + float32 copies; the arrays must then already be the
+            # canonical (1, H, W, 3) float32 form validate_pair returns.
+            left, right = validate_pair(left, right, self.cfg.admission)
+        if deadline is not None and t_start >= deadline:
+            raise DeadlineExceeded("deadline already expired on arrival")
+        orig_h, orig_w = left.shape[1], left.shape[2]
+        padder = self.padder_for(left.shape)
+        half = (self.cfg.allow_half_res
+                if allow_half_res is None else allow_half_res)
+
+        last_exc: Optional[Exception] = None
+        for _ in range(len(self.breaker.ladder) + 1):
+            try:
+                if deadline is None:
+                    flow = self._run_full(padder, left, right)
+                    out = degrade.Outcome(flow, "full", self.cfg.valid_iters,
+                                          False)
+                else:
+                    out = degrade.run_with_deadline(
+                        self, padder, left, right, deadline,
+                        allow_half_res=half)
+                break
+            except Exception as e:  # noqa: BLE001 — filtered just below
+                if isinstance(e, SessionError) or not is_kernel_failure(e):
+                    raise
+                last_exc = e
+                self._breaker_retry(
+                    e, getattr(e, "_raft_phase", "runtime_failure"))
+                padder = self.padder_for(left.shape)  # unchanged, explicit
+                continue
+        else:
+            raise InferenceFailed(
+                "ladder_exhausted",
+                f"breaker retries exhausted: {last_exc}") from last_exc
+
+        disparity = self._finish(out.flow_padded, padder, out.quality,
+                                 orig_h, orig_w)
+        elapsed = self.clock.now() - t_start
+        with self._metrics_lock:
+            self._metrics["requests_ok"] += 1
+            if out.quality != "full":
+                self._metrics["degraded"] += 1
+        return InferenceResult(
+            disparity=disparity, quality=out.quality, iters=out.iters,
+            elapsed_s=elapsed, padded_shape=padder.padded_shape,
+            deadline_missed=out.deadline_missed)
+
+    def _run_full(self, padder: InputPadder, left: np.ndarray,
+                  right: np.ndarray, iters: Optional[int] = None,
+                  cfg=None, env=None) -> np.ndarray:
+        """Single-scan forward on the padded bucket; returns padded flow."""
+        iters = iters if iters is not None else self.cfg.valid_iters
+        lp, rp = padder.pad_np(left, right)
+        ph, pw = padder.padded_shape
+        prog = self.get_program("full", ph, pw, iters, cfg, env)
+        flow_up, _checksum = self.invoke(prog, lp, rp)
+        return flow_up
+
+    def _finish(self, flow_padded: np.ndarray, padder: InputPadder,
+                quality: str, orig_h: int, orig_w: int) -> np.ndarray:
+        """Unpad, validate, convert to positive disparity."""
+        if quality == "half_res":
+            # degrade.py already restored full resolution and unpadded.
+            flow = flow_padded
+        else:
+            flow = padder.unpad_np(flow_padded)
+        flow = flow[0, ..., 0]
+        if flow.shape != (orig_h, orig_w):
+            raise InferenceFailed(
+                "internal", f"output shape {flow.shape} != input "
+                f"({orig_h}, {orig_w})")
+        if not np.isfinite(flow).all():
+            with self._metrics_lock:
+                self._metrics["nonfinite_outputs"] += 1
+            raise InferenceFailed(
+                "nonfinite_output",
+                "disparity contains NaN/Inf — refusing to serve it")
+        return -flow
+
+    # -- warmup / canary --------------------------------------------------
+
+    def _warm_shape(self, h: int, w: int) -> None:
+        """Compile (and once-run, on zeros) the programs for one bucket,
+        walking the breaker ladder on failure instead of dying."""
+        padder = self.padder_for((h, w, 3))
+        zeros = np.zeros((1, h, w, 3), np.float32)
+        for _ in range(len(self.breaker.ladder) + 1):
+            try:
+                self._run_full(padder, zeros, zeros)
+                if self.cfg.warmup_segmented:
+                    from raft_stereo_tpu.serve import degrade
+                    degrade.warm_segmented(self, padder, zeros)
+                return
+            except Exception as e:  # noqa: BLE001 — filtered just below
+                if not is_kernel_failure(e):
+                    raise
+                self._breaker_retry(
+                    e, getattr(e, "_raft_phase", "runtime_failure"))
+        raise InferenceFailed("ladder_exhausted",
+                              f"warmup for bucket {h}x{w} never succeeded")
+
+    def _run_canary(self) -> None:
+        """One bucketed forward, fast path vs plain XLA, within the pinned
+        drift band. A mismatch is a silently-wrong kernel: trip a rung,
+        rebuild, re-check — by the bottom rung fast == reference and the
+        canary passes trivially."""
+        h, w = self.cfg.canary_shape
+        padder = self.padder_for((h, w, 3))
+        rng = np.random.default_rng(1234)
+        left = rng.uniform(0, 255, (1, h, w, 3)).astype(np.float32)
+        right = rng.uniform(0, 255, (1, h, w, 3)).astype(np.float32)
+        iters = self.cfg.canary_iters
+        self._canary_state["ran"] = True
+        for _ in range(len(self.breaker.ladder) + 1):
+            self._canary_state["attempts"] += 1
+            try:
+                fast = self._run_full(padder, left, right, iters=iters)
+                ref_cfg, ref_env = self.breaker.plain_xla_cfg(self._base_cfg)
+                if (self._fingerprint() ==
+                        self._fingerprint(ref_cfg, ref_env)):
+                    # Already at plain XLA — the reference program IS the
+                    # serving program.
+                    ok = bool(np.isfinite(fast).all())
+                else:
+                    ref = self._run_full(padder, left, right, iters=iters,
+                                         cfg=ref_cfg, env=ref_env)
+                    ok = (np.isfinite(fast).all() and np.isfinite(ref).all()
+                          and np.allclose(fast, ref, rtol=CANARY_RTOL,
+                                          atol=CANARY_ATOL))
+            except Exception as e:  # noqa: BLE001 — filtered just below
+                if not is_kernel_failure(e):
+                    raise
+                self._breaker_retry(
+                    e, getattr(e, "_raft_phase", "runtime_failure"))
+                continue
+            if ok:
+                self._canary_state["passed"] = True
+                return
+            path = self.breaker.classify(
+                RuntimeError("canary parity mismatch"))
+            if path is None:
+                self._canary_state["passed"] = False
+                raise InferenceFailed(
+                    "canary_failed",
+                    "parity canary failing at plain XLA (non-finite "
+                    "reference output)")
+            self.breaker.trip(path.name, "canary_mismatch")
+            self._rebuild(f"canary mismatch -> tripped {path.name}")
+        self._canary_state["passed"] = False
+        raise InferenceFailed("canary_failed", "canary never converged")
+
+    # -- reporting --------------------------------------------------------
+
+    def metrics(self) -> Dict:
+        with self._metrics_lock:
+            m = dict(self._metrics)
+        return m
+
+    def status(self) -> Dict:
+        with self._cache_lock:
+            cached = [f"{k[0]}@{k[1]}x{k[2]}/it{k[3]}" for k in self._cache]
+        return {
+            "bucket": self.cfg.bucket,
+            "valid_iters": self.cfg.valid_iters,
+            "segments": self.cfg.segments,
+            "programs": {"cached": cached,
+                         "capacity": self.cfg.max_programs,
+                         **{k: v for k, v in self.metrics().items()
+                            if k in ("compiles", "evictions")}},
+            "breaker": self.breaker.status(),
+            "canary": dict(self._canary_state),
+            "counts": {k: v for k, v in self.metrics().items()
+                       if k not in ("compiles", "evictions")},
+        }

@@ -16,6 +16,17 @@ step() { echo; echo "== $* =="; }
 step "tier-1 suite"
 bash scripts/run_tier1.sh || { echo "FAIL: tier-1"; fail=1; }
 
+# Serving fault storm (ISSUE 3 acceptance): the FULL `serve` battery,
+# slow-marked members included — N requests with injected compile
+# failures, deadline overruns and malformed inputs interleaved; asserts
+# zero crashes, honest quality labels, the breaker ladder ending at plain
+# XLA with all trips recorded, and ZERO breaker trips on the clean path
+# (tests/test_serve.py::test_clean_path_zero_trips).
+step "serving fault storm (injected compile failures / deadline overruns / bad inputs)"
+env JAX_PLATFORMS=cpu python -m pytest tests/test_serve.py -q -m serve \
+    -p no:cacheprovider -p no:xdist -p no:randomly \
+    || { echo "FAIL: serving fault storm"; fail=1; }
+
 backend=$(python - <<'EOF'
 import jax
 print(jax.default_backend())

@@ -2,12 +2,16 @@
 # Canonical tier-1 gate (ROADMAP.md "Tier-1 verify") — the ONE command
 # builders and CI run; keep it in sync with ROADMAP.md.
 #
+# Includes the `serve` marker battery (tests/test_serve.py: sessions,
+# circuit breaker, deadline degradation, fault storm) minus its few
+# slow-marked members, which scripts/release_gate.sh runs in full.
+#
 # Prints DOTS_PASSED=<n> (count of passing-test dots) and exits with
 # pytest's status.
 set -o pipefail
 cd "$(dirname "$0")/.."
 rm -f /tmp/_t1.log
-timeout -k 10 870 env JAX_PLATFORMS=cpu \
+timeout -k 10 1020 env JAX_PLATFORMS=cpu \
     python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}

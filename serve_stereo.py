@@ -1,0 +1,201 @@
+#!/usr/bin/env python
+"""Serving CLI — drive the resilient inference subsystem from the shell.
+
+Globs left/right image pairs (like ``demo.py``), stands up an
+``InferenceSession`` + ``StereoService`` (bucketed compile cache, circuit
+breaker, optional startup parity canary, per-request deadlines with
+anytime degradation), streams every pair through the bounded queue, and
+prints one JSON line per response plus the final ``/healthz`` status
+document. See README "Serving quickstart" and DESIGN.md "Serving &
+degradation".
+
+Examples::
+
+    # plain serving, bucketed compiles, warmed first shape
+    python serve_stereo.py --restore_ckpt models/raftstereo.msgpack \
+        -l 'imgs/*_left.png' -r 'imgs/*_right.png' --bucket 64
+
+    # 200 ms deadline per frame: late frames degrade instead of timing out
+    python serve_stereo.py --restore_ckpt ... -l ... -r ... \
+        --deadline_ms 200 --segments 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import logging
+from pathlib import Path
+
+
+def build_parser() -> argparse.ArgumentParser:
+    from raft_stereo_tpu.config import add_model_args
+
+    parser = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument('--restore_ckpt', default=None,
+                        help="checkpoint (.pth reference weights or native "
+                        ".msgpack); omitted = random init (smoke runs)")
+    parser.add_argument('-l', '--left_imgs', required=True,
+                        help="glob for left frames")
+    parser.add_argument('-r', '--right_imgs', required=True,
+                        help="glob for right frames")
+    parser.add_argument('--output_directory', default=None,
+                        help="save disparity .npy files here (optional)")
+    parser.add_argument('--valid_iters', type=int, default=32,
+                        help='refinement iterations for an undegraded pass')
+    # Serving knobs
+    parser.add_argument('--bucket', type=int, default=64,
+                        help="pad request shapes to multiples of this "
+                        "(multiple of 32) so mixed sizes share compiles")
+    parser.add_argument('--segments', type=int, default=4,
+                        help="host-visible scan segments for deadline "
+                        "requests (must divide valid_iters)")
+    parser.add_argument('--deadline_ms', type=float, default=None,
+                        help="per-request deadline; omitted = no degradation")
+    parser.add_argument('--max_queue', type=int, default=8,
+                        help="bounded queue depth (full -> explicit reject)")
+    parser.add_argument('--workers', type=int, default=1,
+                        help="worker threads draining the queue")
+    parser.add_argument('--max_pixels', type=int, default=8 << 20,
+                        help="admission cap on per-image area")
+    parser.add_argument('--warmup', default=None,
+                        help="comma-separated HxW image shapes to "
+                        "pre-compile, e.g. '544x960,736x1280'")
+    parser.add_argument('--no_canary', action='store_true',
+                        help="skip the startup fast-vs-XLA parity canary")
+    parser.add_argument('--no_half_res', action='store_true',
+                        help="never degrade to half resolution")
+    parser.add_argument('--status_json', default=None,
+                        help="also write the final /healthz status here")
+    add_model_args(parser)
+    return parser
+
+
+def _parse_warmup(spec):
+    if not spec:
+        return ()
+    shapes = []
+    for part in spec.split(','):
+        h, _, w = part.strip().partition('x')
+        shapes.append((int(h), int(w)))
+    return tuple(shapes)
+
+
+def serve(args) -> int:
+    import jax
+    import numpy as np
+
+    from raft_stereo_tpu.config import (RAFTStereoConfig,
+                                        with_eval_precision)
+    from raft_stereo_tpu.data.frame_utils import read_image_rgb
+    from raft_stereo_tpu.engine.checkpoint import load_params
+    from raft_stereo_tpu.models import init_raft_stereo
+    from raft_stereo_tpu.serve import (AdmissionConfig, InferenceSession,
+                                       ServiceConfig, SessionConfig,
+                                       StereoService)
+
+    cfg = RAFTStereoConfig.from_namespace(args)
+    if args.restore_ckpt is not None:
+        template = (None if args.restore_ckpt.endswith(".pth")
+                    else init_raft_stereo(jax.random.PRNGKey(0), cfg))
+        params = load_params(args.restore_ckpt, cfg, template)
+    else:
+        logging.warning("no --restore_ckpt: serving RANDOM weights "
+                        "(wiring smoke only)")
+        params = init_raft_stereo(jax.random.PRNGKey(0), cfg)
+    cfg = with_eval_precision(cfg)  # the one shared inference bf16 policy
+
+    session = InferenceSession(
+        params, cfg,
+        SessionConfig(
+            valid_iters=args.valid_iters,
+            segments=args.segments,
+            bucket=args.bucket,
+            warmup_shapes=_parse_warmup(args.warmup),
+            warmup_segmented=args.deadline_ms is not None,
+            canary=not args.no_canary,
+            allow_half_res=not args.no_half_res,
+            admission=AdmissionConfig(max_pixels=args.max_pixels)))
+    service = StereoService(session, ServiceConfig(
+        max_queue=args.max_queue, workers=args.workers))
+
+    left_images = sorted(glob.glob(args.left_imgs, recursive=True))
+    right_images = sorted(glob.glob(args.right_imgs, recursive=True))
+    if len(left_images) != len(right_images):
+        raise SystemExit(
+            f"left glob matched {len(left_images)} files but right glob "
+            f"matched {len(right_images)} — zip would silently drop the "
+            "difference; fix the globs")
+    print(f"Found {len(left_images)} pairs.")
+    out_dir = Path(args.output_directory) if args.output_directory else None
+    if out_dir is not None:
+        out_dir.mkdir(parents=True, exist_ok=True)
+
+    failures = 0
+    seq = 0
+
+    def drain(fut) -> None:
+        nonlocal failures, seq
+        resp = fut.result()
+        line = {k: v for k, v in resp.items() if k != "disparity"}
+        print(json.dumps(line, default=str))
+        if resp["status"] != "ok":
+            failures += 1
+        elif out_dir is not None:
+            # Sequence-prefixed: Middlebury-style globs (*/im0.png) share
+            # one stem across every scene, which would silently overwrite.
+            stem = f"{seq:05d}_{Path(resp['id']).stem}"
+            np.save(out_dir / f"{stem}_disp.npy", resp["disparity"])
+        seq += 1
+
+    # In-flight cap for this closed-loop driver: the queue bound normally,
+    # but only `workers` when requests carry deadlines — a deadline is
+    # stamped at submit time, so anything parked behind a busy worker
+    # would burn its whole budget queued and be rejected
+    # deadline_exceeded_in_queue instead of degrading.
+    inflight_cap = max(
+        1, args.workers if args.deadline_ms is not None else args.max_queue)
+
+    with service:
+        # Drain as we submit: this batch driver respects the service's
+        # backpressure by capping its own in-flight requests below the
+        # queue bound instead of firing the whole glob at a bounded queue
+        # (which would correctly reject most of it with queue_full —
+        # the right answer for an open-loop network caller, the wrong
+        # one for a closed-loop batch job).
+        from collections import deque
+        pending = deque()
+        for f1, f2 in zip(left_images, right_images):
+            while len(pending) >= inflight_cap:
+                drain(pending.popleft())
+            request = {
+                "id": f1,
+                "left": read_image_rgb(f1).astype(np.float32)[None],
+                "right": read_image_rgb(f2).astype(np.float32)[None],
+            }
+            if args.deadline_ms is not None:
+                request["deadline_ms"] = args.deadline_ms
+            pending.append(service.submit(request))
+        while pending:
+            drain(pending.popleft())
+
+    status = service.status()
+    print(json.dumps(status, indent=2, default=str))
+    if args.status_json:
+        Path(args.status_json).write_text(
+            json.dumps(status, indent=2, default=str))
+    if failures:
+        print(f"{failures}/{len(left_images)} requests failed")
+    return 1 if failures else 0
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    return serve(args)
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

@@ -28,7 +28,8 @@ from raft_stereo_tpu.models import init_raft_stereo
 TINY = RAFTStereoConfig(hidden_dims=(32, 32, 32), corr_levels=2, corr_radius=2)
 
 
-def _zero_forward(params, cfg, iters, mixed_prec=False, mesh=None):
+def _zero_forward(params, cfg, iters, mixed_prec=False, mesh=None,
+                  segments=1):
     def forward(image1, image2):
         return np.zeros(image1.shape[:3] + (1,), np.float32), 0.01
     return forward

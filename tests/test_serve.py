@@ -1,0 +1,733 @@
+"""Serving-layer battery: segmented-scan equivalence, session bucketing +
+compile-cache keying, circuit-breaker ladder, parity canary, deadline-aware
+degradation, queue backpressure, and the fault-storm acceptance run.
+
+Everything runs on CPU with a tiny model config; every fault is injected
+through an explicit ``ServeFaultPlan`` (deterministic ordinals, FakeClock
+deadlines — zero real sleeping in the deadline tests).
+"""
+
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+import jax
+
+from raft_stereo_tpu.config import RAFTStereoConfig
+from raft_stereo_tpu.faults import (FakeClock, ServeFaultPlan, ServeFaults,
+                                    malformed_pairs)
+from raft_stereo_tpu.models import (init_raft_stereo, raft_stereo_forward,
+                                    raft_stereo_inference,
+                                    raft_stereo_prepare, raft_stereo_segment)
+from raft_stereo_tpu.ops.padder import InputPadder, bucket_shape
+from raft_stereo_tpu.serve import (DeadlineExceeded, InferenceFailed,
+                                   InferenceSession, InputRejected,
+                                   ServiceConfig, SessionConfig,
+                                   StereoService)
+
+pytestmark = pytest.mark.serve
+
+TINY = dict(n_gru_layers=1, hidden_dims=(32, 32, 32),
+            corr_levels=2, corr_radius=2)
+H, W = 40, 60  # deliberately not multiples of 32: bucketing must pad
+
+
+@pytest.fixture(scope="module")
+def tiny_cfg():
+    return RAFTStereoConfig(**TINY)
+
+
+@pytest.fixture(scope="module")
+def tiny_params(tiny_cfg):
+    return init_raft_stereo(jax.random.PRNGKey(0), tiny_cfg)
+
+
+@pytest.fixture(scope="module")
+def pair():
+    rng = np.random.default_rng(0)
+    return (rng.uniform(0, 255, (H, W, 3)).astype(np.float32),
+            rng.uniform(0, 255, (H, W, 3)).astype(np.float32))
+
+
+def make_session(params, cfg, *, valid_iters=4, segments=2, plan=None,
+                 clock=None, **kw):
+    scfg = SessionConfig(valid_iters=valid_iters, segments=segments,
+                         canary=kw.pop("canary", False), **kw)
+    return InferenceSession(params, cfg, scfg, fault_plan=plan,
+                            clock=clock or FakeClock())
+
+
+@pytest.fixture(scope="module")
+def clean_session(tiny_params, tiny_cfg):
+    """Shared fault-free session for the read-only tests; warms its one
+    bucket (full + segmented programs) at construction."""
+    return make_session(tiny_params, tiny_cfg, valid_iters=4, segments=2,
+                        warmup_shapes=((H, W),), warmup_segmented=True)
+
+
+# ---------------------------------------------------------------------------
+# Anytime property: segmented scan == single scan, bit for bit.
+
+
+def test_segments_compose_bit_identical(tiny_params, tiny_cfg, pair):
+    """k segments of m iters from carried (net, coords1) state == one
+    k*m-iter scan — the invariant deadline degradation stands on."""
+    cfg = tiny_cfg
+    i1, i2 = (x[None] for x in pair)
+    prep = jax.jit(lambda p, a, b: raft_stereo_prepare(p, cfg, a, b))
+    seg = {m: jax.jit(
+        lambda p, s, m=m: raft_stereo_segment(p, cfg, s, iters=m))
+        for m in (2, 4)}
+    state0 = prep(tiny_params, i1, i2)
+
+    _, low_a, up_a = seg[4](tiny_params, state0)
+    state = state0
+    for _ in range(2):
+        state, low_b, up_b = seg[2](tiny_params, state)
+
+    assert np.asarray(up_a).tobytes() == np.asarray(up_b).tobytes()
+    assert np.asarray(low_a).tobytes() == np.asarray(low_b).tobytes()
+
+
+def test_inference_segments_matches_single_scan(tiny_params, tiny_cfg, pair):
+    """raft_stereo_inference(segments=k) == the test-mode forward."""
+    cfg = tiny_cfg
+    i1, i2 = (x[None] for x in pair)
+    low_ref, up_ref = jax.jit(
+        lambda p, a, b: raft_stereo_forward(p, cfg, a, b, iters=4,
+                                            test_mode=True))(
+        tiny_params, i1, i2)
+    low_seg, up_seg = jax.jit(
+        lambda p, a, b: raft_stereo_inference(p, cfg, a, b, iters=4,
+                                              segments=2))(
+        tiny_params, i1, i2)
+    assert np.asarray(up_seg).tobytes() == np.asarray(up_ref).tobytes()
+    assert np.asarray(low_seg).tobytes() == np.asarray(low_ref).tobytes()
+
+
+def test_inference_rejects_bad_segmenting(tiny_params, tiny_cfg, pair):
+    with pytest.raises(ValueError, match="divisible"):
+        raft_stereo_inference(tiny_params, tiny_cfg, pair[0][None],
+                              pair[1][None], iters=4, segments=3)
+    with pytest.raises(ValueError, match="segments"):
+        raft_stereo_inference(tiny_params, tiny_cfg, pair[0][None],
+                              pair[1][None], iters=4, segments=0)
+
+
+# ---------------------------------------------------------------------------
+# Session: bucketing, output contract, eval-path equivalence.
+
+
+def test_session_serves_full_quality(clean_session, pair):
+    # warmup compiled the bucket's programs at construction: full,
+    # prepare, segment — plus the half bucket's prepare/segment (the
+    # degrade policy only routes half_res onto warm programs)
+    warm_compiles = clean_session.metrics()["compiles"]
+    assert warm_compiles == 5
+    res = clean_session.infer(*pair)
+    assert res.quality == "full" and not res.degraded
+    assert res.iters == 4
+    assert res.disparity.shape == (H, W)
+    assert np.isfinite(res.disparity).all()
+    assert res.padded_shape == (64, 64)  # 40x60 bucketed to /32
+    # a warmed bucket pays zero compiles at request time
+    assert clean_session.metrics()["compiles"] == warm_compiles
+
+
+def test_session_matches_eval_forward_bytes(clean_session, tiny_params,
+                                            tiny_cfg, pair):
+    """The session's single-scan path is byte-identical to the program
+    engine/evaluate.make_eval_forward (and hence demo.py) compiles."""
+    from raft_stereo_tpu.engine.evaluate import make_eval_forward
+    forward = make_eval_forward(tiny_params, tiny_cfg, 4)
+    i1, i2 = (x[None] for x in pair)
+    padder = InputPadder(i1.shape, divis_by=32)
+    p1, p2 = padder.pad_np(i1, i2)
+    flow_up, _ = forward(p1, p2)
+    ref = padder.unpad_np(np.asarray(flow_up))[0, ..., 0]
+    res = clean_session.infer(*pair)
+    assert (-res.disparity).tobytes() == ref.tobytes()
+
+
+def test_session_deadline_path_bit_identical_when_unconstrained(
+        clean_session, pair):
+    """A generous deadline runs all segments — same bytes as the full
+    single-scan path (the anytime split is free of quality cost)."""
+    ref = clean_session.infer(*pair)
+    res = clean_session.infer(*pair, budget_s=1e6)
+    assert res.quality == "full"
+    assert res.disparity.tobytes() == ref.disparity.tobytes()
+
+
+def test_session_accepts_batched_and_unbatched(clean_session, pair):
+    a = clean_session.infer(pair[0], pair[1])
+    b = clean_session.infer(pair[0][None], pair[1][None])
+    assert a.disparity.tobytes() == b.disparity.tobytes()
+
+
+# ---------------------------------------------------------------------------
+# Admission control.
+
+
+def test_malformed_inputs_rejected(clean_session):
+    cases = malformed_pairs(
+        h=H, w=W,
+        oversize_pixels=clean_session.cfg.admission.max_pixels)
+    expected_codes = {
+        "nan_pixels": "nonfinite_input",
+        "inf_pixels": "nonfinite_input",
+        "five_channel": "bad_channels",
+        "zero_area": "zero_area",
+        "mismatched_shapes": "shape_mismatch",
+        "wrong_rank": "wrong_rank",
+        # np.asarray converts the nested list to a rank-2 numeric array
+        "not_an_array": "wrong_rank",
+        "oversized": "too_large",
+    }
+    for name, (left, right) in cases.items():
+        with pytest.raises(InputRejected) as ei:
+            clean_session.infer(left, right)
+        assert ei.value.code == expected_codes[name], name
+
+
+def test_nonfinite_output_is_structured_error(tiny_params, tiny_cfg, pair):
+    """A silently-corrupted kernel output (injected NaN) must become a
+    structured InferenceFailed, never a served frame."""
+    sess = make_session(tiny_params, tiny_cfg,
+                        plan=ServeFaultPlan(poison_outputs=(0,)))
+    with pytest.raises(InferenceFailed) as ei:
+        sess.infer(*pair)
+    assert ei.value.code == "nonfinite_output"
+    assert sess.metrics()["nonfinite_outputs"] == 1
+    # the program is fine; the next (unpoisoned) request serves
+    res = sess.infer(*pair)
+    assert res.quality == "full"
+
+
+# ---------------------------------------------------------------------------
+# Compile cache: keying, LRU bound, per-bucket locks.
+
+
+def test_cache_key_covers_every_config_field(tiny_params, tiny_cfg):
+    """Regression (the bug class this PR fixes): sessions differing ONLY
+    in corr_implementation must never share a compiled program."""
+    alt_cfg = RAFTStereoConfig(**{**TINY, "corr_implementation": "alt"})
+    s_reg = make_session(tiny_params, tiny_cfg)
+    s_alt = make_session(tiny_params, alt_cfg)
+    k_reg = s_reg.cache_key("full", 64, 64, 4)
+    k_alt = s_alt.cache_key("full", 64, 64, 4)
+    assert k_reg != k_alt
+    # a kernel env switch is snapshotted at session construction: a
+    # session built under the flipped switch keys differently, while an
+    # existing session's keys stay stable (concurrent traces temporarily
+    # mutate the process env — live reads would bleed across threads)
+    import os
+    os.environ["RAFT_FUSE_GRU1632"] = "0"
+    try:
+        s_flip = make_session(tiny_params, tiny_cfg)
+        assert s_flip.cache_key("full", 64, 64, 4) != k_reg
+        assert s_reg.cache_key("full", 64, 64, 4) == k_reg
+    finally:
+        del os.environ["RAFT_FUSE_GRU1632"]
+    # mixed_precision / fused flags / iters segmenting all key distinctly
+    mp_cfg = RAFTStereoConfig(**{**TINY, "mixed_precision": True})
+    assert (make_session(tiny_params, mp_cfg).cache_key("full", 64, 64, 4)
+            != k_reg)
+    assert s_reg.cache_key("segment", 64, 64, 2) != \
+        s_reg.cache_key("segment", 64, 64, 4)
+
+
+def test_breaker_trip_rekeys_cache(tiny_params, tiny_cfg, pair):
+    """After an EFFECTIVE trip the old fast-path program is unreachable —
+    the same bucket compiles a fresh program under the new fingerprint.
+    A projection no-op trip (corr_kernel on an already-XLA corr) keys
+    identically: the program is the same program, so it is shared."""
+    sess = make_session(tiny_params, tiny_cfg)
+    sess.infer(*pair)
+    before = sess.metrics()["compiles"]
+    key_before = sess.cache_key("full", 64, 64, 4)
+    sess.breaker.trip("corr_kernel", "manual")  # reg -> reg: no-op
+    sess._rebuild("test")
+    assert sess.cache_key("full", 64, 64, 4) == key_before
+    sess.breaker.trip("fuse_gru1632", "manual")  # env switch: effective
+    sess._rebuild("test")
+    assert sess.cache_key("full", 64, 64, 4) != key_before
+    sess.infer(*pair)
+    assert sess.metrics()["compiles"] == before + 1
+
+
+@pytest.mark.slow  # 3 compiles; release_gate's serve step still runs it
+def test_lru_bound_evicts(tiny_params, tiny_cfg):
+    rng = np.random.default_rng(1)
+    sess = make_session(tiny_params, tiny_cfg, max_programs=2)
+    for (h, w) in ((32, 32), (32, 64), (64, 32)):
+        img = rng.uniform(0, 255, (h, w, 3)).astype(np.float32)
+        sess.infer(img, img)
+    m = sess.metrics()
+    assert m["compiles"] == 3
+    assert m["evictions"] == 1
+    assert len(sess._cache) == 2
+
+
+def test_concurrent_first_requests_compile_once(tiny_params, tiny_cfg, pair):
+    """Per-bucket compile locks: two racing first requests, one compile."""
+    sess = make_session(tiny_params, tiny_cfg,
+                        plan=ServeFaultPlan(slow_builds={0: 0.3}))
+    with ThreadPoolExecutor(max_workers=2) as ex:
+        futs = [ex.submit(sess.infer, *pair) for _ in range(2)]
+        results = [f.result() for f in futs]
+    assert sess.metrics()["compiles"] == 1
+    assert results[0].disparity.tobytes() == results[1].disparity.tobytes()
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker.
+
+
+LADDER_NAMES = ("fuse_gru1632", "stream_tail", "packed_l2", "corr_kernel",
+                "fused_encoders", "fused_update")
+
+
+def test_breaker_walks_ladder_to_plain_xla(tiny_params, pair):
+    """Repeated unattributable compile failures trip every rung in order;
+    the plain-XLA rebuild serves the request that triggered the walk."""
+    cfg = RAFTStereoConfig(**{**TINY, "corr_implementation": "reg_tpu"})
+    plan = ServeFaultPlan(compile_errors={0: "oom", 1: "oom", 2: "oom",
+                                          3: "mosaic", 4: "oom", 5: "oom"})
+    sess = make_session(tiny_params, cfg, plan=plan)
+    res = sess.infer(*pair)
+    assert res.quality == "full"
+    assert sess.breaker.tripped_names == LADDER_NAMES
+    assert sess.breaker.exhausted
+    assert sess._run_cfg.corr_implementation == "reg"  # XLA twin
+    assert sess._run_cfg.fused_update is False
+    # every env-switched rung is exported off for subsequent traces
+    assert sess._env == {"RAFT_FUSE_GRU1632": "0", "RAFT_STREAM_TAIL": "0",
+                         "RAFT_PACKED_L2": "0", "RAFT_FUSED_ENCODERS": "0"}
+    st = sess.breaker.status()
+    assert st["trip_count"] == 6 and st["exhausted"]
+    assert all(r["reason"] == "compile_failure"
+               for r in st["tripped"].values())
+
+
+def test_breaker_matcher_targets_rung(tiny_params, tiny_cfg, pair):
+    plan = ServeFaultPlan(compile_errors={0: "mosaic:stream_tail raw1 pass"})
+    sess = make_session(tiny_params, tiny_cfg, plan=plan)
+    sess.infer(*pair)
+    assert sess.breaker.tripped_names == ("stream_tail",)
+
+
+def test_breaker_exhaustion_is_structured(tiny_params, tiny_cfg, pair):
+    """Failures past the bottom rung surface as ladder_exhausted."""
+    # ordinals 0-5 trip the six rungs; ordinal 6 fails the plain-XLA
+    # build itself -> ladder_exhausted. Ordinal 7+ is clean.
+    plan = ServeFaultPlan(
+        compile_errors={i: "oom" for i in range(7)})
+    sess = make_session(tiny_params, tiny_cfg, plan=plan)
+    with pytest.raises(InferenceFailed) as ei:
+        sess.infer(*pair)
+    assert ei.value.code == "ladder_exhausted"
+    # the injector budget is spent: the session recovers on retry
+    res = sess.infer(*pair)
+    assert res.quality == "full"
+
+
+def test_canary_catches_corrupted_kernel_output(tiny_params, tiny_cfg):
+    """Startup canary vs plain XLA: a poisoned fast-path forward trips a
+    rung and the rebuilt session comes up serving."""
+    plan = ServeFaultPlan(poison_outputs=(0,))
+    sess = make_session(tiny_params, tiny_cfg, plan=plan, canary=True,
+                        canary_shape=(32, 48), canary_iters=2)
+    assert sess._canary_state == {
+        "enabled": True, "ran": True, "passed": True, "attempts": 2}
+    assert sess.breaker.tripped_names == ("fuse_gru1632",)
+    assert sess.breaker.status()["tripped"]["fuse_gru1632"]["reason"] == \
+        "canary_mismatch"
+
+
+@pytest.mark.slow  # release_gate's serve step still runs it
+def test_canary_clean_pass_no_trips(tiny_params, tiny_cfg):
+    sess = make_session(tiny_params, tiny_cfg, canary=True,
+                        canary_shape=(32, 48), canary_iters=2)
+    assert sess._canary_state["passed"] is True
+    assert sess.breaker.trip_count == 0
+
+
+# ---------------------------------------------------------------------------
+# Deadline-aware degradation.
+
+
+def test_deadline_reduced_iters(tiny_params, tiny_cfg, pair):
+    """Budget expires mid-scan -> best-so-far with an honest label."""
+    clk = FakeClock()
+    # ordinal 0 = prepare, 1 = first segment (injected 50 fake-seconds)
+    sess = make_session(tiny_params, tiny_cfg, clock=clk,
+                        plan=ServeFaultPlan(slow_forwards={1: 50.0}))
+    res = sess.infer(*pair, budget_s=10.0)
+    assert res.quality == "reduced_iters:2"
+    assert res.iters == 2
+    assert res.deadline_missed  # the first segment alone overran
+    assert np.isfinite(res.disparity).all()
+    assert sess.metrics()["degraded"] == 1
+
+
+def test_deadline_stops_before_overrunning_segment(tiny_params, tiny_cfg,
+                                                   pair):
+    """With a recorded estimate, the policy stops EARLY (no overrun) and
+    deadline_missed stays False."""
+    clk = FakeClock()
+    # segment invocations cost 30 fake-seconds (ordinals 0 and 3 are the
+    # two requests' prepare calls — instant)
+    sess = make_session(tiny_params, tiny_cfg, clock=clk,
+                        plan=ServeFaultPlan(
+                            slow_forwards={1: 30.0, 2: 30.0, 4: 30.0}))
+    sess.infer(*pair, budget_s=1000.0)        # seeds the segment EMA
+    res = sess.infer(*pair, budget_s=45.0)    # fits one segment, not two
+    assert res.quality == "reduced_iters:2"
+    assert not res.deadline_missed
+
+
+def test_deadline_half_res(tiny_params, tiny_cfg, pair):
+    """When the EMAs prove even one full-res segment cannot fit — and the
+    half-res programs are already warm — the pair runs at half resolution
+    and is labeled half_res."""
+    clk = FakeClock()
+    # Construction warms full + half buckets (invocation ordinals 0-4;
+    # warming runs are deliberately NOT recorded into the EMAs — they
+    # carry compile time in production). Request 0 (ordinals 5-7, each
+    # slowed 40 fake-seconds) seeds the full-res prepare/segment EMAs;
+    # the half-res programs stay instant.
+    sess = make_session(tiny_params, tiny_cfg, clock=clk,
+                        warmup_shapes=((H, W),), warmup_segmented=True,
+                        plan=ServeFaultPlan(
+                            slow_forwards={5: 40.0, 6: 40.0, 7: 40.0}))
+    seed = sess.infer(*pair, budget_s=1e6)   # seeds prep=40, seg=40
+    assert seed.quality == "full"
+    res = sess.infer(*pair, budget_s=20.0)
+    assert res.quality == "half_res"
+    assert res.degraded
+    assert res.disparity.shape == (H, W)      # restored to input geometry
+    assert np.isfinite(res.disparity).all()
+
+    # half-res disabled: same budget falls back to reduced iterations
+    res2 = sess.infer(*pair, budget_s=20.0, allow_half_res=False)
+    assert res2.quality.startswith("reduced_iters:")
+
+    # a session whose half bucket was never warmed refuses the half-res
+    # route (a cold compile would dwarf any budget) and reduces instead
+    cold = make_session(tiny_params, tiny_cfg, clock=FakeClock(),
+                        plan=ServeFaultPlan(
+                            slow_forwards={2: 40.0, 3: 40.0,
+                                           4: 40.0, 5: 40.0}))
+    cold.infer(*pair, budget_s=1e6)           # warm full bucket (0-2)
+    cold.infer(*pair, budget_s=1e6)           # seed full-res EMAs (3-5)
+    res3 = cold.infer(*pair, budget_s=20.0)
+    assert res3.quality.startswith("reduced_iters:")
+
+
+def test_deadline_already_expired(clean_session, pair):
+    with pytest.raises(DeadlineExceeded):
+        clean_session.infer(*pair, budget_s=-1.0)
+
+
+# ---------------------------------------------------------------------------
+# Service: queue, backpressure, health.
+
+
+def test_service_ok_and_health(tiny_params, tiny_cfg, pair):
+    sess = make_session(tiny_params, tiny_cfg)
+    with StereoService(sess, ServiceConfig(max_queue=4, workers=1)) as svc:
+        resp = svc.submit({"id": "r1", "left": pair[0],
+                           "right": pair[1]}).result()
+    assert resp["status"] == "ok" and resp["id"] == "r1"
+    assert resp["quality"] == "full"
+    assert np.isfinite(resp["disparity"]).all()
+    st = svc.status()
+    assert st["requests"]["ok"] == 1
+    assert st["latency_ms"]["n"] == 1
+    assert st["session"]["breaker"]["trip_count"] == 0
+
+
+def test_service_rejects_malformed_before_queueing(tiny_params, tiny_cfg):
+    sess = make_session(tiny_params, tiny_cfg)
+    bad = malformed_pairs(h=H, w=W)["five_channel"]
+    svc = StereoService(sess)  # not started: validation is synchronous
+    resp = svc.submit({"left": bad[0], "right": bad[1]}).result()
+    assert resp["status"] == "rejected"
+    assert resp["code"] == "invalid_input:bad_channels"
+    assert sess.metrics()["compiles"] == 0  # never touched a device
+
+
+@pytest.mark.slow  # spawns a worker; release_gate's serve step runs it
+def test_service_queue_full_backpressure(tiny_params, tiny_cfg, pair):
+    """One busy worker + depth-1 queue: the third concurrent request gets
+    an immediate structured queue_full rejection."""
+    import threading
+    import time
+
+    class GateClock:
+        """Real monotonic clock whose injected 'slowness' blocks on an
+        event the test releases — the worker is provably busy while the
+        backpressure assertions run, with zero timing sensitivity."""
+
+        def __init__(self):
+            self.gate = threading.Event()
+
+        @staticmethod
+        def now():
+            return time.monotonic()
+
+        def sleep(self, _seconds):
+            assert self.gate.wait(timeout=30)
+
+    clk = GateClock()
+    sess = make_session(tiny_params, tiny_cfg, clock=clk,
+                        plan=ServeFaultPlan(
+                            slow_forwards={1: 1.0, 2: 1.0}))
+    sess.infer(*pair)  # pre-compile; consumes forward ordinal 0
+    with StereoService(sess, ServiceConfig(max_queue=1, workers=1)) as svc:
+        f1 = svc.submit({"id": 1, "left": pair[0], "right": pair[1]})
+        # wait until the worker has f1's forward done and is parked in
+        # the injected slowness (ordinal 1 consumed)
+        for _ in range(3000):
+            if sess.faults.forwards >= 2:
+                break
+            time.sleep(0.01)
+        f2 = svc.submit({"id": 2, "left": pair[0], "right": pair[1]})
+        f3 = svc.submit({"id": 3, "left": pair[0], "right": pair[1]})
+        resp3 = f3.result(timeout=5)   # rejected synchronously at submit
+        clk.gate.set()                 # release the worker
+        statuses = {f.result(timeout=30)["id"]: f.result()
+                    for f in (f1, f2)}
+    assert resp3["status"] == "rejected"
+    assert resp3["code"] == "queue_full"
+    assert statuses[1]["status"] == "ok"
+    assert statuses[2]["status"] == "ok"
+    assert svc.status()["requests"]["rejected:queue_full"] == 1
+
+
+@pytest.mark.slow  # spawns a worker; release_gate's serve step runs it
+def test_service_stop_drains_queued_futures(tiny_params, tiny_cfg, pair):
+    """stop() must resolve still-queued Futures with a structured
+    rejection — an abandoned Future deadlocks its caller forever."""
+    import threading
+    import time
+
+    class GateClock:
+        def __init__(self):
+            self.gate = threading.Event()
+
+        @staticmethod
+        def now():
+            return time.monotonic()
+
+        def sleep(self, _seconds):
+            assert self.gate.wait(timeout=30)
+
+    clk = GateClock()
+    sess = make_session(tiny_params, tiny_cfg, clock=clk,
+                        plan=ServeFaultPlan(slow_forwards={1: 1.0}))
+    sess.infer(*pair)  # pre-compile; consumes forward ordinal 0
+    svc = StereoService(sess, ServiceConfig(max_queue=4, workers=1)).start()
+    f1 = svc.submit({"id": 1, "left": pair[0], "right": pair[1]})
+    for _ in range(3000):  # worker parked in f1's injected slowness
+        if sess.faults.forwards >= 2:
+            break
+        time.sleep(0.01)
+    f2 = svc.submit({"id": 2, "left": pair[0], "right": pair[1]})
+    clk.gate.set()
+    stopper = threading.Thread(target=svc.stop)
+    stopper.start()
+    r2 = f2.result(timeout=30)
+    stopper.join(timeout=30)
+    assert f1.result(timeout=30)["status"] == "ok"
+    # f2 either ran (worker dequeued it before exiting) or was drained
+    # with the structured stop rejection — never left unresolved.
+    assert r2["status"] in ("ok", "rejected")
+    if r2["status"] == "rejected":
+        assert r2["code"] == "service_stopped"
+
+
+def test_service_deadline_expires_in_queue(tiny_params, tiny_cfg, pair):
+    """A request whose deadline passes while queued is rejected on
+    dequeue without touching the device."""
+    clk = FakeClock()
+    sess = make_session(tiny_params, tiny_cfg, clock=clk)
+    svc = StereoService(sess)
+    req = {"left": pair[0], "right": pair[1], "deadline_ms": 1000.0}
+    assert svc._admit(req) is None
+    clk.sleep(2.0)  # deadline passes while "queued"
+    resp = svc._respond(req)
+    assert resp["status"] == "rejected"
+    assert resp["code"] == "deadline_exceeded_in_queue"
+
+
+# ---------------------------------------------------------------------------
+# The fault storm (release-gate acceptance): compile failures + deadline
+# overruns + malformed inputs interleaved into one request stream; the
+# session must never crash, every response must be a valid labeled
+# disparity or a structured rejection, and the breaker must end at plain
+# XLA with all trips recorded.
+
+
+def test_fault_storm(tiny_params):
+    cfg = RAFTStereoConfig(**{**TINY, "corr_implementation": "reg_tpu"})
+    clk = FakeClock()
+    plan = ServeFaultPlan(
+        # builds 0-5: the first request's program walks the whole ladder
+        compile_errors={0: "oom", 1: "mosaic", 2: "oom", 3: "oom",
+                        4: "oom", 5: "oom"},
+        # ordinal 0: request 1's forward; 1-3: request 3's prepare/segments
+        slow_forwards={2: 100.0},
+    )
+    sess = make_session(tiny_params, cfg, plan=plan, clock=clk)
+    svc = StereoService(sess, ServiceConfig(max_queue=8, workers=1))
+    rng = np.random.default_rng(3)
+
+    def good():
+        return (rng.uniform(0, 255, (H, W, 3)).astype(np.float32),
+                rng.uniform(0, 255, (H, W, 3)).astype(np.float32))
+
+    bad = malformed_pairs(h=H, w=W)
+    g1, g2, g3, g4 = good(), good(), good(), good()
+    stream = [
+        {"id": "ok-1", "left": g1[0], "right": g1[1]},
+        {"id": "nan", "left": bad["nan_pixels"][0],
+         "right": bad["nan_pixels"][1]},
+        {"id": "deadline", "left": g2[0], "right": g2[1],
+         "deadline_ms": 50_000.0},
+        {"id": "channels", "left": bad["five_channel"][0],
+         "right": bad["five_channel"][1]},
+        {"id": "zero", "left": bad["zero_area"][0],
+         "right": bad["zero_area"][1]},
+        {"id": "ok-2", "left": g3[0], "right": g3[1]},
+        {"id": "mismatch", "left": bad["mismatched_shapes"][0],
+         "right": bad["mismatched_shapes"][1]},
+        {"id": "deadline-2", "left": g4[0], "right": g4[1],
+         "deadline_ms": 1e9},
+    ]
+    responses = {r["id"]: svc.handle(r) for r in stream}
+
+    # zero crashes: every response is structured
+    assert all(r["status"] in ("ok", "rejected", "error")
+               for r in responses.values())
+    # honest quality labels on every served frame
+    assert responses["ok-1"]["status"] == "ok"
+    assert responses["ok-1"]["quality"] == "full"
+    assert responses["deadline"]["status"] == "ok"
+    assert responses["deadline"]["quality"] == "reduced_iters:2"
+    assert responses["deadline"]["deadline_missed"]
+    assert responses["ok-2"]["quality"] == "full"
+    assert responses["deadline-2"]["quality"] == "full"
+    for rid in ("ok-1", "deadline", "ok-2", "deadline-2"):
+        assert np.isfinite(responses[rid]["disparity"]).all()
+    # structured rejections with the right codes
+    assert responses["nan"]["code"] == "invalid_input:nonfinite_input"
+    assert responses["channels"]["code"] == "invalid_input:bad_channels"
+    assert responses["zero"]["code"] == "invalid_input:zero_area"
+    assert responses["mismatch"]["code"] == "invalid_input:shape_mismatch"
+    # the breaker ladder ended at plain XLA with all trips recorded
+    assert sess.breaker.exhausted
+    assert sess.breaker.tripped_names == LADDER_NAMES
+    assert sess._run_cfg.corr_implementation == "reg"
+    assert sess._run_cfg.fused_update is False
+    # health reflects the storm
+    st = svc.status()
+    assert st["requests"]["ok"] == 4
+    assert st["requests"]["degraded"] == 1
+    assert st["session"]["breaker"]["trip_count"] == 6
+    assert st["session"]["counts"]["requests_ok"] == 4
+
+
+def test_clean_path_zero_trips(clean_session, pair):
+    """Release-gate invariant: a fault-free session serves with ZERO
+    breaker trips (a trip in the clean path means a kernel is broken)."""
+    clean_session.infer(*pair)
+    assert clean_session.breaker.trip_count == 0
+    assert clean_session.metrics()["rebuilds"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Demo path: byte-identical output through the session.
+
+
+def test_demo_byte_identical_through_session(tiny_params, tiny_cfg,
+                                             tmp_path):
+    """demo.py routed through InferenceSession: for a fixed input shape
+    the saved .npy is byte-identical to the pre-session eval path."""
+    pytest.importorskip("matplotlib")
+    from PIL import Image
+
+    import demo
+    from raft_stereo_tpu.engine.checkpoint import save_checkpoint
+    from raft_stereo_tpu.engine.evaluate import make_eval_forward
+
+    rng = np.random.default_rng(5)
+    h, w = 64, 96
+    left = rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+    right = rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+    scene = tmp_path / "scene0"
+    scene.mkdir()
+    Image.fromarray(left).save(scene / "im0.png")
+    Image.fromarray(right).save(scene / "im1.png")
+    ckpt = str(tmp_path / "tiny.msgpack")
+    save_checkpoint(ckpt, tiny_params)
+    out_dir = tmp_path / "out"
+
+    demo.main([
+        "--restore_ckpt", ckpt,
+        "-l", str(tmp_path / "*/im0.png"),
+        "-r", str(tmp_path / "*/im1.png"),
+        "--output_directory", str(out_dir),
+        "--save_numpy", "--valid_iters", "2",
+        "--n_gru_layers", "1", "--hidden_dims", "32", "32", "32",
+        "--corr_levels", "2", "--corr_radius", "2",
+    ])
+
+    got = np.load(out_dir / "scene0.npy")
+    forward = make_eval_forward(tiny_params, tiny_cfg, 2)
+    i1 = left.astype(np.float32)[None]
+    i2 = right.astype(np.float32)[None]
+    padder = InputPadder(i1.shape, divis_by=32)
+    p1, p2 = padder.pad_np(i1, i2)
+    flow_up, _ = forward(p1, p2)
+    ref = padder.unpad_np(np.asarray(flow_up))[0, ..., 0]
+    assert got.tobytes() == ref.tobytes()
+
+
+# ---------------------------------------------------------------------------
+# Injector / padder unit checks.
+
+
+def test_serve_faults_ordinals_deterministic():
+    plan = ServeFaultPlan(compile_errors={1: "oom"}, poison_outputs=(2,))
+    faults = ServeFaults(plan)
+    assert faults.on_build() == 0
+    from raft_stereo_tpu.faults import InjectedKernelError
+    with pytest.raises(InjectedKernelError, match="RESOURCE_EXHAUSTED"):
+        faults.on_build()
+    assert faults.on_build() == 2
+    assert [faults.on_forward() for _ in range(3)] == [0, 1, 2]
+    assert not faults.poisoned(1)
+    assert faults.poisoned(2)
+
+
+def test_fake_clock_sleep_advances():
+    clk = FakeClock(start=5.0)
+    assert clk.now() == 5.0
+    clk.sleep(2.5)
+    assert clk.now() == 7.5
+
+
+def test_unpad_np_matches_unpad(rng):
+    import jax.numpy as jnp
+    x = rng.uniform(size=(1, 40, 60, 1)).astype(np.float32)
+    padder = InputPadder(x.shape, divis_by=32, bucket=32)
+    (xp,) = padder.pad_np(x)
+    a = np.asarray(padder.unpad(jnp.asarray(xp)))
+    b = padder.unpad_np(xp)
+    assert a.tobytes() == b.tobytes()
+    assert b.shape == x.shape
+    assert bucket_shape((40, 60), 32) == (64, 64)
+    assert bucket_shape((64, 64), 64) == (64, 64)
