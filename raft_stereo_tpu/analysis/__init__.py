@@ -1,0 +1,26 @@
+"""graftlint — static analysis for this repo's recurring bug classes.
+
+The linter mechanizes invariants that Python will never enforce and that
+human review has repeatedly had to catch by hand (DESIGN.md "Static
+analysis (r8)"):
+
+GL001  kill-switch read at import scope (the PR 3 ``ENABLE`` bug)
+GL002  RAFT_* env read missing from the program-cache knob registry
+GL003  program fingerprint not covering every model-config field
+GL004  instance attribute mutated both inside and outside its lock
+GL005  impure host call inside jit / scan-body / pallas-kernel code
+GL006  pallas_call entry point without kill switch + ladder registration
+
+Run ``python -m raft_stereo_tpu.analysis`` (full tree) or with
+``--changed-only`` (git-changed files only).  Suppress a finding inline
+with ``# graftlint: disable=GLxxx (reason)``.
+
+This package is import-light by design: no jax, no numpy — the linter
+must run (and the knob registry must be importable by serve/) in any
+environment, instantly.
+"""
+
+from raft_stereo_tpu.analysis.core import (Finding, Project,  # noqa: F401
+                                           run_analysis)
+from raft_stereo_tpu.analysis.knobs import (ENV_KNOBS,  # noqa: F401
+                                            KERNEL_ENTRY_POINTS, KernelEntry)

@@ -1,0 +1,5 @@
+import sys
+
+from raft_stereo_tpu.analysis.cli import main
+
+sys.exit(main())

@@ -1,0 +1,68 @@
+"""GL001 — kill switch read at import scope or cached into a constant.
+
+The bug class PR 3 shipped: ``ops/pallas_encoder.py`` read
+``RAFT_FUSED_ENCODERS`` into a module constant ``ENABLE`` at import time,
+so the serving circuit breaker's runtime env flip silently never took
+effect — the stale program kept running the kernel the operator had just
+killed.  Program-shaping switches must be read at trace/build time, i.e.
+inside a function that every trace calls.
+
+Flagged, for any ``RAFT_*`` env key (or a key in the knob registry):
+
+- a read at module or class scope (executes once, at import);
+- a read inside a function decorated ``functools.lru_cache`` / ``cache``
+  (same staleness with one extra step of indirection).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from raft_stereo_tpu.analysis.checkers.base import Checker
+from raft_stereo_tpu.analysis.core import (Finding, Project, SourceFile,
+                                           enclosing_function, env_reads)
+
+_CACHE_DECORATORS = ("functools.lru_cache", "lru_cache", "functools.cache",
+                     "cache")
+
+
+def _is_cached(sf: SourceFile, fn: ast.AST) -> bool:
+    for dec in getattr(fn, "decorator_list", ()):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        if sf.canonical(target) in _CACHE_DECORATORS:
+            return True
+    return False
+
+
+class ImportTimeSwitchChecker(Checker):
+    code = "GL001"
+    name = "import-time-switch"
+    description = ("program-shaping env switch read at module import "
+                   "scope or cached into a constant (must be read at "
+                   "trace/build time)")
+
+    def check_file(self, project: Project, sf: SourceFile
+                   ) -> Iterator[Finding]:
+        for read in env_reads(sf):
+            if read.key is None:
+                continue
+            if not (read.key.startswith("RAFT_")
+                    or read.key in project.knobs):
+                continue
+            fn = enclosing_function(read.node)
+            if fn is None:
+                yield self.finding(
+                    sf, read.node,
+                    f"env switch {read.key!r} read at import scope — a "
+                    "runtime flip (circuit-breaker trip, operator export) "
+                    "will never take effect; read it inside the function "
+                    "that traces/builds the program")
+            elif _is_cached(sf, fn):
+                yield self.finding(
+                    sf, read.node,
+                    f"env switch {read.key!r} read inside the cached "
+                    f"function {getattr(fn, 'name', '<lambda>')!r} — the "
+                    "first call pins the value for the process lifetime; "
+                    "drop the cache decorator or hoist the read to the "
+                    "caller")

@@ -1,0 +1,51 @@
+"""GL002 — knob-registry drift.
+
+Every ``RAFT_*`` env read in a forward-relevant module (``models/``,
+``ops/``, ``corr/``) shapes the traced program, so it must be part of the
+serving cache key — i.e. listed in the one knob registry
+(``analysis/knobs.py`` ``ENV_KNOBS``) that ``serve/session.py``
+fingerprints and ``serve/guard.py`` validates its ladder against.  A read
+missing from the registry is the stale-program class the session can only
+runtime-check for ladder rungs: two requests under different switch
+values would silently share one compiled program.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from raft_stereo_tpu.analysis.checkers.base import Checker
+from raft_stereo_tpu.analysis.core import (Finding, Project, SourceFile,
+                                           env_reads)
+
+#: Path segments marking a module whose env reads shape the forward
+#: program (the serving cache key must cover them).
+FORWARD_DIRS = ("models", "ops", "corr")
+
+
+def is_forward_module(relpath: str) -> bool:
+    return any(seg in FORWARD_DIRS for seg in relpath.split("/")[:-1])
+
+
+class KnobRegistryChecker(Checker):
+    code = "GL002"
+    name = "knob-registry"
+    description = ("RAFT_* env read in a forward-relevant module missing "
+                   "from the program-cache knob registry (ENV_KNOBS)")
+
+    def check_file(self, project: Project, sf: SourceFile
+                   ) -> Iterator[Finding]:
+        if not is_forward_module(sf.relpath):
+            return
+        for read in env_reads(sf):
+            if read.key is None or not read.key.startswith("RAFT_"):
+                continue
+            if read.key not in project.knobs:
+                yield self.finding(
+                    sf, read.node,
+                    f"env knob {read.key!r} is read in a forward-relevant "
+                    "module but missing from ENV_KNOBS "
+                    "(raft_stereo_tpu/analysis/knobs.py) — programs traced "
+                    "under different values would share one cache entry; "
+                    "register it (or suppress with a reason if it provably "
+                    "cannot change the traced program)")

@@ -1,0 +1,197 @@
+"""GL005 — trace purity of jit / scan-body / pallas-kernel functions.
+
+A function handed to ``jax.jit``, ``jax.checkpoint``, ``lax.scan`` or
+``pl.pallas_call`` executes ONCE, at trace time; the compiled program
+replays its recorded ops.  An impure host call inside it —
+``time.time()``, ``np.random``, an ``os.environ`` read, mutation of a
+module-level object — silently becomes a constant baked into the program
+(or a side effect that fires once per compile instead of once per call).
+This is the trace-staleness family of the PR 3 kill-switch bug, one
+level down: not wrong at trace time, wrong on every later call.
+
+The checker inspects the DIRECT body (plus nested defs/lambdas) of
+functions literally passed to / decorated with the tracing entry points;
+it does not chase the transitive call graph, so trace-time-by-design
+helpers like ``corr_tile()`` (read when the corr fn is BUILT, keyed into
+the program cache) stay out of scope.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set, Tuple
+
+from raft_stereo_tpu.analysis.checkers.base import (Checker,
+                                                    call_name_candidates,
+                                                    funcdefs_by_name)
+from raft_stereo_tpu.analysis.checkers.gl004_lock_discipline import MUTATORS
+from raft_stereo_tpu.analysis.core import (Finding, Project, SourceFile,
+                                           ancestors, enclosing_function)
+
+#: call-target last components that trace their function argument /
+#: decorated function.
+_TRACER_TAILS = {"jit", "scan", "pallas_call", "checkpoint", "remat"}
+
+_IMPURE_EXACT = {
+    "time.time", "time.time_ns", "time.perf_counter",
+    "time.perf_counter_ns", "time.monotonic", "time.sleep",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.date.today", "uuid.uuid4", "os.urandom", "os.getenv",
+    "os.environ.get",
+}
+_IMPURE_PREFIX = ("numpy.random.", "random.")
+
+
+def _tracer_tail(sf: SourceFile, func: ast.expr) -> Optional[str]:
+    for cand in call_name_candidates(sf, func):
+        tail = cand.split(".")[-1]
+        if tail in _TRACER_TAILS:
+            return tail
+    return None
+
+
+def _unwrap_partial(sf: SourceFile, expr: ast.expr) -> ast.expr:
+    if isinstance(expr, ast.Call) and \
+            sf.canonical(expr.func).split(".")[-1] == "partial" and expr.args:
+        return expr.args[0]
+    return expr
+
+
+def _resolve_visible(defs, call_node: ast.AST, name: str) -> List[ast.AST]:
+    """The defs a Name argument can actually refer to AT the call site,
+    Python scoping order: the call's innermost enclosing function first,
+    then outward, then module scope — so a host-side namesake of a traced
+    closure (e.g. two functions both called ``step``) is never flagged."""
+    cands = defs.get(name, [])
+    if len(cands) <= 1:
+        return cands
+    scopes = [a for a in ancestors(call_node)
+              if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda))] + [None]
+    for scope in scopes:
+        matches = [d for d in cands if enclosing_function(d) is scope]
+        if matches:
+            return matches
+    return []
+
+
+def _traced_functions(sf: SourceFile) -> List[Tuple[ast.AST, str]]:
+    """(function node, how-it-is-traced) pairs for this file."""
+    defs = funcdefs_by_name(sf.tree)
+    out: List[Tuple[ast.AST, str]] = []
+    seen: Set[int] = set()
+
+    def add(node: Optional[ast.AST], how: str) -> None:
+        if node is not None and id(node) not in seen:
+            seen.add(id(node))
+            out.append((node, how))
+
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                target = _unwrap_partial(sf, dec)
+                target = target.func if isinstance(target, ast.Call) \
+                    else target
+                tail = _tracer_tail(sf, target)
+                if tail:
+                    add(node, f"@{tail}")
+        elif isinstance(node, ast.Call):
+            tail = _tracer_tail(sf, node.func)
+            if not tail or not node.args:
+                continue
+            arg = _unwrap_partial(sf, node.args[0])
+            if isinstance(arg, ast.Lambda):
+                add(arg, tail)
+            elif isinstance(arg, ast.Name):
+                for fd in _resolve_visible(defs, node, arg.id):
+                    add(fd, tail)
+    return out
+
+
+def _local_names(fn: ast.AST) -> Set[str]:
+    names: Set[str] = set()
+    args = getattr(fn, "args", None)
+    if args is not None:
+        for a in (args.posonlyargs + args.args + args.kwonlyargs
+                  + ([args.vararg] if args.vararg else [])
+                  + ([args.kwarg] if args.kwarg else [])):
+            names.add(a.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and \
+                isinstance(node.ctx, (ast.Store, ast.Del)):
+            names.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            names.add(node.name)
+    return names
+
+
+def _base_name(expr: ast.expr) -> Optional[str]:
+    while isinstance(expr, (ast.Subscript, ast.Attribute)):
+        expr = expr.value
+    return expr.id if isinstance(expr, ast.Name) else None
+
+
+class TracePurityChecker(Checker):
+    code = "GL005"
+    name = "trace-purity"
+    description = ("impure host call / global mutation inside a function "
+                   "traced by jit, lax.scan or pallas_call (executes once "
+                   "at trace time, not per call)")
+
+    def check_file(self, project: Project, sf: SourceFile
+                   ) -> Iterator[Finding]:
+        for fn, how in _traced_functions(sf):
+            fname = getattr(fn, "name", "<lambda>")
+            locals_ = _local_names(fn)
+            for node in ast.walk(fn):
+                yield from self._check_node(sf, node, fname, how, locals_)
+
+    def _check_node(self, sf, node, fname, how, locals_):
+        if isinstance(node, ast.Call):
+            name = sf.canonical(node.func)
+            if name in _IMPURE_EXACT or \
+                    name.startswith(_IMPURE_PREFIX):
+                yield self.finding(
+                    sf, node,
+                    f"impure call {name}() inside {fname!r} (traced via "
+                    f"{how}) — it runs once at trace time and its result "
+                    "is baked into the compiled program; hoist it to the "
+                    "caller and pass the value in")
+            elif isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in MUTATORS:
+                base = _base_name(node.func.value)
+                if base and base in sf.module_names and \
+                        base not in locals_:
+                    yield self.finding(
+                        sf, node,
+                        f"mutation of module-level {base!r} inside "
+                        f"{fname!r} (traced via {how}) — the side effect "
+                        "fires once per trace, not once per call")
+        elif isinstance(node, ast.Subscript):
+            if sf.canonical(node.value) == "os.environ" and \
+                    isinstance(node.ctx, ast.Load):
+                yield self.finding(
+                    sf, node,
+                    f"os.environ read inside {fname!r} (traced via {how}) "
+                    "— the value is baked in at trace time; resolve it in "
+                    "the caller and key the program cache on it")
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, (ast.Subscript, ast.Attribute)):
+                    base = _base_name(t)
+                    if base and base in sf.module_names and \
+                            base not in locals_:
+                        yield self.finding(
+                            sf, t,
+                            f"mutation of module-level {base!r} inside "
+                            f"{fname!r} (traced via {how}) — the side "
+                            "effect fires once per trace, not once per "
+                            "call")
+        elif isinstance(node, ast.Global):
+            yield self.finding(
+                sf, node,
+                f"`global {', '.join(node.names)}` inside {fname!r} "
+                f"(traced via {how}) — rebinding module state from traced "
+                "code fires once per trace, not once per call")

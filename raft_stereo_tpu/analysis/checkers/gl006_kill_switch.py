@@ -1,0 +1,133 @@
+"""GL006 — kill-switch / fallback-ladder coverage of pallas_call sites.
+
+Every module that issues a ``pl.pallas_call`` is a production risk the
+serving circuit breaker must be able to turn OFF: it needs a kill switch,
+an XLA fallback, and a guard-ladder rung that flips the switch when the
+kernel fails (DESIGN.md r7 — the ladder's terminal rung must be a
+genuinely kernel-free forward).  Coverage is declared once, in
+``analysis/knobs.py`` ``KERNEL_ENTRY_POINTS``, and this checker keeps the
+declaration honest:
+
+- a module containing ``pallas_call`` with no registry entry (and no
+  explicit exemption) is flagged — a new kernel cannot ship without
+  deciding its fallback story;
+- declared rungs must exist in ``serve/guard.py`` ``DEFAULT_LADDER``
+  (AST cross-check — renaming a rung can't silently orphan a kernel);
+- an env-var rung's switch must actually be consulted somewhere in the
+  module it covers (a declared-but-never-read switch kills nothing);
+- a cfg-field rung's field must exist on the model config dataclass;
+- a registry entry whose module no longer has any ``pallas_call`` is
+  stale and flagged (the registry never overstates coverage).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Set
+
+from raft_stereo_tpu.analysis.checkers.base import (Checker,
+                                                    call_name_candidates)
+from raft_stereo_tpu.analysis.core import (Finding, Project, SourceFile,
+                                           env_reads)
+
+REGISTRY_HINT = "raft_stereo_tpu/analysis/knobs.py KERNEL_ENTRY_POINTS"
+
+
+def _suffix_match(relpath: str, key: str) -> bool:
+    """Path-segment-bounded suffix match: 'xcorr/pallas_reg.py' must NOT
+    inherit the 'corr/pallas_reg.py' entry."""
+    return relpath == key or relpath.endswith("/" + key)
+
+
+def _pallas_calls(sf: SourceFile) -> List[ast.Call]:
+    out = []
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Call) and any(
+                c.split(".")[-1] == "pallas_call"
+                for c in call_name_candidates(sf, node.func)):
+            out.append(node)
+    return out
+
+
+def _env_keys_read(sf: SourceFile) -> Set[str]:
+    return {r.key for r in env_reads(sf) if r.key is not None}
+
+
+class KillSwitchCoverageChecker(Checker):
+    code = "GL006"
+    name = "kill-switch-coverage"
+    description = ("pallas_call entry point without a registered kill "
+                   "switch + guard-ladder rung (or explicit exemption)")
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        ladder = project.ladder()
+        rung_by_name = {r.name: r for r in (ladder or [])}
+        config_fields = project.config_fields()
+        matched_entries: Set[str] = set()
+
+        for sf in project.files:
+            if sf.tree is None:
+                continue
+            calls = _pallas_calls(sf)
+            if not calls:
+                continue
+            entry_key = next((k for k in project.kernel_entries
+                              if _suffix_match(sf.relpath, k)), None)
+            if entry_key is None:
+                yield self.finding(
+                    sf, calls[0],
+                    f"module issues pallas_call but has no entry in "
+                    f"{REGISTRY_HINT} — declare the ladder rungs whose "
+                    "kill switches cover it (or an exemption saying why "
+                    "its failure mode is acceptable)")
+                continue
+            matched_entries.add(entry_key)
+            entry = project.kernel_entries[entry_key]
+            if entry.exempt:
+                continue
+            if not entry.rungs:
+                yield self.finding(
+                    sf, calls[0],
+                    f"registry entry for this module declares no ladder "
+                    f"rungs and no exemption ({REGISTRY_HINT})")
+                continue
+            env_keys = _env_keys_read(sf)
+            for rung_name in entry.rungs:
+                if ladder is not None and rung_name not in rung_by_name:
+                    yield self.finding(
+                        sf, calls[0],
+                        f"declared ladder rung {rung_name!r} does not "
+                        "exist in DEFAULT_LADDER (serve/guard.py) — the "
+                        "breaker cannot trip a rung that isn't there")
+                    continue
+                rung = rung_by_name.get(rung_name)
+                if rung is None:
+                    continue  # no ladder in the analyzed set
+                if rung.env_var is not None and \
+                        rung.env_var not in env_keys:
+                    yield self.finding(
+                        sf, calls[0],
+                        f"rung {rung_name!r} kill switch {rung.env_var!r} "
+                        "is never read in this module — flipping it "
+                        "would kill nothing here; consult the switch on "
+                        "the path that reaches pallas_call")
+                if rung.cfg_field is not None and \
+                        config_fields is not None and \
+                        rung.cfg_field not in config_fields:
+                    yield self.finding(
+                        sf, calls[0],
+                        f"rung {rung_name!r} config switch "
+                        f"{rung.cfg_field!r} is not a field of the model "
+                        "config — the breaker's cfg rewrite would be a "
+                        "no-op")
+
+        for key, entry in sorted(project.kernel_entries.items()):
+            sf = project.find(key)
+            if sf is None or sf.tree is None:
+                continue  # module outside the analyzed set
+            if key not in matched_entries and not _pallas_calls(sf):
+                yield self.finding(
+                    sf, sf.tree,
+                    f"stale registry entry: {key} no longer issues any "
+                    f"pallas_call — remove it from {REGISTRY_HINT} so the "
+                    "registry never overstates coverage")

@@ -1,0 +1,93 @@
+"""``python -m raft_stereo_tpu.analysis`` — the graftlint CLI.
+
+Exit codes: 0 clean (suppressed findings allowed), 1 unsuppressed
+findings, 2 usage/internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from raft_stereo_tpu.analysis.core import git_changed_files, run_analysis
+
+_REPO_MARKERS = ("pyproject.toml", ".git")
+
+
+def _repo_root(start: str) -> str:
+    cur = os.path.abspath(start)
+    while True:
+        if any(os.path.exists(os.path.join(cur, m)) for m in _REPO_MARKERS):
+            return cur
+        nxt = os.path.dirname(cur)
+        if nxt == cur:
+            return os.path.abspath(start)
+        cur = nxt
+
+
+def _default_roots() -> List[str]:
+    """The package directory itself — works from any CWD."""
+    return [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m raft_stereo_tpu.analysis",
+        description="graftlint: static analysis for this repo's recurring "
+                    "bug classes (GL001-GL006). Zero unsuppressed findings "
+                    "is a tier-1/release-gate invariant.")
+    p.add_argument("paths", nargs="*",
+                   help="files/directories to analyze (default: the "
+                        "raft_stereo_tpu package)")
+    p.add_argument("--changed-only", action="store_true",
+                   help="report findings only for git-changed files (the "
+                        "full tree is still analyzed for cross-file "
+                        "context)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable JSON report on stdout")
+    p.add_argument("--select", metavar="CODES",
+                   help="comma-separated finding codes to report "
+                        "(e.g. GL001,GL004); GL000 always reports")
+    p.add_argument("--show-suppressed", action="store_true",
+                   help="also print suppressed findings (with reasons)")
+    p.add_argument("--list-checkers", action="store_true",
+                   help="print the checker table and exit")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_checkers:
+        from raft_stereo_tpu.analysis.checkers import ALL_CHECKERS
+        for cls in ALL_CHECKERS:
+            print(f"{cls.code}  {cls.name:<24} {cls.description}")
+        return 0
+    roots = args.paths or _default_roots()
+    for r in roots:
+        if not os.path.exists(r):
+            print(f"graftlint: no such path: {r}", file=sys.stderr)
+            return 2
+    base = _repo_root(roots[0])
+    only_paths = None
+    if args.changed_only:
+        try:
+            only_paths = git_changed_files(base)
+        except Exception as e:
+            print(f"graftlint: --changed-only needs a git checkout: {e}",
+                  file=sys.stderr)
+            return 2
+    select = None
+    if args.select:
+        select = tuple(c.strip() for c in args.select.split(",") if c.strip())
+    try:
+        report = run_analysis(roots, base=base, select=select,
+                              only_paths=only_paths)
+    except Exception as e:  # an internal error must not read as "clean"
+        print(f"graftlint: internal error: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 2
+    print(report.render_json() if args.as_json
+          else report.render_text(show_suppressed=args.show_suppressed))
+    return 0 if report.ok else 1

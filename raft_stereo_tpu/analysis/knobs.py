@@ -1,0 +1,63 @@
+"""The ONE registry of program-shaping env knobs and kernel entry points.
+
+Before this module existed the same information lived in three hand-synced
+places: ``serve/session.py``'s ``_ENV_KNOBS`` tuple (cache-key coverage),
+``serve/guard.py``'s ladder declarations (fallback coverage), and the
+reviewers' heads (which module reads which switch).  PR 1–3 review rounds
+caught drift between them by hand; now ``serve/session.py``,
+``serve/guard.py`` and the graftlint checkers (GL002/GL006) all import
+THIS module, and the linter cross-checks the registry against the tree.
+
+Import-light on purpose (stdlib only): ``serve/`` pulls it at import time
+and the linter must run without jax present.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+# Env switches whose trace-time values shape the compiled program — part of
+# every serving cache key so a flipped switch (breaker trip or operator
+# export) can never be served a stale program (the compile-cache-staleness
+# bug class).  Every ``RAFT_*`` env read in a forward-relevant module
+# (models/, ops/, corr/) must appear here or carry an explicit graftlint
+# suppression — enforced by GL002.
+ENV_KNOBS: Tuple[str, ...] = (
+    "RAFT_STREAM_TAIL",        # streamed encoder tail (ops/pallas_encoder.py)
+    "RAFT_FUSE_GRU1632",       # co-scheduled gru16+32 (ops/pallas_stream.py)
+    "RAFT_FUSED_ENCODERS",     # one-pass-per-conv stems (ops/pallas_encoder.py)
+    "RAFT_PACKED_L2",          # packed layer2 bit-layout (models/extractor.py)
+    "RAFT_CORR_TILE",          # corr gather tile size (corr/pallas_reg.py)
+    "RAFT_BATCH_FUSE_PIXELS",  # batch-fusion threshold (ops/pallas_stream.py)
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelEntry:
+    """Declared coverage for one module that issues ``pl.pallas_call``.
+
+    rungs: guard-ladder rung names (``serve/guard.py`` ``DEFAULT_LADDER``)
+        whose kill switches cover this module's kernels — tripping them
+        must route every kernel here onto its XLA fallback.
+    exempt: reason string for a module deliberately outside the ladder
+        (none today; an exemption must say why its failure mode is
+        acceptable).
+    """
+
+    rungs: Tuple[str, ...] = ()
+    exempt: Optional[str] = None
+
+
+# Every module containing a ``pl.pallas_call`` must appear here (keyed by
+# path suffix) with the ladder rungs that kill-switch it — enforced by
+# GL006, which also cross-checks that the rungs exist in DEFAULT_LADDER
+# and that each rung's env switch is actually consulted by the module.
+KERNEL_ENTRY_POINTS = {
+    "ops/pallas_encoder.py": KernelEntry(
+        rungs=("fused_encoders", "stream_tail")),
+    "ops/pallas_stream.py": KernelEntry(
+        rungs=("fuse_gru1632", "fused_update")),
+    "corr/pallas_reg.py": KernelEntry(rungs=("corr_kernel",)),
+    "corr/pallas_alt.py": KernelEntry(rungs=("corr_kernel",)),
+}

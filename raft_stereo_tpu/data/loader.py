@@ -280,7 +280,18 @@ def fetch_dataloader(train_cfg, root: Optional[str] = None,
     dataset = fetch_dataset(train_cfg, root=root)
     num_workers = getattr(train_cfg, "num_workers", None)
     if num_workers is None:
-        num_workers = int(os.environ.get("SLURM_CPUS_PER_TASK", 6)) - 2
+        raw = os.environ.get("SLURM_CPUS_PER_TASK", "6")
+        try:
+            cpus = int(raw)
+        except ValueError:
+            raise ValueError(
+                f"SLURM_CPUS_PER_TASK must be an integer, got {raw!r} — "
+                "fix the allocation or pass num_workers explicitly"
+            ) from None
+        # A 1-2 CPU allocation must still get ONE worker, not 0/-1
+        # (StereoLoader clamps too, but clamp at the read so the derived
+        # value is never nonsensical in logs/configs).
+        num_workers = max(1, cpus - 2)
     return StereoLoader(dataset, batch_size=train_cfg.batch_size, shuffle=True,
                         num_workers=num_workers, drop_last=True,
                         seed=getattr(train_cfg, "seed", 0),

@@ -22,11 +22,15 @@ its fallback would re-pay a compile per flap).
 from __future__ import annotations
 
 import dataclasses
+import logging
 import threading
 import time
 from typing import Dict, Mapping, Optional, Tuple, Union
 
+from raft_stereo_tpu.analysis.knobs import ENV_KNOBS
 from raft_stereo_tpu.faults import InjectedKernelError
+
+logger = logging.getLogger(__name__)
 
 # Parity canary drift band: the fast-path forward is compared against the
 # plain-XLA program on one bucketed pair at session startup. The bench gate
@@ -161,6 +165,17 @@ class KernelCircuitBreaker:
         self._by_name = {p.name: p for p in self.ladder}
         if len(self._by_name) != len(self.ladder):
             raise ValueError("duplicate fast-path names in ladder")
+        # Fingerprint/trace contract (one registry: analysis/knobs.py): a
+        # rung's env switch must be in ENV_KNOBS so UNTRIPPED programs key
+        # on it too. resolve_env keeps unknown override keys — the trace
+        # still sees the switch — so drift is a warning, not an error
+        # (tests inject synthetic ladders).
+        for p in self.ladder:
+            if p.env_var is not None and p.env_var not in ENV_KNOBS:
+                logger.warning(
+                    "ladder rung %s uses env var %s not in ENV_KNOBS "
+                    "(raft_stereo_tpu/analysis/knobs.py) — add it so "
+                    "untripped programs key on it too", p.name, p.env_var)
         self._tripped: Dict[str, TripRecord] = {}
         self._lock = threading.Lock()
 

@@ -42,6 +42,7 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from raft_stereo_tpu.analysis.knobs import ENV_KNOBS as _ENV_KNOBS
 from raft_stereo_tpu.config import RAFTStereoConfig
 from raft_stereo_tpu.faults import (RealClock, ServeFaultPlan, ServeFaults,
                                     poison_disparity)
@@ -52,11 +53,11 @@ from raft_stereo_tpu.serve.validate import AdmissionConfig, validate_pair
 
 logger = logging.getLogger(__name__)
 
-# Env switches whose trace-time values shape the compiled program — part of
-# every cache key so a flipped switch (breaker trip or operator export) can
-# never be served a stale program (the compile-cache-staleness bug class).
-_ENV_KNOBS = ("RAFT_STREAM_TAIL", "RAFT_FUSE_GRU1632", "RAFT_FUSED_ENCODERS",
-              "RAFT_PACKED_L2", "RAFT_CORR_TILE", "RAFT_BATCH_FUSE_PIXELS")
+# _ENV_KNOBS (analysis/knobs.py ENV_KNOBS): the env switches whose
+# trace-time values shape the compiled program — part of every cache key so
+# a flipped switch (breaker trip or operator export) can never be served a
+# stale program. ONE registry shared with serve/guard.py and the GL002
+# linter, instead of three hand-synced lists.
 
 # Tracing mutates process-global env (the kernel kill switches are read at
 # trace time), so traces are serialized even across buckets.
@@ -247,17 +248,12 @@ class InferenceSession:
         # means a new session (or tripping the breaker).
         self._env_base: Dict[str, Optional[str]] = {
             k: os.environ.get(k) for k in _ENV_KNOBS}
+        # The ladder/knob-registry sync check lives in the breaker's
+        # constructor now (guard.py imports the same ENV_KNOBS registry);
+        # resolve_env additionally keeps unknown override keys, so a rung
+        # whose env var drifted out of the registry still reaches the
+        # trace correctly — it just won't key untripped programs.
         self.breaker = breaker or KernelCircuitBreaker()
-        # Defense for the fingerprint/trace contract: a ladder rung whose
-        # env var the knob list didn't know about would still reach the
-        # trace (resolve_env keeps override keys), but keep the two lists
-        # visibly in sync anyway.
-        for p in self.breaker.ladder:
-            if p.env_var is not None and p.env_var not in _ENV_KNOBS:
-                logger.warning(
-                    "ladder rung %s uses env var %s not in the session "
-                    "knob list — add it to _ENV_KNOBS so untripped "
-                    "programs key on it too", p.name, p.env_var)
         self.faults = ServeFaults(fault_plan, clock=self.clock)
         self._cache: "OrderedDict[Tuple, _Program]" = OrderedDict()
         self._cache_lock = threading.Lock()

@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# graftlint gate — zero unsuppressed findings over the package tree
+# (DESIGN.md "Static analysis (r8)"). Wired as a step in
+# scripts/release_gate.sh; run locally after any change to models/ops/
+# corr/serve:
+#
+#   bash scripts/lint.sh                 # full tree
+#   bash scripts/lint.sh --changed-only  # git-changed files only
+#   bash scripts/lint.sh <paths...>      # explicit targets (tests use this
+#                                        # to prove an injected violation
+#                                        # fails the gate)
+#
+# Exits with the linter's status: 0 clean, 1 findings, 2 internal error.
+# No jax import — this is milliseconds, not minutes.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+exec python -m raft_stereo_tpu.analysis "$@"

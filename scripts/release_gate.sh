@@ -13,6 +13,13 @@ cd "$(dirname "$0")/.."
 fail=0
 step() { echo; echo "== $* =="; }
 
+# graftlint first: it is the cheapest step (milliseconds, no jax) and a
+# finding here — an unregistered knob, an import-time kill-switch read, a
+# half-locked attribute — invalidates everything the later steps would
+# measure (DESIGN.md "Static analysis (r8)").
+step "graftlint (zero unsuppressed findings)"
+bash scripts/lint.sh || { echo "FAIL: graftlint"; fail=1; }
+
 step "tier-1 suite"
 bash scripts/run_tier1.sh || { echo "FAIL: tier-1"; fail=1; }
 

@@ -1,0 +1,871 @@
+"""graftlint battery: per-checker positive/negative fixtures, the
+historical-regression fixtures (the PR 3 import-time ``ENABLE`` bug, knob
+drift, fingerprint drift), the clean-tree tier-1 gate, and the CLI /
+lint.sh wiring.
+
+No jax import anywhere on these paths — the linter must stay
+milliseconds-fast in any environment.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from raft_stereo_tpu.analysis import knobs
+from raft_stereo_tpu.analysis.core import (Project, collect_files,
+                                           run_analysis, run_checkers)
+
+pytestmark = pytest.mark.lint
+
+REPO = Path(__file__).resolve().parent.parent
+PACKAGE = REPO / "raft_stereo_tpu"
+
+
+def lint(tmp_path, files, **kw):
+    """Write ``files`` (relpath -> source) under ``tmp_path``, lint it."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return run_analysis([str(tmp_path)], base=str(tmp_path), **kw)
+
+
+def codes(report):
+    return sorted(f.code for f in report.findings)
+
+
+# Mini fixtures shared across checkers -------------------------------------
+
+GUARD_SRC = """
+    import dataclasses
+
+    @dataclasses.dataclass(frozen=True)
+    class FastPath:
+        name: str
+        env_var: str = None
+        cfg_field: str = None
+
+    DEFAULT_LADDER = (
+        FastPath(name="my_kernel", env_var="RAFT_MYKERN"),
+        FastPath(name="cfg_rung", cfg_field="fused_update"),
+    )
+"""
+
+CFG_SRC = """
+    import dataclasses
+
+    @dataclasses.dataclass
+    class RAFTStereoConfig:
+        corr_implementation: str = "reg"
+        corr_levels: int = 4
+        fused_update: bool = True
+        mixed_precision: bool = False
+"""
+
+KERNEL_SRC = """
+    import os
+    from jax.experimental import pallas as pl
+
+    def enabled():
+        return os.environ.get("RAFT_MYKERN", "1") != "0"
+
+    def kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...]
+
+    def run(x):
+        if not enabled():
+            return x
+        return pl.pallas_call(kernel)(x)
+"""
+
+
+# ---------------------------------------------------------------------------
+# framework: suppressions, parse errors, filters
+# ---------------------------------------------------------------------------
+
+BAD_GL001 = """
+    import os
+    FLAG = os.environ.get("RAFT_THING", "1")
+"""
+
+
+def test_finding_detected_and_fails(tmp_path):
+    rep = lint(tmp_path, {"m.py": BAD_GL001})
+    assert codes(rep) == ["GL001"] and not rep.ok
+
+
+def test_inline_suppression_with_reason(tmp_path):
+    rep = lint(tmp_path, {"m.py": """
+        import os
+        FLAG = os.environ.get("RAFT_THING", "1")  # graftlint: disable=GL001 (test-only constant)
+    """})
+    assert rep.ok
+    assert [f.code for f in rep.suppressed] == ["GL001"]
+    assert rep.suppressed[0].suppress_reason == "test-only constant"
+
+
+def test_preceding_comment_line_suppression(tmp_path):
+    rep = lint(tmp_path, {"m.py": """
+        import os
+        # graftlint: disable=GL001 (import-time on purpose here)
+        FLAG = os.environ.get("RAFT_THING", "1")
+    """})
+    assert rep.ok and [f.code for f in rep.suppressed] == ["GL001"]
+
+
+def test_wrong_code_suppression_does_not_apply(tmp_path):
+    rep = lint(tmp_path, {"m.py": """
+        import os
+        FLAG = os.environ.get("RAFT_THING", "1")  # graftlint: disable=GL002 (wrong code)
+    """})
+    assert codes(rep) == ["GL001"]
+
+
+def test_suppression_without_reason_is_gl000(tmp_path):
+    rep = lint(tmp_path, {"m.py": """
+        import os
+        FLAG = os.environ.get("RAFT_THING", "1")  # graftlint: disable=GL001
+    """})
+    # the reasonless suppression does NOT suppress, and is itself flagged
+    assert codes(rep) == ["GL000", "GL001"]
+
+
+def test_parse_error_is_gl000(tmp_path):
+    rep = lint(tmp_path, {"m.py": "def broken(:\n"})
+    assert codes(rep) == ["GL000"]
+
+
+def test_select_filter_keeps_gl000(tmp_path):
+    rep = lint(tmp_path, {"m.py": BAD_GL001, "b.py": "def broken(:\n"},
+               select=("GL004",))
+    assert codes(rep) == ["GL000"]  # GL001 filtered, GL000 never filterable
+
+
+def test_only_paths_filter(tmp_path):
+    files = {"a.py": BAD_GL001, "b.py": BAD_GL001}
+    for rel, src in files.items():
+        (tmp_path / rel).write_text(textwrap.dedent(src))
+    rep = run_analysis([str(tmp_path)], base=str(tmp_path),
+                       only_paths={str(tmp_path / "a.py")})
+    assert [f.path for f in rep.findings] == ["a.py"]
+
+
+# ---------------------------------------------------------------------------
+# GL001 — import-time kill-switch read
+# ---------------------------------------------------------------------------
+
+def test_gl001_pr3_enable_regression(tmp_path):
+    # The literal shape of the bug PR 3 fixed in ops/pallas_encoder.py:
+    # the kill switch read once, at import, into a module constant — the
+    # breaker's runtime env flip never reached later traces.
+    rep = lint(tmp_path, {"ops/pallas_encoder.py": """
+        import os as _os
+
+        ENABLE = _os.environ.get("RAFT_FUSED_ENCODERS", "1").lower() not in (
+            "0", "false", "no", "")
+    """})
+    assert "GL001" in codes(rep)
+    assert "RAFT_FUSED_ENCODERS" in rep.findings[0].message
+
+
+def test_gl001_trace_time_read_ok(tmp_path):
+    rep = lint(tmp_path, {"m.py": """
+        import os as _os
+
+        def ENABLE():
+            return _os.environ.get("RAFT_FUSED_ENCODERS", "1") != "0"
+    """})
+    assert rep.ok
+
+
+def test_gl001_lru_cached_read_flagged(tmp_path):
+    rep = lint(tmp_path, {"m.py": """
+        import functools
+        import os
+
+        @functools.lru_cache(maxsize=None)
+        def enabled():
+            return os.environ.get("RAFT_SWITCH", "1") != "0"
+    """})
+    assert codes(rep) == ["GL001"]
+
+
+def test_gl001_class_scope_read_flagged(tmp_path):
+    rep = lint(tmp_path, {"m.py": """
+        import os
+
+        class K:
+            FLAG = os.environ.get("RAFT_SWITCH", "1")
+    """})
+    assert codes(rep) == ["GL001"]
+
+
+def test_gl001_non_raft_key_ignored(tmp_path):
+    rep = lint(tmp_path, {"m.py": """
+        import os
+        HOME = os.environ.get("HOME", "/root")
+        CACHE = os.environ["XDG_CACHE_HOME"]
+    """})
+    assert rep.ok
+
+
+def test_gl001_survives_dotted_os_path_import(tmp_path):
+    # `import os.path` binds the root name `os`; the alias map must not
+    # resolve os.environ to os.path.environ and hide the read (this
+    # exact hole once made the PR 3 regression fixture invisible)
+    rep = lint(tmp_path, {"m.py": """
+        import os.path
+
+        ENABLE = os.environ.get("RAFT_FUSED_ENCODERS", "1")
+    """})
+    assert codes(rep) == ["GL001"]
+
+
+def test_gl001_getenv_and_subscript_forms(tmp_path):
+    rep = lint(tmp_path, {"m.py": """
+        import os
+        A = os.getenv("RAFT_A")
+        B = os.environ["RAFT_B"]
+    """})
+    assert codes(rep) == ["GL001", "GL001"]
+
+
+# ---------------------------------------------------------------------------
+# GL002 — knob-registry drift
+# ---------------------------------------------------------------------------
+
+def test_gl002_unregistered_knob_in_ops(tmp_path):
+    rep = lint(tmp_path, {"ops/k.py": """
+        import os
+
+        def tile():
+            return int(os.environ.get("RAFT_NEW_TILE", 256))
+    """}, knobs=("RAFT_OTHER",))
+    assert codes(rep) == ["GL002"]
+
+
+def test_gl002_registered_knob_ok(tmp_path):
+    rep = lint(tmp_path, {"ops/k.py": """
+        import os
+
+        def tile():
+            return int(os.environ.get("RAFT_NEW_TILE", 256))
+    """}, knobs=("RAFT_NEW_TILE",))
+    assert rep.ok
+
+
+def test_gl002_outside_forward_dirs_ignored(tmp_path):
+    rep = lint(tmp_path, {"serve/k.py": """
+        import os
+
+        def f():
+            return os.environ.get("RAFT_WHATEVER", "1")
+    """}, knobs=())
+    assert rep.ok
+
+
+def test_gl002_real_tree_dropped_knob_fails():
+    # Acceptance fixture: drop RAFT_CORR_TILE from the registry while its
+    # read still exists in corr/pallas_reg.py -> GL002 must fire.
+    files = collect_files([str(PACKAGE)], base=str(REPO))
+    reduced = tuple(k for k in knobs.ENV_KNOBS if k != "RAFT_CORR_TILE")
+    rep = run_checkers(Project(files, knobs=reduced))
+    hits = [f for f in rep.findings if f.code == "GL002"]
+    assert hits and "RAFT_CORR_TILE" in hits[0].message
+    assert hits[0].path.endswith("corr/pallas_reg.py")
+
+
+# ---------------------------------------------------------------------------
+# GL003 — cache-key completeness
+# ---------------------------------------------------------------------------
+
+def test_gl003_missing_field_flagged(tmp_path):
+    rep = lint(tmp_path, {"cfg.py": CFG_SRC, "session.py": """
+        def config_fingerprint(cfg):
+            return (cfg.corr_implementation, cfg.corr_levels,
+                    cfg.fused_update)
+    """})
+    assert codes(rep) == ["GL003"]
+    assert "mixed_precision" in rep.findings[0].message
+
+
+def test_gl003_explicit_complete_ok(tmp_path):
+    rep = lint(tmp_path, {"cfg.py": CFG_SRC, "session.py": """
+        def config_fingerprint(cfg):
+            return (cfg.corr_implementation, cfg.corr_levels,
+                    cfg.fused_update, getattr(cfg, "mixed_precision"))
+    """})
+    assert rep.ok
+
+
+def test_gl003_dataclasses_fields_ok(tmp_path):
+    # the shipped pattern: generic iteration is conservative-by-default
+    rep = lint(tmp_path, {"cfg.py": CFG_SRC, "session.py": """
+        import dataclasses
+
+        def config_fingerprint(cfg):
+            return tuple(sorted(
+                (f.name, repr(getattr(cfg, f.name)))
+                for f in dataclasses.fields(cfg)))
+    """})
+    assert rep.ok
+
+
+def test_gl003_new_config_field_breaks_stale_fingerprint(tmp_path):
+    # the drift direction that bites: config GROWS a field, the
+    # hand-enumerated fingerprint doesn't — new field aliases programs
+    rep = lint(tmp_path, {
+        "cfg.py": CFG_SRC.replace(
+            "mixed_precision: bool = False",
+            "mixed_precision: bool = False\n        new_knob: int = 0"),
+        "session.py": """
+        def config_fingerprint(cfg):
+            return (cfg.corr_implementation, cfg.corr_levels,
+                    cfg.fused_update, cfg.mixed_precision)
+    """})
+    assert codes(rep) == ["GL003"]
+    assert "new_knob" in rep.findings[0].message
+
+
+def test_gl003_helper_named_fields_does_not_disable_check(tmp_path):
+    # only a call resolving to dataclasses.fields counts as generic
+    # iteration; an arbitrary helper named `fields` must not silence GL003
+    rep = lint(tmp_path, {"cfg.py": CFG_SRC, "session.py": """
+        def fields(x):
+            return x
+
+        def config_fingerprint(cfg):
+            return fields((cfg.corr_implementation, cfg.corr_levels))
+    """})
+    assert "GL003" in codes(rep)
+
+
+def test_gl003_from_import_fields_alias_ok(tmp_path):
+    rep = lint(tmp_path, {"cfg.py": CFG_SRC, "session.py": """
+        from dataclasses import fields as dc_fields
+
+        def config_fingerprint(cfg):
+            return tuple((f.name, getattr(cfg, f.name))
+                         for f in dc_fields(cfg))
+    """})
+    assert rep.ok
+
+
+# ---------------------------------------------------------------------------
+# GL004 — lock discipline
+# ---------------------------------------------------------------------------
+
+def test_gl004_half_guarded_attr_flagged(tmp_path):
+    rep = lint(tmp_path, {"serve/s.py": """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._metrics = {}
+
+            def ok(self):
+                with self._lock:
+                    self._metrics["a"] = 1
+
+            def racy(self):
+                self._metrics["b"] = 2
+    """})
+    assert codes(rep) == ["GL004"]
+    assert "racy" in rep.findings[0].message
+
+
+def test_gl004_all_guarded_ok(tmp_path):
+    rep = lint(tmp_path, {"serve/s.py": """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = {}
+
+            def a(self):
+                with self._lock:
+                    self._q["a"] = 1
+
+            def b(self):
+                with self._lock:
+                    self._q.pop("a", None)
+    """})
+    assert rep.ok
+
+
+def test_gl004_init_mutation_exempt(tmp_path):
+    rep = lint(tmp_path, {"serve/s.py": """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = {}
+                self._q["seed"] = 1
+
+            def a(self):
+                with self._lock:
+                    self._q["a"] = 1
+    """})
+    assert rep.ok
+
+
+def test_gl004_never_guarded_attr_ignored(tmp_path):
+    # an attribute with no guarded site anywhere is not this bug class
+    rep = lint(tmp_path, {"serve/s.py": """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+
+            def bump(self):
+                self.count += 1
+    """})
+    assert rep.ok
+
+
+def test_gl004_mutator_call_outside_lock(tmp_path):
+    rep = lint(tmp_path, {"serve/s.py": """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = []
+
+            def a(self):
+                with self._lock:
+                    self.items.append(1)
+
+            def b(self):
+                self.items.clear()
+    """})
+    assert codes(rep) == ["GL004"]
+
+
+def test_gl004_no_common_lock_flagged(tmp_path):
+    rep = lint(tmp_path, {"serve/s.py": """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+                self.q = {}
+
+            def m1(self):
+                with self._a:
+                    self.q["x"] = 1
+
+            def m2(self):
+                with self._b:
+                    self.q["y"] = 2
+    """})
+    assert codes(rep) == ["GL004"]
+    assert "no common lock" in rep.findings[0].message
+
+
+def test_gl004_nested_lock_shares_common(tmp_path):
+    # session.py's real pattern: _estimates popped under _cache_lock AND
+    # _est_lock nested; common lock with _record_time's bare _est_lock
+    rep = lint(tmp_path, {"serve/s.py": """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._cache_lock = threading.Lock()
+                self._est_lock = threading.Lock()
+                self._est = {}
+
+            def record(self, k, v):
+                with self._est_lock:
+                    self._est[k] = v
+
+            def evict(self, k):
+                with self._cache_lock:
+                    with self._est_lock:
+                        self._est.pop(k, None)
+    """})
+    assert rep.ok
+
+
+# ---------------------------------------------------------------------------
+# GL005 — trace purity
+# ---------------------------------------------------------------------------
+
+def test_gl005_time_in_jitted_fn(tmp_path):
+    rep = lint(tmp_path, {"m.py": """
+        import time
+        import jax
+
+        @jax.jit
+        def fwd(x):
+            t0 = time.time()
+            return x + t0
+    """})
+    assert codes(rep) == ["GL005"]
+
+
+def test_gl005_env_read_in_scan_body(tmp_path):
+    rep = lint(tmp_path, {"m.py": """
+        import os
+        from jax import lax
+
+        def forward(xs):
+            def step(carry, x):
+                if os.environ.get("DEBUG"):
+                    x = x * 2
+                return carry, x
+            return lax.scan(step, 0, xs)
+    """})
+    assert codes(rep) == ["GL005"]
+
+
+def test_gl005_np_random_in_pallas_kernel(tmp_path):
+    rep = lint(tmp_path, {"m.py": """
+        import numpy as np
+        from jax.experimental import pallas as pl
+
+        def kernel(x_ref, o_ref):
+            o_ref[...] = x_ref[...] + np.random.rand()
+
+        def run(x):
+            return pl.pallas_call(kernel)(x)
+    """})
+    # GL006 also fires (unregistered pallas module) — expected here
+    assert "GL005" in codes(rep)
+
+
+def test_gl005_global_and_module_dict_mutation(tmp_path):
+    rep = lint(tmp_path, {"m.py": """
+        import jax
+
+        _CACHE = {}
+        _COUNT = 0
+
+        @jax.jit
+        def fwd(x):
+            global _COUNT
+            _COUNT += 1
+            _CACHE["last"] = x
+            return x
+    """})
+    assert "GL005" in codes(rep) and len(codes(rep)) >= 2
+
+
+def test_gl005_pure_traced_fn_ok(tmp_path):
+    rep = lint(tmp_path, {"m.py": """
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        @jax.jit
+        def fwd(x):
+            return jnp.sum(x * 2)
+
+        def forward(xs):
+            def step(c, x):
+                return c + x, c
+            return lax.scan(step, 0, xs)
+    """})
+    assert rep.ok
+
+
+def test_gl005_impure_untraced_fn_ok(tmp_path):
+    # trace-time-by-design helpers (corr_tile, ENABLE) live OUTSIDE the
+    # traced closure — the checker must not chase the call graph
+    rep = lint(tmp_path, {"m.py": """
+        import os
+        import time
+
+        def build_fn():
+            tile = int(os.environ.get("RAFT_TILE", 256))
+            t0 = time.time()
+            return tile, t0
+    """})
+    assert rep.ok
+
+
+def test_gl005_jit_called_form_and_partial(tmp_path):
+    rep = lint(tmp_path, {"m.py": """
+        import functools
+        import time
+        import jax
+
+        def fwd(x):
+            return x + time.time()
+
+        f = jax.jit(fwd)
+
+        @functools.partial(jax.jit, static_argnums=0)
+        def g(n, x):
+            return x * time.perf_counter()
+    """})
+    assert codes(rep) == ["GL005", "GL005"]
+
+
+def test_gl005_untraced_namesake_not_flagged(tmp_path):
+    # a host-side helper sharing a traced closure's name must not be
+    # flagged: name resolution follows Python scoping at the call site
+    rep = lint(tmp_path, {"m.py": """
+        import time
+        from jax import lax
+
+        def forward(xs):
+            def step(c, x):
+                return c + x, c
+            return lax.scan(step, 0, xs)
+
+        def step(label):
+            return time.perf_counter(), label
+    """})
+    assert rep.ok
+
+
+def test_env_write_is_not_a_read(tmp_path):
+    # os.environ["RAFT_X"] = "1" is a WRITE — no GL001/GL002
+    rep = lint(tmp_path, {"ops/m.py": """
+        import os
+        os.environ["RAFT_DEBUG_DUMP"] = "1"
+
+        def clear():
+            del os.environ["RAFT_DEBUG_DUMP"]
+    """}, knobs=())
+    assert rep.ok
+
+
+# ---------------------------------------------------------------------------
+# GL006 — kill-switch coverage
+# ---------------------------------------------------------------------------
+
+def _gl006(rep):
+    return [f for f in rep.findings if f.code == "GL006"]
+
+
+def test_gl006_unregistered_pallas_module(tmp_path):
+    rep = lint(tmp_path, {"ops/newkern.py": KERNEL_SRC,
+                          "serve/guard.py": GUARD_SRC},
+               knobs=("RAFT_MYKERN",), kernel_entries={})
+    assert [f.path for f in _gl006(rep)] == ["ops/newkern.py"]
+
+
+def test_gl006_registered_module_ok(tmp_path):
+    rep = lint(tmp_path, {"ops/newkern.py": KERNEL_SRC,
+                          "serve/guard.py": GUARD_SRC,
+                          "cfg.py": CFG_SRC},
+               knobs=("RAFT_MYKERN",),
+               kernel_entries={"ops/newkern.py":
+                               knobs.KernelEntry(rungs=("my_kernel",))})
+    assert not _gl006(rep) and rep.ok
+
+
+def test_gl006_unknown_rung_flagged(tmp_path):
+    rep = lint(tmp_path, {"ops/newkern.py": KERNEL_SRC,
+                          "serve/guard.py": GUARD_SRC},
+               knobs=("RAFT_MYKERN",),
+               kernel_entries={"ops/newkern.py":
+                               knobs.KernelEntry(rungs=("renamed_rung",))})
+    hits = _gl006(rep)
+    assert hits and "renamed_rung" in hits[0].message
+
+
+def test_gl006_switch_never_read_flagged(tmp_path):
+    src = KERNEL_SRC.replace('os.environ.get("RAFT_MYKERN", "1") != "0"',
+                             "True")
+    rep = lint(tmp_path, {"ops/newkern.py": src,
+                          "serve/guard.py": GUARD_SRC},
+               knobs=("RAFT_MYKERN",),
+               kernel_entries={"ops/newkern.py":
+                               knobs.KernelEntry(rungs=("my_kernel",))})
+    hits = _gl006(rep)
+    assert hits and "RAFT_MYKERN" in hits[0].message
+
+
+def test_gl006_cfg_rung_field_must_exist(tmp_path):
+    rep = lint(tmp_path, {"ops/newkern.py": KERNEL_SRC,
+                          "serve/guard.py": GUARD_SRC,
+                          "cfg.py": CFG_SRC.replace(
+                               "fused_update", "renamed_field")},
+               knobs=("RAFT_MYKERN",),
+               kernel_entries={"ops/newkern.py":
+                               knobs.KernelEntry(
+                                   rungs=("my_kernel", "cfg_rung"))})
+    hits = _gl006(rep)
+    assert hits and "fused_update" in hits[0].message
+
+
+def test_gl006_exempt_module_ok(tmp_path):
+    src = KERNEL_SRC.replace('os.environ.get("RAFT_MYKERN", "1") != "0"',
+                             "True")
+    rep = lint(tmp_path, {"ops/newkern.py": src,
+                          "serve/guard.py": GUARD_SRC},
+               knobs=(), kernel_entries={
+                   "ops/newkern.py": knobs.KernelEntry(
+                       exempt="debug-only kernel, never served")})
+    assert not _gl006(rep)
+
+
+def test_gl006_suffix_match_is_segment_bounded(tmp_path):
+    # 'xcorr/pallas_reg.py' must NOT inherit the 'corr/pallas_reg.py'
+    # registry entry — it needs its own declaration
+    rep = lint(tmp_path, {"xcorr/pallas_reg.py": KERNEL_SRC,
+                          "serve/guard.py": GUARD_SRC},
+               knobs=("RAFT_MYKERN",),
+               kernel_entries={"corr/pallas_reg.py":
+                               knobs.KernelEntry(rungs=("my_kernel",))})
+    hits = _gl006(rep)
+    assert hits and "no entry" in hits[0].message
+
+
+def test_gl006_stale_entry_flagged(tmp_path):
+    rep = lint(tmp_path, {"ops/nokernel.py": "X = 1\n",
+                          "serve/guard.py": GUARD_SRC},
+               kernel_entries={"ops/nokernel.py":
+                               knobs.KernelEntry(rungs=("my_kernel",))})
+    hits = _gl006(rep)
+    assert hits and "stale" in hits[0].message
+
+
+def test_gl006_real_tree_dropped_entry_fails():
+    files = collect_files([str(PACKAGE)], base=str(REPO))
+    reduced = dict(knobs.KERNEL_ENTRY_POINTS)
+    del reduced["ops/pallas_encoder.py"]
+    rep = run_checkers(Project(files, kernel_entries=reduced))
+    hits = [f for f in rep.findings if f.code == "GL006"]
+    assert hits and hits[0].path.endswith("ops/pallas_encoder.py")
+
+
+# ---------------------------------------------------------------------------
+# the real tree: clean, and NOT vacuously so
+# ---------------------------------------------------------------------------
+
+def test_real_tree_zero_unsuppressed_findings():
+    """Tier-1 gate: the package linted with the real registries is clean.
+    Any new finding must be fixed or suppressed-with-reason in the same
+    change that introduces it."""
+    rep = run_analysis([str(PACKAGE)], base=str(REPO))
+    assert rep.findings == [], "\n" + rep.render_text()
+
+
+def test_real_tree_checks_are_not_vacuous():
+    """Guard the guards: the cross-file context every checker needs must
+    actually resolve on the real tree — a refactor that silently breaks
+    extraction (e.g. DEFAULT_LADDER becoming unparseable to the linter)
+    would otherwise turn GL003/GL005/GL006 into no-ops."""
+    from raft_stereo_tpu.analysis.checkers.gl005_trace_purity import \
+        _traced_functions
+    from raft_stereo_tpu.analysis.checkers.gl006_kill_switch import \
+        _pallas_calls
+    files = collect_files([str(PACKAGE)], base=str(REPO))
+    proj = Project(files)
+    ladder = proj.ladder()
+    assert ladder is not None and len(ladder) == 6
+    assert {r.name for r in ladder} >= {"corr_kernel", "fused_update"}
+    fields = proj.config_fields()
+    assert fields is not None and "corr_implementation" in fields
+    traced = sum(len(_traced_functions(sf)) for sf in files
+                 if sf.tree is not None)
+    assert traced >= 5  # session closures, eval/train steps, scan bodies
+    pallas_modules = [sf.relpath for sf in files
+                      if sf.tree is not None and _pallas_calls(sf)]
+    assert sorted(pallas_modules) == [
+        "raft_stereo_tpu/corr/pallas_alt.py",
+        "raft_stereo_tpu/corr/pallas_reg.py",
+        "raft_stereo_tpu/ops/pallas_encoder.py",
+        "raft_stereo_tpu/ops/pallas_stream.py",
+    ]
+
+
+def test_registry_is_single_source_of_truth():
+    """session.py and guard.py consume analysis/knobs.py, and every
+    env-var ladder rung is registered (the three-hand-synced-lists
+    failure mode this PR removes)."""
+    from raft_stereo_tpu.serve import guard, session
+    assert session._ENV_KNOBS is knobs.ENV_KNOBS
+    for p in guard.DEFAULT_LADDER:
+        if p.env_var is not None:
+            assert p.env_var in knobs.ENV_KNOBS, p.name
+
+
+# ---------------------------------------------------------------------------
+# CLI + scripts wiring
+# ---------------------------------------------------------------------------
+
+def _run_cli(args, cwd=REPO):
+    return subprocess.run([sys.executable, "-m", "raft_stereo_tpu.analysis",
+                           *args], cwd=str(cwd), capture_output=True,
+                          text=True)
+
+
+def test_cli_clean_tree_exits_zero():
+    res = _run_cli([str(PACKAGE)])
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "0 finding(s)" in res.stdout
+
+
+def test_cli_violation_exits_one_and_json(tmp_path):
+    bad = tmp_path / "ops"
+    bad.mkdir()
+    (bad / "k.py").write_text(
+        'import os\nE = os.environ.get("RAFT_X", "1")\n')
+    res = _run_cli(["--json", str(tmp_path)])
+    assert res.returncode == 1
+    payload = json.loads(res.stdout)
+    assert payload["ok"] is False
+    assert [f["code"] for f in payload["findings"]] == ["GL001", "GL002"]
+
+
+def test_cli_list_checkers():
+    res = _run_cli(["--list-checkers"])
+    assert res.returncode == 0
+    for code in ("GL001", "GL002", "GL003", "GL004", "GL005", "GL006"):
+        assert code in res.stdout
+
+
+def test_cli_nonexistent_path_is_usage_error():
+    res = _run_cli(["/nonexistent/never"])
+    assert res.returncode == 2
+
+
+def test_lint_sh_clean_and_injected_violation(tmp_path):
+    """The release-gate step: scripts/lint.sh is clean on the real tree
+    and exits nonzero on an injected violation (acceptance criterion)."""
+    script = REPO / "scripts" / "lint.sh"
+    res = subprocess.run(["bash", str(script)], cwd=str(REPO),
+                         capture_output=True, text=True)
+    assert res.returncode == 0, res.stdout + res.stderr
+    bad = tmp_path / "serve"
+    bad.mkdir()
+    (bad / "racy.py").write_text(textwrap.dedent("""
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.q = {}
+
+            def a(self):
+                with self._lock:
+                    self.q["a"] = 1
+
+            def b(self):
+                self.q["b"] = 2
+    """))
+    res = subprocess.run(["bash", str(script), str(tmp_path)],
+                         cwd=str(REPO), capture_output=True, text=True)
+    assert res.returncode == 1
+    assert "GL004" in res.stdout
+
+
+def test_release_gate_runs_lint_step():
+    gate = (REPO / "scripts" / "release_gate.sh").read_text()
+    assert "lint.sh" in gate and "graftlint" in gate

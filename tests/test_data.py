@@ -507,3 +507,43 @@ def test_loader_local_rows_partition(tmp_path):
     for f, a, b in zip(full, part_a, part_b):
         np.testing.assert_array_equal(f["image1"][:2], a["image1"])
         np.testing.assert_array_equal(f["image1"][2:], b["image1"])
+
+
+# ---------------------------------------------------------------------------
+# fetch_dataloader worker sizing (SLURM_CPUS_PER_TASK)
+# ---------------------------------------------------------------------------
+
+def test_fetch_dataloader_small_cpu_allocation_clamps(monkeypatch):
+    """SLURM_CPUS_PER_TASK=1 must yield ONE worker, not -1 (a 1-2 CPU
+    allocation previously produced 0/negative workers)."""
+    from types import SimpleNamespace
+
+    from raft_stereo_tpu.data import loader as loader_mod
+
+    class _Dummy:
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i, rng=None):
+            raise NotImplementedError
+
+    monkeypatch.setattr(loader_mod, "fetch_dataset",
+                        lambda cfg, root=None: _Dummy())
+    for cpus, expect in (("1", 1), ("2", 1), ("3", 1), ("8", 6)):
+        monkeypatch.setenv("SLURM_CPUS_PER_TASK", cpus)
+        dl = loader_mod.fetch_dataloader(
+            SimpleNamespace(batch_size=2, num_workers=None))
+        assert dl.num_workers == expect, (cpus, dl.num_workers)
+
+
+def test_fetch_dataloader_garbage_cpu_allocation_is_clear_error(monkeypatch):
+    from types import SimpleNamespace
+
+    from raft_stereo_tpu.data import loader as loader_mod
+
+    monkeypatch.setattr(loader_mod, "fetch_dataset",
+                        lambda cfg, root=None: None)
+    monkeypatch.setenv("SLURM_CPUS_PER_TASK", "4(x2)")
+    with pytest.raises(ValueError, match="SLURM_CPUS_PER_TASK"):
+        loader_mod.fetch_dataloader(
+            SimpleNamespace(batch_size=2, num_workers=None))
