@@ -32,6 +32,28 @@ ENV_KNOBS: Tuple[str, ...] = (
     "RAFT_BATCH_FUSE_PIXELS",  # batch-fusion threshold (ops/pallas_stream.py)
 )
 
+# Serving-behavior env knobs (continuous batching, DESIGN.md r9). These are
+# deliberately NOT in ENV_KNOBS — neither changes what any ONE compiled
+# program computes, so folding them into the config fingerprint would be
+# dishonest cache-key bloat:
+#
+# - RAFT_BATCH_BUCKETS only selects WHICH batch sizes get compiled; the
+#   batch size itself is an explicit cache-key component (``b`` in
+#   ``InferenceSession.cache_key``), so two sessions with different bucket
+#   ladders can safely share every program they both compile;
+# - RAFT_SCHED_TICK_MS is pure host-side scheduling (the idle-poll
+#   interval of the scheduler thread) and never reaches a trace.
+#
+# Registered here so the flag matrix has one home and a future reviewer
+# asking "does this knob need to be in the fingerprint?" finds the answer
+# where the fingerprint is defined.
+SERVE_ENV_KNOBS: Tuple[str, ...] = (
+    "RAFT_BATCH_BUCKETS",   # batch-bucket ladder, e.g. "1,2,4,8"
+                            # (serve/session.py, resolved at construction)
+    "RAFT_SCHED_TICK_MS",   # scheduler idle poll, ms (serve/service.py,
+                            # read at service start)
+)
+
 
 @dataclasses.dataclass(frozen=True)
 class KernelEntry:

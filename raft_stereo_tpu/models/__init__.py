@@ -8,8 +8,12 @@ reference's torch state_dict); the GRU refinement loop is a ``jax.lax.scan``.
 
 from raft_stereo_tpu.models.raft_stereo import (  # noqa: F401
     init_raft_stereo,
+    raft_stereo_epilogue,
     raft_stereo_forward,
     raft_stereo_inference,
     raft_stereo_prepare,
     raft_stereo_segment,
+    raft_stereo_segment_carry,
+    stack_refinement_states,
+    take_refinement_rows,
 )

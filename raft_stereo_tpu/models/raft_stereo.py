@@ -358,22 +358,13 @@ def raft_stereo_prepare(params: Params, cfg: RAFTStereoConfig,
             "coords1": coords1}
 
 
-def raft_stereo_segment(params: Params, cfg: RAFTStereoConfig, state, *,
-                        iters: int, warm_start: bool = False):
-    """Advance the refinement scan ``iters`` steps from a carried state.
-
-    ``state`` is the carry from :func:`raft_stereo_prepare` or a previous
-    segment. The scan body is the one the single-scan test-mode forward
-    compiles — the correlation pyramid is rebuilt from the carried feature
-    maps by the same deterministic ops, so composing segments never changes
-    a bit relative to one long scan. Returns ``(new_state, flow_low,
-    flow_up)``: the low-res flow and the convex-upsampled disparity field
-    after these iterations (the mask head runs once at the segment end,
-    exactly like the single-scan path runs it once after its scan).
-
-    ``warm_start`` mirrors ``flow_init is not None`` in the single-scan
-    forward (it disables motion-encoder fusion the same way).
-    """
+def _advance_carry(params: Params, cfg: RAFTStereoConfig, state, *,
+                   iters: int, warm_start: bool):
+    """Shared segment core: run the scan body ``iters`` steps from a carried
+    state. Returns ``(new_state, coords0, upsampled)`` — the caller decides
+    whether to pay for the mask-head epilogue (:func:`raft_stereo_segment`
+    does; the continuous-batching scheduler advances many carries per tick
+    and runs :func:`raft_stereo_epilogue` only for the rows that exit)."""
     compute_dtype = jnp.bfloat16 if cfg.mixed_precision else jnp.float32
     net = tuple(state["net"])
     inp = [tuple(triple) for triple in state["inp"]]
@@ -391,9 +382,92 @@ def raft_stereo_segment(params: Params, cfg: RAFTStereoConfig, state, *,
 
     (net, coords1), _ = lax.scan(step, (net, state["coords1"]), None,
                                  length=iters)
-    up_mask = apply_mask_head(params["update_block"], net[0])
-    new_state = dict(state, net=net, coords1=coords1)
+    return dict(state, net=net, coords1=coords1), coords0, upsampled
+
+
+def raft_stereo_segment(params: Params, cfg: RAFTStereoConfig, state, *,
+                        iters: int, warm_start: bool = False):
+    """Advance the refinement scan ``iters`` steps from a carried state.
+
+    ``state`` is the carry from :func:`raft_stereo_prepare` or a previous
+    segment. The scan body is the one the single-scan test-mode forward
+    compiles — the correlation pyramid is rebuilt from the carried feature
+    maps by the same deterministic ops, so composing segments never changes
+    a bit relative to one long scan. Returns ``(new_state, flow_low,
+    flow_up)``: the low-res flow and the convex-upsampled disparity field
+    after these iterations (the mask head runs once at the segment end,
+    exactly like the single-scan path runs it once after its scan).
+
+    ``warm_start`` mirrors ``flow_init is not None`` in the single-scan
+    forward (it disables motion-encoder fusion the same way).
+    """
+    new_state, coords0, upsampled = _advance_carry(
+        params, cfg, state, iters=iters, warm_start=warm_start)
+    up_mask = apply_mask_head(params["update_block"], new_state["net"][0])
+    coords1 = new_state["coords1"]
     return new_state, coords1 - coords0, upsampled(coords1, up_mask)
+
+
+def raft_stereo_segment_carry(params: Params, cfg: RAFTStereoConfig, state, *,
+                              iters: int, warm_start: bool = False):
+    """:func:`raft_stereo_segment` minus the mask-head epilogue: advance the
+    carry only. The continuous-batching scheduler runs this once per tick
+    over the whole device batch and pays the epilogue (mask head + convex
+    upsample) only for the rows that exit at this segment boundary —
+    ``raft_stereo_epilogue(segment_carry(state))`` is bit-identical to
+    ``raft_stereo_segment(state)[2]`` because the mask head reads the
+    carried hidden state and never feeds back into it."""
+    new_state, _, _ = _advance_carry(
+        params, cfg, state, iters=iters, warm_start=warm_start)
+    return new_state
+
+
+def raft_stereo_epilogue(params: Params, cfg: RAFTStereoConfig, state):
+    """Mask head + convex upsample from a carried state, without advancing.
+
+    Exactly the segment-end output computation: the same
+    ``apply_mask_head`` call and the same channel-0-sliced fp32 upsample
+    the single-scan test-mode forward and :func:`raft_stereo_segment`
+    perform — so for any carry, ``raft_stereo_epilogue`` returns the same
+    bytes a segment ending at that carry would have. Returns
+    ``(flow_low, flow_up)``.
+    """
+    b, h, w, _ = state["fmap1"].shape
+    coords0 = coords_grid(b, h, w)
+    coords1 = state["coords1"]
+    up_mask = apply_mask_head(params["update_block"], tuple(state["net"])[0])
+    # Mirror of _refinement_closures.upsampled: slice x before upsampling.
+    flow_x = (coords1 - coords0)[..., :1].astype(jnp.float32)
+    flow_up = convex_upsample(flow_x, up_mask.astype(jnp.float32),
+                              cfg.downsample_factor)
+    return coords1 - coords0, flow_up
+
+
+# -- carry-batch composition -------------------------------------------------
+# The serving scheduler composes per-request carries into one device batch
+# (and back) with the two helpers below. Every leaf of the carry dict has a
+# leading batch dim and every op in the scan body is batch-row independent
+# (convs, the corr gather, the epipolar .at[..., 1] update chain), so row i
+# of a stacked carry advances bit-identically to the same carry alone —
+# pinned by tests/test_batch_serve.py.
+
+
+def stack_refinement_states(states):
+    """Concatenate carry dicts along the batch axis (rows keep order)."""
+    if not states:
+        raise ValueError("stack_refinement_states needs >= 1 state")
+    if len(states) == 1:
+        return states[0]
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.concatenate(xs, axis=0), *states)
+
+
+def take_refinement_rows(state, rows: Sequence[int]):
+    """Gather batch rows of a carry dict (repeats allowed — padding a batch
+    to its power-of-two bucket replicates a live row, so pad rows are
+    always well-formed finite carries that are simply never read back)."""
+    idx = jnp.asarray(tuple(int(r) for r in rows), dtype=jnp.int32)
+    return jax.tree_util.tree_map(lambda x: jnp.take(x, idx, axis=0), state)
 
 
 def raft_stereo_inference(params: Params, cfg: RAFTStereoConfig,

@@ -11,6 +11,9 @@ Layers, bottom up:
                   breaker-driven rebuild/retry; the unit that owns params;
 - ``degrade``   — deadline-aware anytime policy over the segmented
                   refinement scan (``models.raft_stereo_segment``);
+- ``scheduler`` — iteration-level continuous batching: requests join a
+                  running device batch at tick boundaries, exit at
+                  segment boundaries (``SessionConfig.max_batch > 1``);
 - ``service``   — bounded queue, backpressure, per-request deadlines,
                   /healthz status.
 
@@ -22,6 +25,9 @@ from raft_stereo_tpu.serve.guard import (  # noqa: F401
     DEFAULT_LADDER,
     FastPath,
     KernelCircuitBreaker,
+)
+from raft_stereo_tpu.serve.scheduler import (  # noqa: F401
+    BatchScheduler,
 )
 from raft_stereo_tpu.serve.service import (  # noqa: F401
     ServiceConfig,

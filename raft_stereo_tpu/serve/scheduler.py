@@ -1,0 +1,477 @@
+"""Iteration-level continuous batching over the segmented refinement scan.
+
+LLM serving schedulers batch at *decode-token* granularity: requests join
+and leave a running device batch between token steps, so the accelerator
+always runs one big program instead of many small ones. RAFT-Stereo's GRU
+refinement has the same shape — the PR 3 segmented scan already advances an
+explicit carry dict ``{net, inp, fmap1, fmap2, coords1}`` k iterations at a
+time, bit-identical to the single scan — so this module batches at
+*segment* granularity:
+
+- each **tick** runs ONE compiled batched ``advance`` program (the segment
+  scan body WITHOUT the mask-head epilogue) over every active request
+  sharing a (padded shape, config) bucket, padded up to a power-of-two
+  **batch bucket** (pad rows are dead carries — replicated live rows that
+  are never read back);
+- **joins** happen at tick boundaries: waiting requests' image pairs are
+  uploaded by a background thread while the current segment executes (the
+  ``device_prefetch`` pattern), then a batched ``prepare`` builds their
+  carries, which are concatenated onto the running batch;
+- **exits** happen at segment boundaries: rows that finished their
+  iterations — or whose per-row deadline provably cannot absorb another
+  batched segment (the EMA cost model is keyed per (program, batch
+  bucket)) — leave the batch and pay the mask-head ``epilogue`` once, as
+  one stacked device round trip;
+- per-request outputs are **provably independent of batchmates**: every op
+  in the scan body is batch-row independent (convs, the corr gather, the
+  epipolar ``.at[..., 1]`` update), so row i of the batched run is the PR 3
+  sequential path's bytes — correctness is inherited, not renegotiated
+  (pinned in tests/test_batch_serve.py).
+
+The scheduler is single-threaded by design: all batch state is owned by
+the one thread calling :meth:`run_tick` (the service's scheduler thread,
+or a test driving ticks deterministically). Only the aggregate metrics are
+lock-shared with /healthz readers.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import queue
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from raft_stereo_tpu.serve.degrade import SAFETY
+from raft_stereo_tpu.serve.guard import is_kernel_failure
+from raft_stereo_tpu.serve.session import (InferenceFailed, InferenceSession,
+                                           SessionError)
+
+logger = logging.getLogger(__name__)
+
+
+def _reject(code: str, message: str) -> Dict:
+    return {"status": "rejected", "code": code, "message": message}
+
+
+def _error(code: str, message: str) -> Dict:
+    return {"status": "error", "code": code, "message": message}
+
+
+class _Row:
+    """Bookkeeping for one admitted request while it rides the batch."""
+
+    __slots__ = ("request", "padder", "orig_h", "orig_w", "deadline",
+                 "iters_done", "t_start", "dev_pair", "upload_error",
+                 "uploaded")
+
+    def __init__(self, request, padder, deadline, t_start):
+        self.request = request
+        self.padder = padder
+        self.orig_h = request["left"].shape[1]
+        self.orig_w = request["left"].shape[2]
+        self.deadline = deadline
+        self.iters_done = 0
+        self.t_start = t_start
+        self.dev_pair = None
+        self.upload_error: Optional[Exception] = None
+        self.uploaded = threading.Event()
+
+
+class _Bucket:
+    """Active batch + FIFO of waiting joiners for one padded shape."""
+
+    def __init__(self, key: Tuple[int, int]):
+        self.key = key                      # (padded_h, padded_w)
+        self.rows: List[_Row] = []          # row i of carry == rows[i]
+        # Batched state dict; its leading dim may EXCEED len(rows) — live
+        # rows are the prefix, the rest are dead pad rows. Keeping the
+        # carry at batch-bucket width between ticks means a steady
+        # occupancy that is not itself a bucket size (say 5 under
+        # buckets 4/8) pays the pad/trim gathers only when the batch
+        # composition changes, not on every segment.
+        self.carry = None
+        self.pending: "collections.deque[_Row]" = collections.deque()
+
+    @property
+    def carry_width(self) -> int:
+        return 0 if self.carry is None else int(
+            self.carry["coords1"].shape[0])
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.rows or self.pending)
+
+
+class _Uploader:
+    """Background host->device transfer: pads and uploads a joiner's image
+    pair while the current segment executes on device, so a join costs the
+    batch a carry concat, not a host round trip (train.py's
+    ``device_prefetch`` pattern applied to serving)."""
+
+    def __init__(self):
+        self._q: "queue.Queue[Optional[_Row]]" = queue.Queue()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="stereo-uploader")
+        self._thread.start()
+
+    def push(self, row: _Row) -> None:
+        self._q.put(row)
+
+    def stop(self) -> None:
+        self._q.put(None)
+
+    def _loop(self) -> None:
+        import jax
+        while True:
+            row = self._q.get()
+            if row is None:
+                return
+            try:
+                lp, rp = row.padder.pad_np(row.request["left"],
+                                           row.request["right"])
+                row.dev_pair = (jax.device_put(lp), jax.device_put(rp))
+            except Exception as e:  # noqa: BLE001 — surfaced per-row
+                row.upload_error = e
+            row.uploaded.set()
+
+
+class BatchScheduler:
+    """Continuous-batching engine over one :class:`InferenceSession`.
+
+    ``resolve(row_request, response)`` is called exactly once per admitted
+    request (the service wires its Future resolution + counters in; tests
+    collect responses). All scheduling state is confined to the thread
+    calling :meth:`submit` / :meth:`run_tick`.
+    """
+
+    def __init__(self, session: InferenceSession, *,
+                 resolve: Optional[Callable[[Dict, Dict], None]] = None):
+        if session.cfg.max_batch < 2:
+            raise ValueError("BatchScheduler needs SessionConfig.max_batch "
+                             ">= 2; use the sequential worker path at 1")
+        self.session = session
+        self.resolve = resolve or self._default_resolve
+        self.uploader = _Uploader()
+        self._buckets: Dict[Tuple[int, int], _Bucket] = {}
+        self._rr: List[Tuple[int, int]] = []   # round-robin bucket order
+        self._rr_next = 0
+        # Guards the metrics AND the bucket map itself: /healthz readers
+        # iterate the map from other threads while submit() (scheduler
+        # thread) inserts new shape buckets. Per-bucket rows/carries need
+        # no lock — they are touched only by the scheduling thread.
+        self._lock = threading.Lock()
+        self._m = {"ticks": 0, "joins": 0, "exits": 0,
+                   "pad_rows": 0, "batch_rows": 0}
+        self._occupancy: collections.Counter = collections.Counter()
+        self._tick_lat: "collections.deque[float]" = collections.deque(
+            maxlen=512)
+
+    # -- request intake ---------------------------------------------------
+
+    @staticmethod
+    def _default_resolve(request: Dict, resp: Dict) -> None:
+        fut = request.get("_future")
+        if fut is not None:
+            try:
+                fut.set_result(resp)
+            except Exception:  # already resolved/cancelled
+                pass
+
+    def submit(self, request: Dict) -> None:
+        """Admit one validated request (arrays already canonical, deadline
+        already stamped as ``_deadline``) into its shape bucket's join
+        queue and start its host->device upload immediately."""
+        padder = self.session.padder_for(request["left"].shape)
+        row = _Row(request, padder, request.get("_deadline"),
+                   self.session.clock.now())
+        key = padder.padded_shape
+        bucket = self._buckets.get(key)
+        if bucket is None:
+            with self._lock:
+                bucket = self._buckets[key] = _Bucket(key)
+            self._rr.append(key)
+        bucket.pending.append(row)
+        self.uploader.push(row)
+
+    def _bucket_list(self) -> List[_Bucket]:
+        with self._lock:
+            return list(self._buckets.values())
+
+    @property
+    def has_work(self) -> bool:
+        return any(b.has_work for b in self._bucket_list())
+
+    @property
+    def active_rows(self) -> int:
+        return sum(len(b.rows) for b in self._bucket_list())
+
+    # -- the tick ---------------------------------------------------------
+
+    def run_tick(self) -> bool:
+        """Run one scheduler tick on the next bucket with work (round
+        robin). Returns False when every bucket is idle. Never raises: a
+        terminal failure fails the affected bucket's requests with
+        structured error responses and clears that bucket."""
+        bucket = self._next_bucket()
+        if bucket is None:
+            return False
+        t0 = time.perf_counter()
+        try:
+            self._tick_bucket(bucket)
+        except Exception as e:  # noqa: BLE001 — the crash-proof boundary
+            logger.exception("tick failed for bucket %s", bucket.key)
+            self._fail_bucket(bucket, e)
+        dt = time.perf_counter() - t0
+        with self._lock:
+            self._m["ticks"] += 1
+            self._tick_lat.append(dt)
+        return True
+
+    def _next_bucket(self) -> Optional[_Bucket]:
+        for _ in range(len(self._rr)):
+            key = self._rr[self._rr_next % len(self._rr)]
+            self._rr_next += 1
+            b = self._buckets[key]
+            # A bucket whose only work is still uploading counts as work
+            # (has_work) but cannot tick yet — skip it this round.
+            if b.rows or (b.pending and b.pending[0].uploaded.is_set()):
+                return b
+        return None
+
+    def _tick_bucket(self, bucket: _Bucket) -> None:
+        from raft_stereo_tpu.models import (stack_refinement_states,
+                                            take_refinement_rows)
+        session = self.session
+        clock = session.clock
+        m_iters = session.cfg.valid_iters // session.cfg.segments
+        ph, pw = bucket.key
+
+        # 1. Joins: admit uploaded joiners (FIFO) up to capacity; one
+        # batched prepare builds their carries.
+        joiners: List[_Row] = []
+        capacity = session.cfg.max_batch - len(bucket.rows)
+        while capacity > 0 and bucket.pending and \
+                bucket.pending[0].uploaded.is_set():
+            row = bucket.pending.popleft()
+            if row.upload_error is not None:
+                self.session.count_request(ok=False)
+                self._respond(row, _error(
+                    "internal", f"upload failed: {row.upload_error}"))
+                continue
+            now = clock.now()
+            if row.deadline is not None and now >= row.deadline:
+                self._respond(row, _reject(
+                    "deadline_exceeded_in_queue",
+                    "deadline expired before the request joined a batch"))
+                continue
+            joiners.append(row)
+            capacity -= 1
+        if joiners:
+            bb = session.batch_bucket(len(joiners))
+            import jax.numpy as jnp
+            lefts = [r.dev_pair[0] for r in joiners]
+            rights = [r.dev_pair[1] for r in joiners]
+            pad = bb - len(joiners)
+            lb = jnp.concatenate(lefts + [lefts[0]] * pad, axis=0)
+            rb = jnp.concatenate(rights + [rights[0]] * pad, axis=0)
+            (state_j,) = self._device_call("prepare", ph, pw, 0, bb, lb, rb)
+            if pad:
+                state_j = take_refinement_rows(state_j, range(len(joiners)))
+            if bucket.carry is None:
+                bucket.carry = state_j
+            else:
+                live = (bucket.carry
+                        if bucket.carry_width == len(bucket.rows) else
+                        take_refinement_rows(bucket.carry,
+                                             range(len(bucket.rows))))
+                bucket.carry = stack_refinement_states([live, state_j])
+            bucket.rows.extend(joiners)
+            with self._lock:
+                self._m["joins"] += len(joiners)
+
+        n = len(bucket.rows)
+        if n == 0:
+            return
+
+        # 2. One batched segment over the whole active set, padded up to
+        # its batch bucket (pad rows replicate row 0 — dead carries). The
+        # output stays at bucket width: a steady composition re-enters
+        # here next tick with carry_width == bb and pays no gather.
+        bb = session.batch_bucket(n)
+        if bucket.carry_width != bb:
+            bucket.carry = take_refinement_rows(
+                bucket.carry, list(range(n)) + [0] * (bb - n))
+        adv_key = session.cache_key("advance", ph, pw, m_iters, b=bb)
+        state, _rowsum = self._device_call(
+            "advance", ph, pw, m_iters, bb, bucket.carry)
+        bucket.carry = state
+        for row in bucket.rows:
+            row.iters_done += m_iters
+        with self._lock:
+            self._occupancy[n] += 1
+            self._m["batch_rows"] += bb
+            self._m["pad_rows"] += bb - n
+
+        # 3. Exits: finished rows, plus rows whose deadline cannot absorb
+        # another batched segment (per-row anytime degradation — the first
+        # segment always runs because this check only happens after one).
+        now = clock.now()
+        est = session.estimate(adv_key)
+        exits: List[int] = []
+        for i, row in enumerate(bucket.rows):
+            if row.iters_done >= session.cfg.valid_iters:
+                exits.append(i)
+            elif row.deadline is not None and (
+                    now >= row.deadline
+                    or (est is not None
+                        and now + est * SAFETY > row.deadline)):
+                exits.append(i)
+        if not exits:
+            return
+        eb = session.batch_bucket(len(exits))
+        ex_state = take_refinement_rows(
+            bucket.carry, exits + [exits[0]] * (eb - len(exits)))
+        (flow_up,) = self._device_call("epilogue", ph, pw, 0, eb, ex_state)
+        now = clock.now()
+        for j, i in enumerate(exits):
+            self._finish(bucket.rows[i], flow_up[j:j + 1], now)
+        with self._lock:
+            self._m["exits"] += len(exits)
+        survivors = [i for i in range(n) if i not in set(exits)]
+        bucket.rows = [bucket.rows[i] for i in survivors]
+        bucket.carry = (take_refinement_rows(bucket.carry, survivors)
+                        if survivors else None)
+
+    # -- device calls with breaker retry ----------------------------------
+
+    def _device_call(self, kind: str, ph: int, pw: int, iters: int,
+                     b: int, *args):
+        """get_program + invoke, walking the breaker ladder on classified
+        kernel failures exactly like the sequential path (the carry is
+        plain data — it composes with a rebuilt rung's programs)."""
+        session = self.session
+        last: Optional[Exception] = None
+        for _ in range(len(session.breaker.ladder) + 1):
+            try:
+                prog = session.get_program(kind, ph, pw, iters, b=b)
+                return session.invoke(prog, *args)
+            except Exception as e:  # noqa: BLE001 — filtered just below
+                if isinstance(e, SessionError) or not is_kernel_failure(e):
+                    raise
+                last = e
+                session._breaker_retry(
+                    e, getattr(e, "_raft_phase", "runtime_failure"))
+        raise InferenceFailed(
+            "ladder_exhausted", f"breaker retries exhausted: {last}")
+
+    # -- responses --------------------------------------------------------
+
+    def _respond(self, row: _Row, resp: Dict) -> None:
+        if row.request.get("id") is not None:
+            resp.setdefault("id", row.request["id"])
+        self.resolve(row.request, resp)
+
+    def _finish(self, row: _Row, flow_padded: np.ndarray, now: float) -> None:
+        session = self.session
+        flow = row.padder.unpad_np(flow_padded)[0, ..., 0]
+        quality = ("full" if row.iters_done >= session.cfg.valid_iters
+                   else f"reduced_iters:{row.iters_done}")
+        if flow.shape != (row.orig_h, row.orig_w):
+            session.count_request(ok=False)
+            self._respond(row, _error(
+                "internal", f"output shape {flow.shape} != input "
+                f"({row.orig_h}, {row.orig_w})"))
+            return
+        if not np.isfinite(flow).all():
+            session.count_request(ok=False, nonfinite=True)
+            self._respond(row, _error(
+                "nonfinite_output",
+                "disparity contains NaN/Inf — refusing to serve it"))
+            return
+        session.count_request(ok=True, degraded=quality != "full")
+        self._respond(row, {
+            "status": "ok",
+            "quality": quality,
+            "disparity": -flow,
+            "iters": row.iters_done,
+            "elapsed_ms": (now - row.t_start) * 1e3,
+            "deadline_missed": (row.deadline is not None
+                                and now > row.deadline),
+        })
+
+    def _fail_bucket(self, bucket: _Bucket, exc: Exception) -> None:
+        """Terminal tick failure: every request in the bucket gets a
+        structured error (never an abandoned Future), the bucket resets."""
+        code = exc.code if isinstance(exc, SessionError) else "internal"
+        for row in list(bucket.rows) + list(bucket.pending):
+            # Mirror the sequential path's accounting (infer() increments
+            # requests_failed on every exception): /healthz session
+            # counters stay one truth across serving modes.
+            self.session.count_request(ok=False)
+            self._respond(row, _error(
+                code, f"batched tick failed: {exc}"))
+        bucket.rows = []
+        bucket.carry = None
+        bucket.pending.clear()
+
+    def drain_pending(self, code: str = "service_stopped",
+                      message: str = "service stopped before this request "
+                                     "ran") -> None:
+        """Reject joiners that never made it into a batch (shutdown path:
+        active rows keep ticking to their segment-boundary exits — they
+        already own device state — while un-admitted work is returned with
+        the same structured rejection the sequential stop() uses)."""
+        for bucket in self._bucket_list():
+            while bucket.pending:
+                self._respond(bucket.pending.popleft(),
+                              _reject(code, message))
+
+    def drain(self, code: str = "service_stopped",
+              message: str = "service stopped before this request ran"
+              ) -> None:
+        """Reject everything still waiting or mid-flight (hard shutdown)."""
+        self.drain_pending(code, message)
+        for bucket in self._bucket_list():
+            for row in bucket.rows:
+                self._respond(row, _reject(code, message))
+            bucket.rows = []
+            bucket.carry = None
+        self.shutdown()
+
+    def shutdown(self) -> None:
+        self.uploader.stop()
+
+    # -- reporting --------------------------------------------------------
+
+    def status(self) -> Dict:
+        with self._lock:
+            m = dict(self._m)
+            occ = {str(k): v for k, v in sorted(self._occupancy.items())}
+            lat = sorted(self._tick_lat)
+
+        def pct(p: float) -> Optional[float]:
+            if not lat:
+                return None
+            return lat[min(len(lat) - 1, int(p * len(lat)))] * 1e3
+
+        ticks = max(1, m["ticks"])
+        return {
+            "max_batch": self.session.cfg.max_batch,
+            "batch_buckets": list(self.session.batch_buckets),
+            "active": self.active_rows,
+            "pending": sum(len(b.pending) for b in self._bucket_list()),
+            "ticks": m["ticks"],
+            "joins": m["joins"],
+            "exits": m["exits"],
+            "joins_per_tick": m["joins"] / ticks,
+            "exits_per_tick": m["exits"] / ticks,
+            "occupancy_hist": occ,
+            "pad_waste": (m["pad_rows"] / m["batch_rows"]
+                          if m["batch_rows"] else 0.0),
+            "tick_latency_ms": {"p50": pct(0.50), "p99": pct(0.99),
+                                "n": len(lat)},
+        }

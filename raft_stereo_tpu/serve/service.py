@@ -14,9 +14,18 @@ Queueing discipline for a latency-bound model server, with nothing but
 - **crash-proof workers**: a worker turns *any* session failure into a
   structured error response — one poisoned request cannot take the
   process down (fault-storm-pinned in tests/test_serve.py);
+- **continuous batching** (``SessionConfig.max_batch > 1``): the worker
+  pool is replaced by ONE scheduler thread driving
+  :class:`~raft_stereo_tpu.serve.scheduler.BatchScheduler` — requests
+  join a running device batch at tick boundaries and exit at segment
+  boundaries. The queue, admission, backpressure (``queue_full``) and
+  response contract are IDENTICAL to the sequential path;
 - **/healthz**: ``status()`` folds session state (bucket cache, breaker
   trips, canary), queue depth, request counters by rejection/error code,
-  degraded-request count, and p50/p99 latency over a sliding window.
+  degraded-request count, p50/p99 latency over a sliding window, and — in
+  batched mode — the ``batching`` document (per-tick occupancy histogram,
+  joins/exits per tick, pad-row waste, tick latency percentiles: the
+  numbers that tune ``--max_batch`` in production).
 
 Every response is a plain dict: ``{"status": "ok" | "rejected" |
 "error", ...}`` — ``ok`` always carries a finite disparity and an honest
@@ -45,6 +54,11 @@ class ServiceConfig:
     # undegraded full-iteration serving by default.
     default_deadline_ms: Optional[float] = None
     latency_window: int = 512
+    # Scheduler idle poll (continuous batching only): how long the
+    # scheduler thread blocks for new work when every bucket is empty.
+    # None reads RAFT_SCHED_TICK_MS at start() (default 2 ms). Purely a
+    # host-side latency/CPU trade — it never shapes a compiled program.
+    tick_ms: Optional[float] = None
 
 
 def _reject(code: str, message: str) -> Dict:
@@ -69,6 +83,16 @@ class StereoService:
         self._latencies: deque = deque(maxlen=self.cfg.latency_window)
         self._lock = threading.Lock()
         self._started = False
+        # Continuous batching engages when the SESSION was built for it
+        # (max_batch shapes compiled programs and warmup, so it lives on
+        # SessionConfig); the service then runs one scheduler thread
+        # instead of the worker pool. The scheduler itself is built per
+        # START generation (like the stop event): stop() permanently ends
+        # its uploader thread and drains its state, so reusing one across
+        # a restart would hang every post-restart request in the join
+        # queue.
+        self._batched = session.cfg.max_batch > 1
+        self._scheduler = None
 
     # -- lifecycle --------------------------------------------------------
 
@@ -83,6 +107,26 @@ class StereoService:
             # it can never be revived as an untracked extra worker.
             self._stop = threading.Event()
             stop_event = self._stop
+        if self._batched:
+            import os
+            from raft_stereo_tpu.serve.scheduler import BatchScheduler
+            tick_ms = self.cfg.tick_ms
+            if tick_ms is None:
+                tick_ms = float(os.environ.get("RAFT_SCHED_TICK_MS", "2"))
+            # Fresh scheduler per generation; a previous generation's
+            # thread (possibly still ticking its own active rows out past
+            # the join timeout) holds only its OWN scheduler and, once its
+            # stop event is set, never touches the shared queue again —
+            # two generations can never race on one batch state.
+            self._scheduler = BatchScheduler(
+                self.session, resolve=self._resolve_scheduled)
+            t = threading.Thread(target=self._scheduler_loop,
+                                 args=(self._scheduler, stop_event,
+                                       tick_ms / 1e3),
+                                 name="stereo-scheduler", daemon=True)
+            t.start()
+            self._workers.append(t)
+            return self
         for i in range(self.cfg.workers):
             t = threading.Thread(target=self._worker_loop,
                                  args=(stop_event,),
@@ -202,8 +246,14 @@ class StereoService:
         return resp
 
     def handle(self, request: Dict) -> Dict:
-        """Synchronous path (no queue): admit, run, respond. The
-        fault-storm battery drives this for deterministic ordering."""
+        """Synchronous path: admit, run, respond. The fault-storm battery
+        drives this for deterministic ordering. In batched mode with the
+        scheduler running, the request rides the batch like any other
+        (submit + wait); otherwise it runs the sequential session path."""
+        with self._lock:
+            batched_live = self._batched and self._started
+        if batched_live:
+            return self.submit(request).result()
         rejection = self._admit(request)
         if rejection is not None:
             if request.get("id") is not None:
@@ -242,6 +292,67 @@ class StereoService:
             fut.set_result(rejection)
         return fut
 
+    # -- continuous batching ----------------------------------------------
+
+    def _resolve_scheduled(self, request: Dict, resp: Dict) -> None:
+        """Scheduler response sink: fold counters/latency exactly like the
+        sequential ``_respond`` path, then resolve the caller's Future."""
+        with self._lock:
+            key = resp["status"]
+            if resp["status"] != "ok":
+                key = f'{resp["status"]}:{resp["code"]}'
+            else:
+                self._latencies.append(resp["elapsed_ms"] / 1e3)
+                if resp.get("quality") != "full":
+                    self._counts["degraded"] += 1
+            self._counts[key] += 1
+        fut = request.get("_future")
+        if fut is not None:
+            try:
+                fut.set_result(resp)
+            except Exception:  # already resolved/cancelled
+                pass
+
+    def _scheduler_loop(self, sched, stop_event: threading.Event,
+                        tick_s: float) -> None:
+        """One thread owns all batch state: drain the bounded queue into
+        the scheduler, run ticks while there is work, block briefly when
+        idle. On stop: the thread never touches the shared queue again
+        (stop()'s own drain — or the next generation — owns it),
+        un-admitted joiners are rejected (same structured
+        ``service_stopped`` the sequential drain uses), and active rows
+        tick to their segment-boundary exits — a row that already owns
+        device state is finished, never abandoned."""
+        while True:
+            if stop_event.is_set():
+                sched.drain_pending()
+                if sched.active_rows == 0:
+                    sched.shutdown()
+                    return
+                sched.run_tick()
+                continue
+            while True:  # drain everything currently queued
+                try:
+                    item = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if item is None:  # wake sentinel
+                    continue
+                request, fut = item
+                request["_future"] = fut
+                sched.submit(request)
+            if stop_event.is_set():
+                continue  # reject/finish via the stop branch above
+            if not sched.run_tick():
+                try:
+                    item = self._queue.get(timeout=tick_s)
+                except queue.Empty:
+                    continue
+                if item is not None:
+                    request, fut = item
+                    request["_future"] = fut
+                    sched.submit(request)
+
     def _worker_loop(self, stop_event: threading.Event) -> None:
         while not stop_event.is_set():
             item = self._queue.get()
@@ -272,9 +383,12 @@ class StereoService:
         return {
             "queue": {"depth": self._queue.qsize(),
                       "max": self.cfg.max_queue,
-                      "workers": self.cfg.workers},
+                      "workers": (1 if self._batched
+                                  else self.cfg.workers)},
             "requests": counts,
             "latency_ms": {"p50": pct(0.50), "p99": pct(0.99),
                            "n": len(lat)},
+            "batching": (self._scheduler.status()
+                         if self._scheduler is not None else None),
             "session": self.session.status(),
         }

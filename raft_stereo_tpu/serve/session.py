@@ -92,7 +92,11 @@ class SessionConfig:
         degrade policy checks the budget and can return best-so-far.
     bucket: pad request shapes up to multiples of this (a multiple of 32);
         32 == the reference per-shape padding formula.
-    max_programs: LRU bound on cached compiled programs.
+    max_programs: LRU bound on cached compiled programs. With
+        ``max_batch > 1`` the effective bound is raised to fit one fully
+        warm shape bucket (prepare/advance/epilogue at every batch
+        bucket) — a smaller bound would evict the warmup's own programs
+        and recompile per tick.
     warmup_shapes: (H, W) image shapes whose full-scan programs compile at
         construction, so first requests don't pay the compile.
     warmup_segmented: also pre-compile the prepare/segment programs for
@@ -104,6 +108,13 @@ class SessionConfig:
         engage).
     allow_half_res: let the degrade policy drop to half resolution when
         the budget cannot fit even one full-res segment.
+    max_batch: device-batch ceiling for the continuous-batching scheduler
+        (1 = the PR 3 sequential path, no batched programs compiled).
+    batch_buckets: the batch sizes programs compile at (each request batch
+        pads up to the smallest bucket that fits — pad rows are dead
+        carries). Empty = the RAFT_BATCH_BUCKETS env override if set, else
+        powers of two up to ``max_batch``. Bounding the bucket set bounds
+        the compile count exactly like shape bucketing does.
     """
 
     valid_iters: int = 32
@@ -116,6 +127,8 @@ class SessionConfig:
     canary_shape: Tuple[int, int] = (64, 96)
     canary_iters: int = 2
     allow_half_res: bool = True
+    max_batch: int = 1
+    batch_buckets: Tuple[int, ...] = ()
     admission: AdmissionConfig = dataclasses.field(
         default_factory=AdmissionConfig)
 
@@ -127,6 +140,14 @@ class SessionConfig:
             raise ValueError(
                 f"segments ({self.segments}) must divide valid_iters "
                 f"({self.valid_iters})")
+        if self.max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {self.max_batch}")
+        if self.batch_buckets:
+            bb = tuple(self.batch_buckets)
+            if list(bb) != sorted(set(bb)) or bb[0] < 1:
+                raise ValueError(
+                    f"batch_buckets must be strictly increasing positive "
+                    f"ints, got {bb}")
 
 
 @dataclasses.dataclass
@@ -248,6 +269,23 @@ class InferenceSession:
         # means a new session (or tripping the breaker).
         self._env_base: Dict[str, Optional[str]] = {
             k: os.environ.get(k) for k in _ENV_KNOBS}
+        # Batch-bucket ladder for continuous batching, resolved ONCE here
+        # (SessionConfig value > RAFT_BATCH_BUCKETS env > powers of two up
+        # to max_batch). Batch size is an EXPLICIT cache-key component, so
+        # this knob never needs to ride the config fingerprint — it only
+        # selects which batch sizes get compiled, not what any one
+        # compiled program computes (analysis/knobs.py SERVE_ENV_KNOBS).
+        self._batch_buckets = self._resolve_batch_buckets()
+        # Effective LRU bound: continuous batching keeps prepare/advance/
+        # epilogue warm at EVERY batch bucket for a shape — with the
+        # sequential default (8) a max_batch=8 warmup would evict its own
+        # programs and the scheduler would recompile per tick, forever.
+        # One fully-warm shape bucket is the floor; operators serving many
+        # shapes raise max_programs themselves.
+        self._max_programs = self.cfg.max_programs
+        if self.cfg.max_batch > 1:
+            self._max_programs = max(
+                self.cfg.max_programs, 3 * len(self._batch_buckets) + 2)
         # The ladder/knob-registry sync check lives in the breaker's
         # constructor now (guard.py imports the same ENV_KNOBS registry);
         # resolve_env additionally keeps unknown override keys, so a rung
@@ -309,6 +347,48 @@ class InferenceSession:
     def padder_for(self, shape) -> InputPadder:
         return InputPadder(shape, divis_by=32, bucket=self.cfg.bucket)
 
+    def _resolve_batch_buckets(self) -> Tuple[int, ...]:
+        buckets = tuple(self.cfg.batch_buckets)
+        if not buckets:
+            spec = os.environ.get("RAFT_BATCH_BUCKETS", "").strip()
+            if spec:
+                try:  # named error, not a bare int() traceback (cf. the
+                    # PR 4 SLURM_CPUS_PER_TASK fix — same env-parsing class)
+                    buckets = tuple(sorted({int(p) for p in spec.split(",")
+                                            if p.strip()}))
+                except ValueError:
+                    raise ValueError(
+                        f"RAFT_BATCH_BUCKETS must be comma-separated "
+                        f"positive ints, got {spec!r}") from None
+                if not buckets or buckets[0] < 1:
+                    raise ValueError(
+                        f"RAFT_BATCH_BUCKETS must be positive ints, "
+                        f"got {spec!r}")
+            else:
+                buckets, b = [], 1
+                while b < self.cfg.max_batch:
+                    buckets.append(b)
+                    b *= 2
+                buckets = tuple(buckets) + (self.cfg.max_batch,)
+        # Cap at max_batch but always keep one bucket that covers it.
+        capped = tuple(b for b in buckets if b < self.cfg.max_batch)
+        covering = min((b for b in buckets if b >= self.cfg.max_batch),
+                       default=self.cfg.max_batch)
+        return capped + (covering,)
+
+    @property
+    def batch_buckets(self) -> Tuple[int, ...]:
+        return self._batch_buckets
+
+    def batch_bucket(self, n: int) -> int:
+        """Smallest registered batch bucket that fits ``n`` rows."""
+        for b in self._batch_buckets:
+            if b >= n:
+                return b
+        raise ValueError(
+            f"batch of {n} exceeds the largest batch bucket "
+            f"{self._batch_buckets[-1]} (max_batch={self.cfg.max_batch})")
+
     # -- program cache ----------------------------------------------------
 
     def _resolve(self, env: Dict[str, str]) -> Dict[str, Optional[str]]:
@@ -322,14 +402,21 @@ class InferenceSession:
             cfg if cfg is not None else self._run_cfg, env)
 
     def cache_key(self, kind: str, h: int, w: int, iters: int,
-                  cfg=None, env=None) -> Tuple:
-        return (kind, h, w, iters, self._fingerprint(cfg, env))
+                  cfg=None, env=None, b: int = 1) -> Tuple:
+        # ``b`` is the batch bucket: jit would happily re-specialize one
+        # cached program on a new leading dim, but that silent recompile
+        # would dodge the warmed flag and corrupt the latency EMA (batched
+        # segments have batch-dependent cost) — so batch is part of the
+        # key and callers always pad rows up to a registered bucket.
+        return (kind, b, h, w, iters, self._fingerprint(cfg, env))
 
     def _build_fn(self, kind: str, cfg, iters: int):
         import jax.numpy as jnp
-        from raft_stereo_tpu.models import (raft_stereo_forward,
+        from raft_stereo_tpu.models import (raft_stereo_epilogue,
+                                            raft_stereo_forward,
                                             raft_stereo_prepare,
-                                            raft_stereo_segment)
+                                            raft_stereo_segment,
+                                            raft_stereo_segment_carry)
         jax = self._jax
         if kind == "full":
             # The exact program engine/evaluate.make_eval_forward compiles
@@ -352,10 +439,28 @@ class InferenceSession:
                     p, cfg, state, iters=iters)
                 return state, flow_up, jnp.sum(flow_up.astype(jnp.float32))
             return jax.jit(seg)
+        if kind == "advance":
+            # The continuous-batching tick: advance the whole device batch
+            # WITHOUT the mask-head epilogue (exiting rows pay it once, in
+            # the batched "epilogue" program). The per-row coords sums are
+            # the host fetch that doubles as the completion barrier.
+            def adv(p, state):
+                state = raft_stereo_segment_carry(p, cfg, state, iters=iters)
+                rowsum = jnp.sum(state["coords1"].astype(jnp.float32),
+                                 axis=(1, 2, 3))
+                return state, rowsum
+            return jax.jit(adv)
+        if kind == "epilogue":
+            # Mask head + convex upsample for a batch of exiting carries —
+            # one stacked round trip for every row that finished this tick.
+            def epi(p, state):
+                _, flow_up = raft_stereo_epilogue(p, cfg, state)
+                return (flow_up,)
+            return jax.jit(epi)
         raise ValueError(f"unknown program kind {kind!r}")
 
     def get_program(self, kind: str, h: int, w: int, iters: int,
-                    cfg=None, env=None) -> _Program:
+                    cfg=None, env=None, b: int = 1) -> _Program:
         """Fetch-or-compile under the per-bucket lock; LRU-bounded.
 
         The kernel switch set is resolved ONCE here (breaker overrides ∪
@@ -364,7 +469,7 @@ class InferenceSession:
         cfg = cfg if cfg is not None else self._run_cfg
         env = env if env is not None else self._env
         trace_env = self._resolve(env)
-        key = self.cache_key(kind, h, w, iters, cfg, trace_env)
+        key = self.cache_key(kind, h, w, iters, cfg, trace_env, b=b)
         with self._cache_lock:
             prog = self._cache.get(key)
             if prog is not None:
@@ -393,7 +498,7 @@ class InferenceSession:
             evicted = 0
             with self._cache_lock:
                 self._cache[key] = prog
-                while len(self._cache) > self.cfg.max_programs:
+                while len(self._cache) > self._max_programs:
                     old_key, _ = self._cache.popitem(last=False)
                     self._key_locks.pop(old_key, None)
                     with self._est_lock:
@@ -404,11 +509,12 @@ class InferenceSession:
                     self._metrics["evictions"] += evicted
             return prog
 
-    def has_program(self, kind: str, h: int, w: int, iters: int) -> bool:
+    def has_program(self, kind: str, h: int, w: int, iters: int,
+                    b: int = 1) -> bool:
         """Whether this program is already compiled (no side effects) —
         the degrade policy refuses to route a deadline request onto a
         cold bucket whose compile would dwarf the budget."""
-        key = self.cache_key(kind, h, w, iters)
+        key = self.cache_key(kind, h, w, iters, b=b)
         with self._cache_lock:
             prog = self._cache.get(key)
         return prog is not None and prog.warmed
@@ -452,7 +558,7 @@ class InferenceSession:
             # calls after every cold bucket. Only steady-state runs count.
             self._record_time(prog.key, self.clock.now() - t0)
         if self.faults.poisoned(ordinal):
-            flow_i = {"full": 0, "segment": 1}.get(prog.kind)
+            flow_i = {"full": 0, "segment": 1, "epilogue": 0}.get(prog.kind)
             if flow_i is not None:
                 out = (out[:flow_i] + (poison_disparity(out[flow_i]),)
                        + out[flow_i + 1:])
@@ -597,9 +703,16 @@ class InferenceSession:
         for _ in range(len(self.breaker.ladder) + 1):
             try:
                 self._run_full(padder, zeros, zeros)
-                if self.cfg.warmup_segmented:
+                if self.cfg.warmup_segmented and self.cfg.max_batch == 1:
+                    # Sequential-only: the batched scheduler never runs
+                    # the b=1 "segment" program nor the half-res degrade
+                    # route, so warming them with max_batch > 1 would be
+                    # minutes of dead compiles per shape (_warm_batched
+                    # below covers every program the scheduler uses).
                     from raft_stereo_tpu.serve import degrade
                     degrade.warm_segmented(self, padder, zeros)
+                if self.cfg.max_batch > 1:
+                    self._warm_batched(padder, zeros)
                 return
             except Exception as e:  # noqa: BLE001 — filtered just below
                 if not is_kernel_failure(e):
@@ -608,6 +721,26 @@ class InferenceSession:
                     e, getattr(e, "_raft_phase", "runtime_failure"))
         raise InferenceFailed("ladder_exhausted",
                               f"warmup for bucket {h}x{w} never succeeded")
+
+    def _warm_batched(self, padder: InputPadder, zeros: np.ndarray) -> None:
+        """Compile (and once-run) the continuous-batching programs for one
+        shape bucket at every batch bucket — prepare, advance, epilogue —
+        so the scheduler's first ticks don't pay compiles. The warming
+        invocations are excluded from the latency EMAs per (program, batch
+        bucket), exactly like the sequential warmups."""
+        import jax.numpy as jnp
+        m = self.cfg.valid_iters // self.cfg.segments
+        ph, pw = padder.padded_shape
+        lp, rp = padder.pad_np(zeros, zeros)
+        for b in self._batch_buckets:
+            lb = jnp.concatenate([jnp.asarray(lp)] * b, axis=0)
+            rb = jnp.concatenate([jnp.asarray(rp)] * b, axis=0)
+            prep = self.get_program("prepare", ph, pw, 0, b=b)
+            (state,) = self.invoke(prep, lb, rb)
+            adv = self.get_program("advance", ph, pw, m, b=b)
+            state, _ = self.invoke(adv, state)
+            epi = self.get_program("epilogue", ph, pw, 0, b=b)
+            self.invoke(epi, state)
 
     def _run_canary(self) -> None:
         """One bucketed forward, fast path vs plain XLA, within the pinned
@@ -661,6 +794,21 @@ class InferenceSession:
 
     # -- reporting --------------------------------------------------------
 
+    def count_request(self, ok: bool, degraded: bool = False,
+                      nonfinite: bool = False) -> None:
+        """Fold one externally-served request (the continuous-batching
+        scheduler resolves its own responses) into the session counters,
+        so /healthz sees one truth regardless of serving mode."""
+        with self._metrics_lock:
+            if ok:
+                self._metrics["requests_ok"] += 1
+                if degraded:
+                    self._metrics["degraded"] += 1
+            else:
+                self._metrics["requests_failed"] += 1
+                if nonfinite:
+                    self._metrics["nonfinite_outputs"] += 1
+
     def metrics(self) -> Dict:
         with self._metrics_lock:
             m = dict(self._metrics)
@@ -668,13 +816,16 @@ class InferenceSession:
 
     def status(self) -> Dict:
         with self._cache_lock:
-            cached = [f"{k[0]}@{k[1]}x{k[2]}/it{k[3]}" for k in self._cache]
+            cached = [f"{k[0]}@b{k[1]}:{k[2]}x{k[3]}/it{k[4]}"
+                      for k in self._cache]
         return {
             "bucket": self.cfg.bucket,
             "valid_iters": self.cfg.valid_iters,
             "segments": self.cfg.segments,
+            "max_batch": self.cfg.max_batch,
+            "batch_buckets": list(self._batch_buckets),
             "programs": {"cached": cached,
-                         "capacity": self.cfg.max_programs,
+                         "capacity": self._max_programs,
                          **{k: v for k, v in self.metrics().items()
                             if k in ("compiles", "evictions")}},
             "breaker": self.breaker.status(),

@@ -1,0 +1,149 @@
+"""Serving-throughput bench: requests/s through the real StereoService,
+sequential (max_batch=1) vs continuous batching (max_batch=B).
+
+Drives a synthetic closed-loop workload through the production stack —
+admission, bounded queue, scheduler/worker threads, padding, program cache
+— so the number includes every host-side cost a real deployment pays, not
+just device fps. Prints ONE JSON line (bench.py's contract), with both
+modes and the speedup, so the release-gate trajectory pins serve
+throughput alongside the forward-pass fps.
+
+BASELINE.md's itemized headroom makes batching the single largest
+unexploited serving lever on chip (384x1248 full-quality: 12.6 fps/chip at
+batch 8 vs ~1.6 at batch 1); the acceptance bar for this bench is >=2x
+requests/s at batch >= 4 on a multi-request workload on chip. On CPU this
+is a wiring smoke: it must run and print the line (conv throughput on CPU
+is roughly linear in batch, so the CPU speedup is dispatch-overhead only).
+
+Env overrides (RAFT_SERVE_BENCH_*):
+  H / W          image shape               (default 384 x 1248)
+  N              requests per mode         (default 24)
+  ITERS          refinement iterations     (default 32)
+  SEGMENTS       segments per request      (default 4)
+  MAX_BATCH      batched mode's ceiling    (default 8)
+  CORR           corr implementation       (default reg_tpu on TPU, reg off)
+  TINY=1         32-dim 1-GRU model at 64x96 (the CPU gate smoke)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _env_int(name: str, default: int) -> int:
+    return int(os.environ.get(f"RAFT_SERVE_BENCH_{name}", default))
+
+
+def main() -> None:
+    import jax
+    import numpy as np
+
+    from raft_stereo_tpu.config import RAFTStereoConfig, with_eval_precision
+    from raft_stereo_tpu.models import init_raft_stereo
+    from raft_stereo_tpu.serve import (InferenceSession, ServiceConfig,
+                                       SessionConfig, StereoService)
+
+    tiny = os.environ.get("RAFT_SERVE_BENCH_TINY", "0").strip().lower() \
+        not in ("0", "false", "no", "off")
+    on_tpu = jax.default_backend() == "tpu"
+    h = _env_int("H", 64 if tiny else 384)
+    w = _env_int("W", 96 if tiny else 1248)
+    # Enough requests to amortize the one-time tracing of the eager
+    # stack/take helper ops (distinct gather shapes compile on first use)
+    # — the first few ticks are NOT steady state, on any backend.
+    n_requests = _env_int("N", 24 if tiny else 24)
+    iters = _env_int("ITERS", 4 if tiny else 32)
+    segments = _env_int("SEGMENTS", 2 if tiny else 4)
+    max_batch = _env_int("MAX_BATCH", 4 if tiny else 8)
+    corr = os.environ.get("RAFT_SERVE_BENCH_CORR",
+                          "reg_tpu" if on_tpu else "reg")
+
+    arch = (dict(n_gru_layers=1, hidden_dims=(32, 32, 32), corr_levels=2,
+                 corr_radius=2) if tiny else {})
+    cfg = with_eval_precision(
+        RAFTStereoConfig(corr_implementation=corr, **arch))
+    params = init_raft_stereo(jax.random.PRNGKey(0), cfg)
+
+    rng = np.random.default_rng(0)
+    pairs = [
+        (rng.uniform(0, 255, (h, w, 3)).astype(np.float32),
+         rng.uniform(0, 255, (h, w, 3)).astype(np.float32))
+        for _ in range(min(n_requests, 4))  # cycle a few distinct frames
+    ]
+
+    def run_mode(mb: int) -> dict:
+        session = InferenceSession(
+            params, cfg,
+            SessionConfig(valid_iters=iters, segments=segments,
+                          max_batch=mb,
+                          warmup_shapes=((h, w),),
+                          warmup_segmented=True))
+        service = StereoService(session, ServiceConfig(
+            max_queue=max(8, 2 * mb), workers=1))
+        # Closed-loop driver with an in-flight cap under the queue bound
+        # (serve_stereo.py's drain-as-you-submit discipline): the bench
+        # measures serving throughput, not the rejection rate of an
+        # open-loop flood. Twice the batch ceiling keeps the join queue
+        # non-empty while a full batch is mid-segment — a cap of exactly
+        # max_batch lets ticks race the submitter and run partial batches.
+        inflight_cap = max(2 * mb, 8)
+        responses = []
+        from collections import deque
+        pending: deque = deque()
+        with service:
+            t0 = time.perf_counter()
+            for i in range(n_requests):
+                while len(pending) >= inflight_cap:
+                    responses.append(pending.popleft().result(timeout=3600))
+                pending.append(service.submit({
+                    "id": i,
+                    "left": pairs[i % len(pairs)][0],
+                    "right": pairs[i % len(pairs)][1],
+                }))
+            while pending:
+                responses.append(pending.popleft().result(timeout=3600))
+            elapsed = time.perf_counter() - t0
+        bad = [r for r in responses if r["status"] != "ok"]
+        if bad:
+            raise AssertionError(
+                f"mode max_batch={mb}: {len(bad)} non-ok responses, "
+                f"first: {bad[0]}")
+        status = service.status()
+        out = {"rps": n_requests / elapsed, "elapsed_s": elapsed}
+        if status.get("batching"):
+            b = status["batching"]
+            out["occupancy_hist"] = b["occupancy_hist"]
+            out["pad_waste"] = round(b["pad_waste"], 4)
+            out["ticks"] = b["ticks"]
+        return out
+
+    # Sequential first (its warmup also proves the shape compiles), then
+    # batched. Separate sessions: programs differ by batch bucket anyway,
+    # and separate caches keep the two measurements independent.
+    seq = run_mode(1)
+    bat = run_mode(max_batch)
+    speedup = bat["rps"] / seq["rps"] if seq["rps"] else None
+
+    print(json.dumps({
+        "metric": (f"serve_requests_per_s_{h}x{w}_i{iters}_{corr}"
+                   f"_b{max_batch}{'_tiny' if tiny else ''}"),
+        "value": round(bat["rps"], 4),
+        "unit": "requests/s",
+        "sequential_rps": round(seq["rps"], 4),
+        "speedup_vs_sequential": round(speedup, 4) if speedup else None,
+        "n_requests": n_requests,
+        "max_batch": max_batch,
+        "occupancy_hist": bat.get("occupancy_hist"),
+        "pad_waste": bat.get("pad_waste"),
+        "backend": jax.default_backend(),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -18,6 +18,11 @@ Examples::
     # 200 ms deadline per frame: late frames degrade instead of timing out
     python serve_stereo.py --restore_ckpt ... -l ... -r ... \
         --deadline_ms 200 --segments 4
+
+    # continuous batching: up to 8 requests share one device batch,
+    # joining/leaving at segment boundaries (throughput mode)
+    python serve_stereo.py --restore_ckpt ... -l ... -r ... \
+        --max_batch 8 --workers 8
 """
 
 from __future__ import annotations
@@ -57,7 +62,18 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument('--max_queue', type=int, default=8,
                         help="bounded queue depth (full -> explicit reject)")
     parser.add_argument('--workers', type=int, default=1,
-                        help="worker threads draining the queue")
+                        help="worker threads draining the queue "
+                        "(sequential mode; with --max_batch > 1 one "
+                        "scheduler thread replaces the pool and this only "
+                        "caps the CLI's in-flight requests)")
+    parser.add_argument('--max_batch', type=int, default=1,
+                        help="continuous batching: up to this many "
+                        "requests share one device batch, joining at tick "
+                        "boundaries and exiting at segment boundaries "
+                        "(1 = sequential serving)")
+    parser.add_argument('--tick_ms', type=float, default=None,
+                        help="scheduler idle-poll interval (batched mode; "
+                        "default RAFT_SCHED_TICK_MS or 2 ms)")
     parser.add_argument('--max_pixels', type=int, default=8 << 20,
                         help="admission cap on per-image area")
     parser.add_argument('--warmup', default=None,
@@ -117,9 +133,11 @@ def serve(args) -> int:
             warmup_segmented=args.deadline_ms is not None,
             canary=not args.no_canary,
             allow_half_res=not args.no_half_res,
+            max_batch=args.max_batch,
             admission=AdmissionConfig(max_pixels=args.max_pixels)))
     service = StereoService(session, ServiceConfig(
-        max_queue=args.max_queue, workers=args.workers))
+        max_queue=args.max_queue, workers=args.workers,
+        tick_ms=args.tick_ms))
 
     left_images = sorted(glob.glob(args.left_imgs, recursive=True))
     right_images = sorted(glob.glob(args.right_imgs, recursive=True))
@@ -151,12 +169,16 @@ def serve(args) -> int:
         seq += 1
 
     # In-flight cap for this closed-loop driver: the queue bound normally,
-    # but only `workers` when requests carry deadlines — a deadline is
-    # stamped at submit time, so anything parked behind a busy worker
-    # would burn its whole budget queued and be rejected
-    # deadline_exceeded_in_queue instead of degrading.
+    # but only the device concurrency when requests carry deadlines — a
+    # deadline is stamped at submit time, so anything parked behind busy
+    # capacity would burn its whole budget queued and be rejected
+    # deadline_exceeded_in_queue instead of degrading. With --max_batch
+    # the device serves up to max_batch rows concurrently, so the cap must
+    # be at least that or the driver itself would starve the batch.
+    concurrency = args.max_batch if args.max_batch > 1 else args.workers
     inflight_cap = max(
-        1, args.workers if args.deadline_ms is not None else args.max_queue)
+        1, concurrency if args.deadline_ms is not None
+        else max(args.max_queue, args.max_batch))
 
     with service:
         # Drain as we submit: this batch driver respects the service's

@@ -1,0 +1,468 @@
+"""Continuous-batching battery: batch-row bitwise independence, epilogue /
+carry-advance composition, scheduler join/exit parity vs the PR 3
+sequential path, per-row deadline degradation, EMA batch-bucket keying,
+and queue backpressure under batching.
+
+Everything runs on CPU with the tiny model config; deadlines use FakeClock
++ plan-driven slow forwards (zero real sleeping in the deadline math), and
+the scheduler tests drive ``run_tick`` directly on the calling thread so
+join/exit ordering is deterministic.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from raft_stereo_tpu.config import RAFTStereoConfig
+from raft_stereo_tpu.faults import FakeClock, ServeFaultPlan
+from raft_stereo_tpu.models import (init_raft_stereo, raft_stereo_epilogue,
+                                    raft_stereo_prepare, raft_stereo_segment,
+                                    raft_stereo_segment_carry,
+                                    stack_refinement_states,
+                                    take_refinement_rows)
+from raft_stereo_tpu.serve import (BatchScheduler, InferenceSession,
+                                   ServiceConfig, SessionConfig,
+                                   StereoService)
+from raft_stereo_tpu.serve.validate import AdmissionConfig, validate_pair
+
+pytestmark = pytest.mark.serve
+
+TINY = dict(n_gru_layers=1, hidden_dims=(32, 32, 32),
+            corr_levels=2, corr_radius=2)
+H, W = 40, 60  # not multiples of 32: every request really is padded
+
+
+@pytest.fixture(scope="module")
+def tiny_cfg():
+    return RAFTStereoConfig(**TINY)
+
+
+@pytest.fixture(scope="module")
+def tiny_params(tiny_cfg):
+    return init_raft_stereo(jax.random.PRNGKey(0), tiny_cfg)
+
+
+@pytest.fixture(scope="module")
+def pairs():
+    rng = np.random.default_rng(7)
+    return [(rng.uniform(0, 255, (H, W, 3)).astype(np.float32),
+             rng.uniform(0, 255, (H, W, 3)).astype(np.float32))
+            for _ in range(4)]
+
+
+def make_session(params, cfg, *, max_batch=4, valid_iters=4, segments=2,
+                 plan=None, clock=None, **kw):
+    scfg = SessionConfig(valid_iters=valid_iters, segments=segments,
+                         max_batch=max_batch, canary=False, **kw)
+    return InferenceSession(params, cfg, scfg, fault_plan=plan,
+                            clock=clock or FakeClock())
+
+
+@pytest.fixture(scope="module")
+def bsession(tiny_params, tiny_cfg):
+    """Shared fault-free batched session (programs accumulate across the
+    read-only tests — the cache is the point of the session)."""
+    return make_session(tiny_params, tiny_cfg, max_batch=4)
+
+
+def canonical(pair):
+    return validate_pair(pair[0], pair[1], AdmissionConfig())
+
+
+def make_request(pair, rid=None, deadline=None):
+    left, right = canonical(pair)
+    return {"id": rid, "left": left, "right": right, "_deadline": deadline}
+
+
+def drive(sched, out, n_responses, max_spins=2000):
+    """Run ticks until n_responses arrived (waits out the uploader)."""
+    spins = 0
+    while len(out) < n_responses:
+        if not sched.run_tick():
+            time.sleep(0.002)
+        spins += 1
+        assert spins < max_spins, "scheduler made no progress"
+
+
+def wait_uploaded(sched):
+    """Block until every pending joiner's host->device upload finished —
+    tests that pin tick-level grouping need all joiners admissible."""
+    for bucket in sched._buckets.values():
+        for row in list(bucket.pending):
+            assert row.uploaded.wait(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# Model layer: composition and batch-row independence.
+
+
+def test_epilogue_composes_with_segment_carry(tiny_params, tiny_cfg, pairs):
+    """epilogue(segment_carry(s)) == segment(s) — the scheduler's
+    advance-without-mask-head + exit-epilogue split is free of cost."""
+    cfg = tiny_cfg
+    i1, i2 = canonical(pairs[0])
+    state = jax.jit(lambda p, a, b: raft_stereo_prepare(p, cfg, a, b))(
+        tiny_params, i1, i2)
+    _, low_ref, up_ref = jax.jit(
+        lambda p, s: raft_stereo_segment(p, cfg, s, iters=2))(
+        tiny_params, state)
+    carry = jax.jit(
+        lambda p, s: raft_stereo_segment_carry(p, cfg, s, iters=2))(
+        tiny_params, state)
+    low, up = jax.jit(lambda p, s: raft_stereo_epilogue(p, cfg, s))(
+        tiny_params, carry)
+    assert np.asarray(up).tobytes() == np.asarray(up_ref).tobytes()
+    assert np.asarray(low).tobytes() == np.asarray(low_ref).tobytes()
+
+
+def test_batch_rows_bitwise_independent(tiny_params, tiny_cfg, pairs):
+    """The invariant continuous batching stands on: a request's rows are
+    byte-identical whether it runs alone, stacked with three distinct
+    batchmates, or next to replicated pad rows."""
+    cfg = tiny_cfg
+    lefts = np.concatenate([canonical(p)[0] for p in pairs], axis=0)
+    rights = np.concatenate([canonical(p)[1] for p in pairs], axis=0)
+    prep = jax.jit(lambda p, a, b: raft_stereo_prepare(p, cfg, a, b))
+    seg = jax.jit(lambda p, s: raft_stereo_segment(p, cfg, s, iters=2))
+
+    sb = prep(tiny_params, lefts, rights)
+    _, _, up_batch = seg(tiny_params, sb)
+    for i in range(4):
+        s1 = prep(tiny_params, lefts[i:i + 1], rights[i:i + 1])
+        _, _, up_solo = seg(tiny_params, s1)
+        assert np.asarray(up_solo).tobytes() == \
+            np.asarray(up_batch[i:i + 1]).tobytes(), f"row {i}"
+    # pad rows: row 0 advanced next to replicas of itself
+    spad = take_refinement_rows(prep(tiny_params, lefts[:1], rights[:1]),
+                                [0, 0, 0, 0])
+    _, _, up_pad = seg(tiny_params, spad)
+    assert np.asarray(up_pad[:1]).tobytes() == \
+        np.asarray(up_batch[:1]).tobytes()
+
+
+def test_stack_take_roundtrip(tiny_params, tiny_cfg, pairs):
+    i1, i2 = canonical(pairs[0])
+    j1, j2 = canonical(pairs[1])
+    cfg = tiny_cfg
+    sa = raft_stereo_prepare(tiny_params, cfg, i1, i2)
+    sb = raft_stereo_prepare(tiny_params, cfg, j1, j2)
+    stacked = stack_refinement_states([sa, sb])
+    back_a = take_refinement_rows(stacked, [0])
+    for x, y in zip(jax.tree_util.tree_leaves(back_a),
+                    jax.tree_util.tree_leaves(sa)):
+        assert np.asarray(x).tobytes() == np.asarray(y).tobytes()
+    with pytest.raises(ValueError):
+        stack_refinement_states([])
+
+
+# ---------------------------------------------------------------------------
+# Session: batch buckets in keys, EMA isolation.
+
+
+def test_batch_bucket_resolution_and_cache_key(tiny_params, tiny_cfg):
+    sess = make_session(tiny_params, tiny_cfg, max_batch=6)
+    assert sess.batch_buckets == (1, 2, 4, 6)
+    assert sess.batch_bucket(1) == 1
+    assert sess.batch_bucket(3) == 4
+    assert sess.batch_bucket(6) == 6
+    with pytest.raises(ValueError, match="exceeds"):
+        sess.batch_bucket(7)
+    # batch bucket is an explicit key component: b=1 and b=4 never share
+    k1 = sess.cache_key("advance", 64, 64, 2, b=1)
+    k4 = sess.cache_key("advance", 64, 64, 2, b=4)
+    assert k1 != k4
+    # env override, resolved once at construction
+    import os
+    os.environ["RAFT_BATCH_BUCKETS"] = "2,8"
+    try:
+        s2 = make_session(tiny_params, tiny_cfg, max_batch=8)
+        assert s2.batch_buckets == (2, 8)
+    finally:
+        del os.environ["RAFT_BATCH_BUCKETS"]
+    with pytest.raises(ValueError, match="batch_buckets"):
+        SessionConfig(max_batch=4, batch_buckets=(4, 2))
+    with pytest.raises(ValueError, match="max_batch"):
+        SessionConfig(max_batch=0)
+    # LRU floor: one fully warm shape bucket (prepare/advance/epilogue at
+    # every batch bucket) must fit, or warmup would evict its own programs
+    s8 = make_session(tiny_params, tiny_cfg, max_batch=8, max_programs=4)
+    assert s8._max_programs >= 3 * len(s8.batch_buckets)
+
+
+def test_ema_keyed_per_batch_bucket(tiny_params, tiny_cfg, pairs):
+    """The satellite bugfix pinned: batched segments have batch-dependent
+    cost, so a cold batch-4 warming invocation (which carries compile
+    time) must neither poison nor even touch the batch-1 estimate."""
+    clk = FakeClock()
+    # ordinals: 0 prepare(warm) / 1 adv_b1(warm, excluded) / 2 adv_b1
+    # (recorded) / 3 adv_b4(warm, excluded despite the huge injected
+    # compile-like stall) / 4 adv_b4 (recorded)
+    plan = ServeFaultPlan(slow_forwards={1: 9.0, 2: 5.0, 3: 50.0, 4: 7.0})
+    sess = make_session(tiny_params, tiny_cfg, max_batch=4, plan=plan,
+                        clock=clk)
+    i1, i2 = canonical(pairs[0])
+    lp, rp = sess.padder_for(i1.shape).pad_np(i1, i2)
+    prep = sess.get_program("prepare", 64, 64, 0, b=1)
+    (state,) = sess.invoke(prep, lp, rp)
+    adv1 = sess.get_program("advance", 64, 64, 2, b=1)
+    state1, _ = sess.invoke(adv1, state)          # warming: excluded
+    sess.invoke(adv1, state1)                      # recorded: 5.0
+    assert sess.estimate(adv1.key) == pytest.approx(5.0)
+    state4 = take_refinement_rows(state, [0, 0, 0, 0])
+    adv4 = sess.get_program("advance", 64, 64, 2, b=4)
+    assert adv4.key != adv1.key
+    state4b, _ = sess.invoke(adv4, state4)         # warming: excluded
+    assert sess.estimate(adv4.key) is None
+    assert sess.estimate(adv1.key) == pytest.approx(5.0)  # untouched
+    sess.invoke(adv4, state4b)                     # recorded: 7.0
+    assert sess.estimate(adv4.key) == pytest.approx(7.0)
+    assert sess.estimate(adv1.key) == pytest.approx(5.0)
+
+
+# ---------------------------------------------------------------------------
+# Scheduler: parity with the sequential path, join/exit boundaries,
+# per-row deadlines. Ticks are driven directly — no service thread.
+
+
+def test_scheduler_parity_including_pad_rows(bsession, pairs):
+    """Three requests (odd count -> a pad row at batch bucket 4): every
+    disparity byte-identical to the sequential session path."""
+    refs = [bsession.infer(*p).disparity for p in pairs[:3]]
+    out = []
+    sched = BatchScheduler(bsession,
+                           resolve=lambda req, resp: out.append(resp))
+    for i, p in enumerate(pairs[:3]):
+        sched.submit(make_request(p, rid=i))
+    wait_uploaded(sched)
+    drive(sched, out, 3)
+    by_id = {r["id"]: r for r in out}
+    for i in range(3):
+        assert by_id[i]["status"] == "ok"
+        assert by_id[i]["quality"] == "full"
+        assert by_id[i]["disparity"].tobytes() == refs[i].tobytes(), i
+    st = sched.status()
+    assert st["joins"] == 3 and st["exits"] == 3
+    assert st["pad_waste"] > 0  # 3 rows rode a 4-bucket
+    assert st["occupancy_hist"].get("3") >= 1
+
+
+def test_scheduler_join_exit_boundary_parity(bsession, pairs):
+    """B joins the batch AFTER A already ran a segment; A exits while B
+    continues — both byte-identical to their sequential runs."""
+    ref_a = bsession.infer(*pairs[0]).disparity
+    ref_b = bsession.infer(*pairs[1]).disparity
+    out = []
+    sched = BatchScheduler(bsession,
+                           resolve=lambda req, resp: out.append(resp))
+    sched.submit(make_request(pairs[0], rid="a"))
+    wait_uploaded(sched)
+    assert sched.run_tick()          # A alone: segment 1 at batch 1
+    assert sched.active_rows == 1
+    sched.submit(make_request(pairs[1], rid="b"))
+    wait_uploaded(sched)
+    assert sched.run_tick()          # B joins; A+B advance at batch 2;
+    assert len(out) == 1             # A (4 iters) exits at the boundary
+    assert out[0]["id"] == "a" and out[0]["quality"] == "full"
+    drive(sched, out, 2)             # B's second segment + exit
+    by_id = {r["id"]: r for r in out}
+    assert by_id["a"]["disparity"].tobytes() == ref_a.tobytes()
+    assert by_id["b"]["disparity"].tobytes() == ref_b.tobytes()
+    st = sched.status()
+    assert st["active"] == 0 and st["pending"] == 0
+
+
+def test_scheduler_per_row_deadline_exit(tiny_params, tiny_cfg, pairs):
+    """Per-row anytime degradation: the deadline row exits early with an
+    honest reduced_iters label while its batchmate runs to full quality —
+    and the batchmate's bytes don't care."""
+    clk = FakeClock()
+    # ordinals: 0 prepare_b2 / 1 advance_b2 (60 fake-s: blows A's budget)
+    # / 2 epilogue_b1 (A's exit) / 3 advance_b1 / 4 epilogue_b1 (B)
+    plan = ServeFaultPlan(slow_forwards={1: 60.0})
+    sess = make_session(tiny_params, tiny_cfg, max_batch=4, plan=plan,
+                        clock=clk)
+    ref_b = None  # computed after: the plan only slows ordinal 1
+    out = []
+    sched = BatchScheduler(sess, resolve=lambda req, resp: out.append(resp))
+    sched.submit(make_request(pairs[0], rid="a", deadline=clk.now() + 50.0))
+    sched.submit(make_request(pairs[1], rid="b"))
+    wait_uploaded(sched)
+    drive(sched, out, 2)
+    by_id = {r["id"]: r for r in out}
+    assert by_id["a"]["status"] == "ok"
+    assert by_id["a"]["quality"] == "reduced_iters:2"
+    assert by_id["a"]["iters"] == 2
+    assert by_id["a"]["deadline_missed"] is True  # 60 fake-s > 50 budget
+    assert by_id["b"]["quality"] == "full"
+    assert np.isfinite(by_id["a"]["disparity"]).all()
+    ref_b = sess.infer(*pairs[1]).disparity
+    assert by_id["b"]["disparity"].tobytes() == ref_b.tobytes()
+    assert sess.metrics()["degraded"] == 1
+
+
+def test_scheduler_deadline_estimate_stops_early(tiny_params, tiny_cfg,
+                                                 pairs):
+    """With a recorded per-(program, batch-bucket) estimate the policy
+    exits BEFORE overrunning: reduced label, deadline_missed=False."""
+    clk = FakeClock()
+    # r1 (no deadline): prepare(0), adv_b1(1: warming, excluded),
+    # adv_b1(2: recorded 60), epilogue(3). r2: prepare(4), adv_b1(5: 60).
+    plan = ServeFaultPlan(slow_forwards={1: 60.0, 2: 60.0, 5: 60.0})
+    sess = make_session(tiny_params, tiny_cfg, max_batch=4, plan=plan,
+                        clock=clk)
+    out = []
+    sched = BatchScheduler(sess, resolve=lambda req, resp: out.append(resp))
+    sched.submit(make_request(pairs[0], rid="r1"))
+    wait_uploaded(sched)
+    drive(sched, out, 1)
+    adv_key = sess.cache_key("advance", 64, 64, 2, b=1)
+    assert sess.estimate(adv_key) == pytest.approx(60.0)
+    # budget fits ONE more 60s segment plus 40s of slack — not two
+    sched.submit(make_request(pairs[1], rid="r2",
+                              deadline=clk.now() + 100.0))
+    wait_uploaded(sched)
+    drive(sched, out, 2)
+    r2 = next(r for r in out if r["id"] == "r2")
+    assert r2["quality"] == "reduced_iters:2"
+    assert r2["deadline_missed"] is False
+
+
+def test_scheduler_deadline_expired_in_queue(bsession, pairs):
+    """A joiner whose deadline passed while waiting is rejected at the
+    tick boundary without touching the device."""
+    out = []
+    sched = BatchScheduler(bsession,
+                           resolve=lambda req, resp: out.append(resp))
+    sched.submit(make_request(pairs[0], rid="late",
+                              deadline=bsession.clock.now() - 1.0))
+    wait_uploaded(sched)
+    compiles = bsession.metrics()["compiles"]
+    drive(sched, out, 1)
+    assert out[0]["status"] == "rejected"
+    assert out[0]["code"] == "deadline_exceeded_in_queue"
+    assert bsession.metrics()["compiles"] == compiles
+
+
+def test_scheduler_nonfinite_output_structured(tiny_params, tiny_cfg,
+                                               pairs):
+    """A poisoned epilogue output becomes a structured nonfinite_output
+    error, never a served frame (the sequential contract, batched)."""
+    # ordinals: 0 prepare / 1-2 advances / 3 epilogue (poisoned)
+    plan = ServeFaultPlan(poison_outputs=(3,))
+    sess = make_session(tiny_params, tiny_cfg, max_batch=4, plan=plan)
+    out = []
+    sched = BatchScheduler(sess, resolve=lambda req, resp: out.append(resp))
+    sched.submit(make_request(pairs[0], rid="x"))
+    wait_uploaded(sched)
+    drive(sched, out, 1)
+    assert out[0]["status"] == "error"
+    assert out[0]["code"] == "nonfinite_output"
+    assert sess.metrics()["nonfinite_outputs"] == 1
+    # the program itself is fine: the next request serves clean
+    sched.submit(make_request(pairs[1], rid="y"))
+    wait_uploaded(sched)
+    drive(sched, out, 2)
+    assert out[1]["status"] == "ok"
+
+
+# ---------------------------------------------------------------------------
+# Service integration: threads, backpressure, health, shutdown.
+
+
+def test_batched_service_end_to_end(bsession, pairs):
+    refs = [bsession.infer(*p).disparity for p in pairs]
+    with StereoService(bsession, ServiceConfig(max_queue=8)) as svc:
+        futs = [svc.submit({"id": i, "left": p[0], "right": p[1]})
+                for i, p in enumerate(pairs)]
+        resps = [f.result(timeout=60) for f in futs]
+    for i, r in enumerate(resps):
+        assert r["status"] == "ok" and r["id"] == i
+        assert r["quality"] == "full"
+        assert r["disparity"].tobytes() == refs[i].tobytes()
+    st = svc.status()
+    assert st["requests"]["ok"] == 4
+    assert st["batching"] is not None
+    b = st["batching"]
+    assert b["joins"] >= 4 and b["exits"] >= 4
+    assert b["max_batch"] == 4
+    assert b["occupancy_hist"]  # at least one tick recorded
+    assert b["tick_latency_ms"]["p50"] is not None
+    assert st["session"]["max_batch"] == 4
+
+
+def test_batched_service_queue_full_backpressure(tiny_params, tiny_cfg,
+                                                 pairs):
+    """Scheduler blocked mid-tick + depth-1 queue: the third concurrent
+    request gets an immediate structured queue_full rejection — the
+    backpressure contract survives batching."""
+    import threading
+
+    class GateClock:
+        def __init__(self):
+            self.gate = threading.Event()
+
+        @staticmethod
+        def now():
+            return time.monotonic()
+
+        def sleep(self, _seconds):
+            assert self.gate.wait(timeout=30)
+
+    clk = GateClock()
+    # ordinal 0 = r1's prepare, 1 = r1's first advance (gated)
+    sess = make_session(tiny_params, tiny_cfg, max_batch=2, clock=clk,
+                        plan=ServeFaultPlan(slow_forwards={1: 1.0}))
+    svc = StereoService(sess, ServiceConfig(max_queue=1)).start()
+    try:
+        f1 = svc.submit({"id": 1, "left": pairs[0][0],
+                         "right": pairs[0][1]})
+        for _ in range(3000):  # until the scheduler is parked in the gate
+            if sess.faults.forwards >= 2:
+                break
+            time.sleep(0.01)
+        assert sess.faults.forwards >= 2
+        f2 = svc.submit({"id": 2, "left": pairs[1][0],
+                         "right": pairs[1][1]})
+        f3 = svc.submit({"id": 3, "left": pairs[2][0],
+                         "right": pairs[2][1]})
+        resp3 = f3.result(timeout=5)   # rejected synchronously at submit
+        clk.gate.set()
+        r1 = f1.result(timeout=60)
+        r2 = f2.result(timeout=60)
+    finally:
+        clk.gate.set()
+        svc.stop()
+    assert resp3["status"] == "rejected" and resp3["code"] == "queue_full"
+    assert r1["status"] == "ok"
+    assert r2["status"] == "ok"
+    assert svc.status()["requests"]["rejected:queue_full"] == 1
+
+
+def test_batched_service_restart_serves(bsession, pairs):
+    """stop() then start() must serve again: each generation gets a fresh
+    scheduler (the old one's uploader thread dies with it), so a
+    post-restart request can never hang in a dead join queue."""
+    svc = StereoService(bsession, ServiceConfig(max_queue=8))
+    for generation in range(2):
+        svc.start()
+        r = svc.submit({"id": generation, "left": pairs[0][0],
+                        "right": pairs[0][1]}).result(timeout=60)
+        assert r["status"] == "ok", (generation, r)
+        svc.stop()
+
+
+def test_batched_service_stop_resolves_every_future(bsession, pairs):
+    """stop() never abandons a Future: admitted rows finish (they own
+    device state), un-admitted ones get the structured rejection."""
+    svc = StereoService(bsession, ServiceConfig(max_queue=8)).start()
+    futs = [svc.submit({"id": i, "left": p[0], "right": p[1]})
+            for i, p in enumerate(pairs)]
+    svc.stop()
+    for f in futs:
+        r = f.result(timeout=60)
+        assert r["status"] in ("ok", "rejected")
+        if r["status"] == "rejected":
+            assert r["code"] in ("service_stopped", "not_running")
