@@ -15,9 +15,15 @@ Run ``python -m raft_stereo_tpu.analysis`` (full tree) or with
 ``--changed-only`` (git-changed files only).  Suppress a finding inline
 with ``# graftlint: disable=GLxxx (reason)``.
 
-This package is import-light by design: no jax, no numpy — the linter
-must run (and the knob registry must be importable by serve/) in any
-environment, instantly.
+``--trace`` additionally runs graftverify (``analysis/trace/``, GV101-
+GV105): trace-level jaxpr/StableHLO analysis of the real entry points,
+proving the invariants the AST layer can only grep for (DESIGN.md
+"Trace-level analysis (r10)").
+
+This package's TOP LEVEL is import-light by design: no jax, no numpy —
+the linter must run (and the knob registry must be importable by
+serve/) in any environment, instantly.  Only the ``trace`` subpackage
+imports jax, and only when ``--trace`` asks for it.
 """
 
 from raft_stereo_tpu.analysis.core import (Finding, Project,  # noqa: F401

@@ -8,6 +8,13 @@ fingerprints and ``serve/guard.py`` validates its ladder against.  A read
 missing from the registry is the stale-program class the session can only
 runtime-check for ladder rungs: two requests under different switch
 values would silently share one compiled program.
+
+The scan also covers ``serve/`` and ``native/`` (widened in r10): a
+``RAFT_*`` read there is host/serving behavior rather than program shape,
+so it may live in ANY registry (``ENV_KNOBS``, ``SERVE_ENV_KNOBS`` or
+``HOST_ENV_KNOBS``) — but it must live somewhere.  Before the widening, a
+new env read in serve/ (e.g. ``RAFT_NATIVE``-style pipeline switches) was
+simply invisible to lint and the flag matrix drifted.
 """
 
 from __future__ import annotations
@@ -22,30 +29,54 @@ from raft_stereo_tpu.analysis.core import (Finding, Project, SourceFile,
 #: program (the serving cache key must cover them).
 FORWARD_DIRS = ("models", "ops", "corr")
 
+#: Path segments whose RAFT_* reads are host/serving behavior: they must
+#: appear in SOME registry (ENV_KNOBS counts too — a forward knob read
+#: from serve/ is legal) so the flag matrix has one home.
+HOST_DIRS = ("serve", "native")
+
 
 def is_forward_module(relpath: str) -> bool:
     return any(seg in FORWARD_DIRS for seg in relpath.split("/")[:-1])
 
 
+def is_host_module(relpath: str) -> bool:
+    return any(seg in HOST_DIRS for seg in relpath.split("/")[:-1])
+
+
 class KnobRegistryChecker(Checker):
     code = "GL002"
     name = "knob-registry"
-    description = ("RAFT_* env read in a forward-relevant module missing "
-                   "from the program-cache knob registry (ENV_KNOBS)")
+    description = ("RAFT_* env read missing from the knob registries — "
+                   "ENV_KNOBS for forward modules (models/ops/corr), any "
+                   "registry for host modules (serve/native)")
 
     def check_file(self, project: Project, sf: SourceFile
                    ) -> Iterator[Finding]:
-        if not is_forward_module(sf.relpath):
+        forward = is_forward_module(sf.relpath)
+        host = is_host_module(sf.relpath)
+        if not (forward or host):
             return
         for read in env_reads(sf):
             if read.key is None or not read.key.startswith("RAFT_"):
                 continue
-            if read.key not in project.knobs:
+            if forward:
+                if read.key not in project.knobs:
+                    yield self.finding(
+                        sf, read.node,
+                        f"env knob {read.key!r} is read in a "
+                        "forward-relevant module but missing from ENV_KNOBS "
+                        "(raft_stereo_tpu/analysis/knobs.py) — programs "
+                        "traced under different values would share one "
+                        "cache entry; register it (or suppress with a "
+                        "reason if it provably cannot change the traced "
+                        "program)")
+            elif read.key not in project.knobs and \
+                    read.key not in project.serve_knobs:
                 yield self.finding(
                     sf, read.node,
-                    f"env knob {read.key!r} is read in a forward-relevant "
-                    "module but missing from ENV_KNOBS "
-                    "(raft_stereo_tpu/analysis/knobs.py) — programs traced "
-                    "under different values would share one cache entry; "
-                    "register it (or suppress with a reason if it provably "
-                    "cannot change the traced program)")
+                    f"env knob {read.key!r} is read in a host/serving "
+                    "module but appears in no registry — add it to "
+                    "SERVE_ENV_KNOBS or HOST_ENV_KNOBS "
+                    "(raft_stereo_tpu/analysis/knobs.py) with a rationale "
+                    "for staying out of the cache-key set, or to "
+                    "ENV_KNOBS if it can shape a traced program")

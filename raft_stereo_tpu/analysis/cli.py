@@ -1,4 +1,10 @@
-"""``python -m raft_stereo_tpu.analysis`` — the graftlint CLI.
+"""``python -m raft_stereo_tpu.analysis`` — the graftlint/graftverify CLI.
+
+Default: the AST suite (GL001-GL006, milliseconds, no jax). With
+``--trace``, ALSO runs graftverify (GV101-GV105): traces the repo's real
+entry points on CPU via jax.eval_shape/make_jaxpr/.lower() — no TPU, no
+execution — and walks the jaxprs/StableHLO; both reports merge into one
+verdict/JSON artifact.
 
 Exit codes: 0 clean (suppressed findings allowed), 1 unsuppressed
 findings, 2 usage/internal error.
@@ -54,14 +60,55 @@ def build_parser() -> argparse.ArgumentParser:
                    help="also print suppressed findings (with reasons)")
     p.add_argument("--list-checkers", action="store_true",
                    help="print the checker table and exit")
+    p.add_argument("--trace", action="store_true",
+                   help="also run graftverify (GV101-GV105): trace the "
+                        "real entry points at pinned shapes on CPU and "
+                        "verify jaxpr/HLO-level invariants (needs jax; "
+                        "~1 min at headline geometry)")
+    p.add_argument("--trace-geometry", choices=("headline", "small"),
+                   default=None,
+                   help="trace shapes: 'headline' (bench north-star, "
+                        "ladder+knob proofs included) or 'small' (fast "
+                        "dev loop; ladder/knob probes are headline-only "
+                        "because kernel heuristics don't engage at small "
+                        "shapes)")
+    p.add_argument("--trace-registry", metavar="FILE",
+                   help="load the trace registry from a python file "
+                        "defining build_registry() instead of the "
+                        "default — tests point this at poisoned fixture "
+                        "registries to prove each GV checker fires")
     return p
+
+
+def _load_registry_file(path: str):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location("_graftverify_fixture",
+                                                  path)
+    if spec is None or spec.loader is None:
+        raise ValueError(f"cannot load trace registry from {path!r}")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.build_registry()
 
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
+    if not args.trace and (args.trace_registry or args.trace_geometry):
+        # A trace option without --trace would silently skip the trace
+        # stage — the analyzer quietly not running must never read as
+        # "clean" (the GV000 principle, applied to the CLI itself).
+        print("graftlint: --trace-registry/--trace-geometry require "
+              "--trace", file=sys.stderr)
+        return 2
     if args.list_checkers:
         from raft_stereo_tpu.analysis.checkers import ALL_CHECKERS
         for cls in ALL_CHECKERS:
+            print(f"{cls.code}  {cls.name:<24} {cls.description}")
+        # The GV table imports without jax (checker modules defer their
+        # jax-touching work to check()), so always list it too.
+        from raft_stereo_tpu.analysis.trace.checkers import \
+            ALL_TRACE_CHECKERS
+        for cls in ALL_TRACE_CHECKERS:
             print(f"{cls.code}  {cls.name:<24} {cls.description}")
         return 0
     roots = args.paths or _default_roots()
@@ -88,6 +135,23 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"graftlint: internal error: {type(e).__name__}: {e}",
               file=sys.stderr)
         return 2
+    if args.trace:
+        # The trace stage analyzes whole programs, not files —
+        # --changed-only's path filter applies to the AST report only.
+        try:
+            if args.trace_registry:
+                registry = _load_registry_file(args.trace_registry)
+            else:
+                from raft_stereo_tpu.analysis.trace import default_registry
+                registry = default_registry(args.trace_geometry
+                                            or "headline")
+            from raft_stereo_tpu.analysis.trace import run_trace_analysis
+            report = report.merged(
+                run_trace_analysis(registry, select=select))
+        except Exception as e:
+            print(f"graftverify: internal error: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            return 2
     print(report.render_json() if args.as_json
           else report.render_text(show_suppressed=args.show_suppressed))
     return 0 if report.ok else 1

@@ -239,11 +239,20 @@ class Project:
 
     def __init__(self, files: Sequence[SourceFile], *,
                  knobs: Optional[Sequence[str]] = None,
+                 serve_knobs: Optional[Sequence[str]] = None,
                  kernel_entries: Optional[Dict] = None):
         from raft_stereo_tpu.analysis import knobs as knobs_mod
         self.files = list(files)
         self.knobs: Tuple[str, ...] = tuple(
             knobs if knobs is not None else knobs_mod.ENV_KNOBS)
+        #: Host/serving-side registries (SERVE_ENV_KNOBS + HOST_ENV_KNOBS):
+        #: GL002's widened scan over serve/ and native/ accepts a RAFT_*
+        #: read that appears in ANY registry — the registries differ in
+        #: what they imply (cache-key membership vs documented host knob),
+        #: not in lint visibility.
+        self.serve_knobs: Tuple[str, ...] = tuple(
+            serve_knobs if serve_knobs is not None
+            else knobs_mod.SERVE_ENV_KNOBS + knobs_mod.HOST_ENV_KNOBS)
         self.kernel_entries = (dict(kernel_entries) if kernel_entries
                                is not None else
                                dict(knobs_mod.KERNEL_ENTRY_POINTS))
@@ -316,10 +325,20 @@ class Report:
     findings: List[Finding]          # unsuppressed — these fail the build
     suppressed: List[Finding]
     files_analyzed: int
+    #: Programs traced by graftverify (``--trace``); 0 for AST-only runs.
+    entries_traced: int = 0
 
     @property
     def ok(self) -> bool:
         return not self.findings
+
+    def merged(self, other: "Report") -> "Report":
+        """Fold another report in (the ``--trace`` stage merges the GV
+        report into the AST one — a single artifact, a single verdict)."""
+        return Report(self.findings + other.findings,
+                      self.suppressed + other.suppressed,
+                      self.files_analyzed + other.files_analyzed,
+                      self.entries_traced + other.entries_traced)
 
     def render_text(self, show_suppressed: bool = False) -> str:
         out = [f.render() for f in sorted(
@@ -335,7 +354,9 @@ class Report:
             f"graftlint: {len(self.findings)} finding(s)"
             + (f" [{summary}]" if summary else "")
             + f", {len(self.suppressed)} suppressed, "
-            f"{self.files_analyzed} file(s) analyzed")
+            f"{self.files_analyzed} file(s) analyzed"
+            + (f", {self.entries_traced} program(s) traced"
+               if self.entries_traced else ""))
         return "\n".join(out)
 
     def render_json(self) -> str:
@@ -343,6 +364,7 @@ class Report:
             "findings": [dataclasses.asdict(f) for f in self.findings],
             "suppressed": [dataclasses.asdict(f) for f in self.suppressed],
             "files_analyzed": self.files_analyzed,
+            "entries_traced": self.entries_traced,
             "ok": self.ok,
         }, indent=2, sort_keys=True)
 
@@ -436,6 +458,7 @@ def run_checkers(project: Project, checkers: Optional[Sequence] = None
 
 def run_analysis(roots: Sequence[str], *, base: Optional[str] = None,
                  knobs: Optional[Sequence[str]] = None,
+                 serve_knobs: Optional[Sequence[str]] = None,
                  kernel_entries: Optional[Dict] = None,
                  checkers: Optional[Sequence] = None,
                  select: Optional[Sequence[str]] = None,
@@ -449,7 +472,8 @@ def run_analysis(roots: Sequence[str], *, base: Optional[str] = None,
         cross-file context stays complete.
     """
     files = collect_files(roots, base=base)
-    project = Project(files, knobs=knobs, kernel_entries=kernel_entries)
+    project = Project(files, knobs=knobs, serve_knobs=serve_knobs,
+                      kernel_entries=kernel_entries)
     report = run_checkers(project, checkers=checkers)
     by_rel = {sf.relpath: sf.abspath for sf in files}
 

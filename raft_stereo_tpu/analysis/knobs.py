@@ -54,6 +54,18 @@ SERVE_ENV_KNOBS: Tuple[str, ...] = (
                             # read at service start)
 )
 
+# Host-pipeline env knobs: they steer HOST code (the data loader's native
+# photometric kernels) and can never reach a trace, so they belong in
+# neither ENV_KNOBS (no compiled program depends on them) nor
+# SERVE_ENV_KNOBS (they are not serving behavior). Registered so GL002's
+# widened scan (native/, serve/) has an answer for every RAFT_* read and a
+# NEW host knob must be deliberately placed here rather than silently
+# invisible to lint.
+HOST_ENV_KNOBS: Tuple[str, ...] = (
+    "RAFT_NATIVE",          # force the numpy photometric path
+                            # (native/__init__.py:lib, read at first use)
+)
+
 
 @dataclasses.dataclass(frozen=True)
 class KernelEntry:

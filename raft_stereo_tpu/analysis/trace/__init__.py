@@ -1,0 +1,30 @@
+"""graftverify — trace-level (jaxpr/StableHLO) program analysis.
+
+graftlint (``analysis/checkers/``) proves source-level invariants; the
+costliest regressions live one level down, in the traced program: a
+silent bf16→fp32 upcast inside the refinement scan, a breaker rung whose
+"fallback" compiles to the identical HLO, a closure-captured array baked
+into the jaxpr as a multi-MB constant, a train step whose donation is
+silently dropped by an aliasing change. This package traces the repo's
+REAL entry points (the serving program kinds from ``serve/session.py``
+``build_program``, the train step, the eval forward) at pinned shapes via
+``jax.eval_shape`` / ``jax.make_jaxpr`` / ``.lower()`` on CPU — no TPU,
+no execution — and walks the resulting jaxprs with the GV-series checker
+suite (DESIGN.md "Trace-level analysis (r10)"):
+
+GV101  bf16→fp32 upcast in a scan body outside the accumulator set
+GV102  breaker-ladder rung vacuity + env-knob cache-key sufficiency
+GV103  host callback / debug effect in a hot-path program
+GV104  baked-in constant above the bloat threshold
+GV105  train-step donation not honored by the lowered aliasing
+
+Unlike the rest of ``analysis/`` this package imports jax — it is loaded
+ONLY under ``python -m raft_stereo_tpu.analysis --trace`` (or direct
+import); ``analysis/__init__`` stays import-light so the AST linter and
+the knob registry keep working without jax.
+"""
+
+from raft_stereo_tpu.analysis.trace.registry import (  # noqa: F401
+    KnobFlip, TraceEntry, TraceRegistry, default_registry)
+from raft_stereo_tpu.analysis.trace.runner import (  # noqa: F401
+    TraceContext, run_trace_analysis)

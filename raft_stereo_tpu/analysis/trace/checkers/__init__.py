@@ -1,0 +1,20 @@
+"""GV-series trace checkers. Registration order = code order."""
+
+from raft_stereo_tpu.analysis.trace.checkers.gv101_dtype_discipline import \
+    DtypeDisciplineChecker
+from raft_stereo_tpu.analysis.trace.checkers.gv102_ladder_vacuity import \
+    LadderVacuityChecker
+from raft_stereo_tpu.analysis.trace.checkers.gv103_host_callbacks import \
+    HostCallbackChecker
+from raft_stereo_tpu.analysis.trace.checkers.gv104_constant_bloat import \
+    ConstantBloatChecker
+from raft_stereo_tpu.analysis.trace.checkers.gv105_donation import \
+    DonationChecker
+
+ALL_TRACE_CHECKERS = (
+    DtypeDisciplineChecker,
+    LadderVacuityChecker,
+    HostCallbackChecker,
+    ConstantBloatChecker,
+    DonationChecker,
+)

@@ -1,0 +1,62 @@
+"""GV101 — dtype discipline inside scan bodies.
+
+Under the eval bf16 policy (``config.eval_mixed_precision``) the
+refinement scan body must compute in bf16: a silent ``convert_element_type
+-> f32`` on a big tensor inside the body doubles that tensor's HBM
+traffic and flips its ops onto the fp32 MXU path — ``iters`` times per
+frame. The r4/r5 perf work (BASELINE.md) exists precisely because these
+casts are invisible to every numeric test (fp32 is MORE accurate) and to
+AST lint (the ``.astype`` may be far from the scan).
+
+Allowed upcasts — the accumulator set:
+
+- a convert whose result reaches an **fp32 scan carry** through an
+  fp32-only path (the epipolar delta-flow feeding the ``coords1``
+  accumulator);
+- a convert whose result feeds **reduction-class primitives** through at
+  most a couple of elementwise glue ops (instance-norm moments, pooling
+  sums — fp32 accumulation over bf16 maps is the sanctioned pattern,
+  ops/basic.py:105);
+- anything inside a ``pallas_call`` kernel body (in-kernel fp32
+  accumulation with in-kernel downcast is the kernels' design).
+
+Everything else is a finding.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from raft_stereo_tpu.analysis.core import Finding
+from raft_stereo_tpu.analysis.trace.runner import TraceChecker, TraceContext
+
+
+class DtypeDisciplineChecker(TraceChecker):
+    code = "GV101"
+    name = "dtype-discipline"
+    description = ("bf16->f32 upcast inside a scan body outside the "
+                   "allowlisted accumulator set (mixed-precision entries)")
+
+    def check(self, ctx: TraceContext) -> Iterator[Finding]:
+        # Deferred: jaxprs imports jax; --list-checkers must not.
+        from raft_stereo_tpu.analysis.trace.jaxprs import (
+            iter_scans, offending_upcasts)
+        min_el = ctx.registry.gv101_min_elements
+        for entry in ctx.registry.entries:
+            if not entry.mixed_precision:
+                continue
+            closed = ctx.jaxpr(entry)
+            if closed is None:
+                continue
+            for scan_eqn in iter_scans(closed.jaxpr):
+                for shape, why in offending_upcasts(scan_eqn,
+                                                    min_elements=min_el):
+                    yield self.finding(
+                        entry.name,
+                        f"bf16->f32 upcast of a {shape} tensor inside a "
+                        f"scan body: {why} — this is fp32 COMPUTE paid "
+                        "every iteration, not fp32 accumulation; keep the "
+                        "map in bf16 (accumulate via "
+                        "preferred_element_type or a reduction) or add a "
+                        "registry suppression with the measured "
+                        "justification")

@@ -1,0 +1,105 @@
+"""GV102 — breaker-ladder vacuity + env-knob cache-key sufficiency.
+
+Two halves of one invariant: *every degree of freedom the serving layer
+believes in must actually exist in the traced program, and every degree
+of freedom in the traced program must exist in the cache key.*
+
+Ladder half: each rung of ``serve/guard.py``'s ``DEFAULT_LADDER``, when
+tripped on top of its predecessors, must produce a DIFFERENT program text
+at the declared geometry. A vacuous rung means the breaker "falls back"
+to the identical program — the retry after a trip re-runs the exact
+failure, the ladder walks to exhaustion, and the session dies where it
+was designed to degrade (PR 3's whole point, previously only
+pattern-matched by GL006's env-consultation check).
+
+Knob half: flipping each registered ``ENV_KNOBS`` entry (with its
+declared probe value) must change the traced program text IFF it changes
+the program-cache key:
+
+- program changed, key unchanged -> THE stale-program class (two switch
+  values silently share one compiled program);
+- key changed, program unchanged -> the registry/probe is dishonest at
+  the geometry where this knob claims to matter (either the knob is dead
+  or the probe is wrong — both need a human);
+- neither changed -> a dead registry entry (not keyed, not consulted).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from raft_stereo_tpu.analysis.core import Finding
+from raft_stereo_tpu.analysis.trace.runner import TraceChecker, TraceContext
+
+
+class LadderVacuityChecker(TraceChecker):
+    code = "GV102"
+    name = "ladder-vacuity"
+    description = ("breaker rung producing an identical program to its "
+                   "predecessor / env knob whose program and cache-key "
+                   "effects disagree")
+
+    def check(self, ctx: TraceContext) -> Iterator[Finding]:
+        # PAIRWISE, not just adjacent: rung k's projection cancelling rung
+        # k-1's (variant k == variant k-2 while both adjacent pairs
+        # differ) would still mean two cumulative trip sets share one
+        # program. All texts are cached in ctx, so the extra comparisons
+        # are string equality only.
+        variants = ctx.registry.ladder_variants
+        for j, (label, cur) in enumerate(variants[1:], start=1):
+            cur_text = ctx.text(cur)
+            if cur_text is None:
+                continue  # trace failure already reported as GV000
+            for i in range(j):
+                prev_label, prev = variants[i]
+                prev_text = ctx.text(prev)
+                if prev_text is None or prev_text != cur_text:
+                    continue
+                how = ("its predecessor" if i == j - 1
+                       else f"the earlier trip set through {prev_label!r}")
+                yield self.finding(
+                    f"ladder:{label}",
+                    f"tripping rung {label!r} produces a program "
+                    f"IDENTICAL to {how} at {ctx.registry.geometry} "
+                    "geometry — the fallback is vacuous: a breaker trip "
+                    "would re-run a program that already failed")
+                break  # one finding per rung is enough
+
+        for kf in ctx.registry.knob_flips:
+            if kf.flipped is None:
+                yield self.finding(
+                    f"knob:{kf.knob}",
+                    f"env knob {kf.knob!r} is registered in ENV_KNOBS but "
+                    "has no flip probe in KNOB_FLIP_PROBES "
+                    "(analysis/trace/registry.py) — declare a value that "
+                    "provably changes the program so GV102 can keep "
+                    "proving the cache key covers it")
+                continue
+            base_text, flip_text = ctx.text(kf.base), ctx.text(kf.flipped)
+            if base_text is None or flip_text is None:
+                continue
+            program_changed = base_text != flip_text
+            key_changed = kf.base_key != kf.flipped_key
+            if program_changed and not key_changed:
+                yield self.finding(
+                    f"knob:{kf.knob}",
+                    f"flipping {kf.knob}={kf.flip_value!r} CHANGES the "
+                    "traced program but NOT the program-cache key — the "
+                    "stale-program class: requests under different switch "
+                    "values would share one compiled program (fold the "
+                    "knob into config_fingerprint / ENV_KNOBS)")
+            elif key_changed and not program_changed:
+                yield self.finding(
+                    f"knob:{kf.knob}",
+                    f"flipping {kf.knob}={kf.flip_value!r} changes the "
+                    "cache key but NOT the traced program at "
+                    f"{ctx.registry.geometry} geometry — dead cache-key "
+                    "bloat or a wrong probe value; fix the probe "
+                    "(KNOB_FLIP_PROBES) or justify the registry entry")
+            elif not key_changed and not program_changed:
+                yield self.finding(
+                    f"knob:{kf.knob}",
+                    f"flipping {kf.knob}={kf.flip_value!r} changes "
+                    "neither the program nor the cache key — a dead "
+                    "registry entry (or the knob is no longer consulted "
+                    "anywhere the trace can see)")

@@ -1,0 +1,57 @@
+"""GV103 — no host callbacks / debug effects in hot-path programs.
+
+``jax.debug.print``, ``pure_callback`` and friends are invaluable while
+debugging and catastrophic when they ship: each one is a device->host
+round trip per invocation (per ITERATION when it lands in the scan body),
+serializes dispatch, and on TPU forces the program into a
+host-synchronized mode. None of the serving/train/eval hot paths has any
+business talking to the host mid-program — the serving layer's host
+fetches happen between programs, by design (DESIGN.md r7).
+
+A debug print left in a kernel is the classic escape: it survives every
+numeric test (outputs are identical) and shows up only as a mysterious
+2-10x slowdown in the next bench run.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from raft_stereo_tpu.analysis.core import Finding
+from raft_stereo_tpu.analysis.trace.runner import TraceChecker, TraceContext
+
+
+class HostCallbackChecker(TraceChecker):
+    code = "GV103"
+    name = "host-callbacks"
+    description = ("host callback / debug-print primitive or effect in a "
+                   "hot-path program")
+
+    def check(self, ctx: TraceContext) -> Iterator[Finding]:
+        # Deferred: jaxprs imports jax; --list-checkers must not.
+        from raft_stereo_tpu.analysis.trace.jaxprs import (
+            effect_names, host_callback_sites)
+        # all_entries(): ladder-variant and knob-probe programs included —
+        # the fallback program serving runs AFTER a breaker trip is a hot
+        # path too (a debug print only in the plain-XLA branch must not
+        # hide behind the untripped default).
+        for entry in ctx.registry.all_entries():
+            closed = ctx.jaxpr(entry)
+            if closed is None:
+                continue
+            for prim, in_pallas in host_callback_sites(closed):
+                where = "a pallas kernel body" if in_pallas \
+                    else "the traced program"
+                yield self.finding(
+                    entry.name,
+                    f"host-callback primitive {prim!r} in {where} — a "
+                    "device->host round trip on the hot path (per "
+                    "iteration if inside the scan); remove it or move the "
+                    "host work between programs")
+            for eff in effect_names(closed):
+                yield self.finding(
+                    entry.name,
+                    f"jaxpr carries host-facing effect {eff} — same "
+                    "class as a callback primitive (forces host "
+                    "synchronization), even if no callback eqn is "
+                    "visible at this level")

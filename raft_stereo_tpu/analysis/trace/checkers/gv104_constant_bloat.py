@@ -1,0 +1,50 @@
+"""GV104 — constant bloat: no multi-MB arrays baked into a program.
+
+A closure-captured concrete array becomes a jaxpr CONSTANT: it is
+embedded in every compiled executable that traces it, uploaded per
+program (not per session), multiplied across the serving cache's shape x
+batch x fingerprint grid, and silently re-materialized on every breaker
+rebuild. The correct form is an ARGUMENT (weights live in the params
+pytree; grids/iota are generated on device). The classic source: a helper
+that computes ``np.something(shape)`` at trace time instead of
+``jnp``-on-tracer, or a debugging snapshot captured by a closure.
+
+Threshold: ``TraceRegistry.gv104_const_bytes`` (default 2 MiB) — small
+trace-time constants (lerp index vectors, per-block kernel tables) are
+the idiom and stay invisible.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from raft_stereo_tpu.analysis.core import Finding
+from raft_stereo_tpu.analysis.trace.runner import TraceChecker, TraceContext
+
+
+class ConstantBloatChecker(TraceChecker):
+    code = "GV104"
+    name = "constant-bloat"
+    description = "baked-in jaxpr constant above the byte threshold"
+
+    def check(self, ctx: TraceContext) -> Iterator[Finding]:
+        # Deferred: jaxprs imports jax; --list-checkers must not.
+        from raft_stereo_tpu.analysis.trace.jaxprs import baked_consts
+        limit = ctx.registry.gv104_const_bytes
+        # all_entries(): tripped-ladder and knob-probe programs count too —
+        # a constant baked only into a fallback program still ships.
+        for entry in ctx.registry.all_entries():
+            closed = ctx.jaxpr(entry)
+            if closed is None:
+                continue
+            for shape, dtype, nbytes in baked_consts(closed):
+                if nbytes <= limit:
+                    continue
+                yield self.finding(
+                    entry.name,
+                    f"program bakes in a {shape} {dtype} constant "
+                    f"({nbytes / 2**20:.1f} MiB > "
+                    f"{limit / 2**20:.1f} MiB limit) — embedded per "
+                    "compiled executable across the whole program cache; "
+                    "pass it as an argument or build it on device from "
+                    "tracers")

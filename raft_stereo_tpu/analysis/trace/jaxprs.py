@@ -1,0 +1,282 @@
+"""Jaxpr/StableHLO walking utilities shared by the GV checkers.
+
+Everything here operates on already-traced ``ClosedJaxpr`` objects (or
+lowered module text) — tracing itself lives in the runner so a trace
+failure is a GV000 finding, not a crash inside a checker.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from jax._src import core as _jcore
+
+ClosedJaxpr = _jcore.ClosedJaxpr
+Jaxpr = _jcore.Jaxpr
+Var = _jcore.Var
+
+_ADDR_RE = re.compile(r"0x[0-9a-f]+")
+
+
+def scrubbed_text(closed: ClosedJaxpr) -> str:
+    """Deterministic program text: ``str(jaxpr)`` with memory addresses
+    scrubbed. Two traces of the same program yield identical text (var
+    naming is deterministic); the only nondeterminism is object reprs in
+    eqn params (``<... at 0x7f..>``), which the scrub removes — verified
+    by ``tests/test_trace_analysis.py::test_text_deterministic``."""
+    return _ADDR_RE.sub("0xX", str(closed))
+
+
+def sub_jaxprs(params: Dict) -> Iterator[Jaxpr]:
+    """Raw sub-jaxprs held in one eqn's params (pjit/scan/cond/custom_*/
+    pallas all stash theirs under different keys and container shapes)."""
+    for v in params.values():
+        if isinstance(v, ClosedJaxpr):
+            yield v.jaxpr
+        elif isinstance(v, Jaxpr):
+            yield v
+        elif isinstance(v, (tuple, list)):
+            for x in v:
+                if isinstance(x, ClosedJaxpr):
+                    yield x.jaxpr
+                elif isinstance(x, Jaxpr):
+                    yield x
+
+
+def sub_closed_jaxprs(params: Dict) -> Iterator[ClosedJaxpr]:
+    """Like :func:`sub_jaxprs` but only the CLOSED ones (the carriers of
+    baked-in consts — GV104's quarry)."""
+    for v in params.values():
+        if isinstance(v, ClosedJaxpr):
+            yield v
+        elif isinstance(v, (tuple, list)):
+            for x in v:
+                if isinstance(x, ClosedJaxpr):
+                    yield x
+
+
+def walk_eqns(jaxpr: Jaxpr, *, in_pallas: bool = False
+              ) -> Iterator[Tuple[_jcore.JaxprEqn, bool]]:
+    """Every eqn at every depth, tagged with whether it executes inside a
+    ``pallas_call`` kernel body."""
+    for eqn in jaxpr.eqns:
+        yield eqn, in_pallas
+        child_in_pallas = in_pallas or eqn.primitive.name == "pallas_call"
+        for sub in sub_jaxprs(eqn.params):
+            yield from walk_eqns(sub, in_pallas=child_in_pallas)
+
+
+def iter_scans(jaxpr: Jaxpr) -> Iterator[_jcore.JaxprEqn]:
+    """Every ``scan`` eqn at any depth OUTSIDE pallas kernels (lax.scan
+    and lax.map both lower to it)."""
+    for eqn, in_pallas in walk_eqns(jaxpr):
+        if not in_pallas and eqn.primitive.name == "scan":
+            yield eqn
+
+
+# -- GV101: dtype discipline inside scan bodies -----------------------------
+
+#: Elementwise/shape glue a legal fp32-statistics upcast may pass through
+#: on its way to a reduction (instance norm: convert -> square -> mean).
+_ELEMENTWISE_GLUE = frozenset({
+    "mul", "add", "sub", "div", "neg", "integer_pow", "square", "abs",
+    "max", "min", "reshape", "squeeze", "expand_dims", "broadcast_in_dim",
+    "transpose", "convert_element_type",
+})
+
+#: Reduction-class primitives: an upcast whose value is consumed by one of
+#: these is fp32 ACCUMULATION — the whole point of mixed-precision
+#: discipline is that sums accumulate in fp32 while maps stay bf16.
+_REDUCTIONS = frozenset({
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
+    "reduce_window_sum", "reduce_window_max", "reduce_window_min",
+    "argmax", "argmin", "reduce_and", "reduce_or", "reduce_precision",
+})
+
+
+def _uses(jaxpr: Jaxpr) -> Dict[Var, List[_jcore.JaxprEqn]]:
+    out: Dict[Var, List[_jcore.JaxprEqn]] = {}
+    for eqn in jaxpr.eqns:
+        for iv in eqn.invars:
+            if isinstance(iv, Var):
+                out.setdefault(iv, []).append(eqn)
+    return out
+
+
+def _f32_sink_vars(jaxpr: Jaxpr, allowed_outs: Sequence[Var]) -> Set[Var]:
+    """Vars from which an allowed fp32 output is reachable through an
+    ALL-fp32 path: walk backward from the allowed outputs, refusing to
+    cross any ``convert_element_type`` (an upcast is the boundary where
+    fp32 accumulation begins; a downcast ends it). A bf16→f32 convert is a
+    legal accumulator feed iff its OUTPUT var lands in this set."""
+    sinks: Set[Var] = {v for v in allowed_outs if isinstance(v, Var)}
+    changed = True
+    while changed:
+        changed = False
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name == "convert_element_type":
+                continue  # converts never extend an fp32-only path
+            if any(ov in sinks for ov in eqn.outvars):
+                for iv in eqn.invars:
+                    if isinstance(iv, Var) and iv not in sinks and \
+                            str(iv.aval.dtype) == "float32":
+                        sinks.add(iv)
+                        changed = True
+    return sinks
+
+
+def _feeds_reduction(start: Var, uses: Dict[Var, List[_jcore.JaxprEqn]],
+                     depth: int = 3) -> bool:
+    """True when EVERY consumer path from ``start`` reaches a
+    reduction-class primitive within ``depth`` hops of elementwise glue —
+    the fp32-statistics pattern (norm moments, pooling sums). A consumer
+    that is neither glue nor a reduction (a conv, a gather, a downcast
+    back to bf16) disqualifies immediately: that is fp32 COMPUTE, not
+    fp32 accumulation."""
+    consumers = uses.get(start, [])
+    if not consumers:
+        return False
+    for eqn in consumers:
+        nm = eqn.primitive.name
+        if nm in _REDUCTIONS:
+            continue
+        if nm in _ELEMENTWISE_GLUE and nm != "convert_element_type":
+            if depth <= 0:
+                return False
+            if not all(_feeds_reduction(ov, uses, depth - 1)
+                       for ov in eqn.outvars if isinstance(ov, Var)):
+                return False
+            continue
+        return False
+    return True
+
+
+def offending_upcasts(scan_eqn: _jcore.JaxprEqn, *, min_elements: int
+                      ) -> List[Tuple[Tuple[int, ...], str]]:
+    """bf16→f32 converts in a scan body that are NEITHER fp32-carry
+    accumulator feeds NOR fp32-statistics reductions.
+
+    Returns ``(operand_shape, why)`` per offender. Analysis covers the
+    body's direct eqns plus nested non-pallas sub-jaxprs (each level
+    analyzed against its own fp32 outputs); pallas kernel bodies are
+    exempt by design — their in-kernel fp32 accumulation with in-kernel
+    downcast IS the sanctioned pattern (DESIGN.md r5/r6).
+    """
+    body = scan_eqn.params["jaxpr"].jaxpr
+    num_carry = scan_eqn.params["num_carry"]
+    f32_carries = [v for v in body.outvars[:num_carry]
+                   if str(v.aval.dtype) == "float32"]
+    out: List[Tuple[Tuple[int, ...], str]] = []
+
+    def check_level(jaxpr: Jaxpr, allowed_outs: Sequence[Var]) -> None:
+        uses = _uses(jaxpr)
+        sinks = _f32_sink_vars(jaxpr, allowed_outs)
+        for eqn in jaxpr.eqns:
+            nm = eqn.primitive.name
+            if nm == "convert_element_type":
+                op = eqn.invars[0]
+                if not isinstance(op, Var):
+                    continue
+                if str(op.aval.dtype) != "bfloat16" or \
+                        str(eqn.outvars[0].aval.dtype) != "float32":
+                    continue
+                if op.aval.size < min_elements:
+                    continue
+                ov = eqn.outvars[0]
+                if ov in sinks:
+                    continue  # fp32 accumulator feed (e.g. the epipolar
+                    # delta-flow into the coords carry)
+                if _feeds_reduction(ov, uses):
+                    continue  # fp32 statistics (norm moments, pool sums)
+                out.append((tuple(op.aval.shape),
+                            "result neither reaches an fp32 carry on an "
+                            "fp32-only path nor feeds a reduction"))
+            elif nm != "pallas_call":
+                for sub in sub_jaxprs(eqn.params):
+                    # Nested levels: any fp32 output of the sub-jaxpr is
+                    # an allowed sink (conservative — the outer level
+                    # already constrains where those outputs may go).
+                    check_level(sub, [v for v in sub.outvars
+                                      if isinstance(v, Var) and
+                                      str(v.aval.dtype) == "float32"])
+
+    check_level(body, f32_carries)
+    return out
+
+
+# -- GV103: host callbacks --------------------------------------------------
+
+_CALLBACK_PRIM_NAMES = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "debug_print",
+    "outside_call", "host_callback_call",
+})
+
+
+def host_callback_sites(closed: ClosedJaxpr) -> List[Tuple[str, bool]]:
+    """``(primitive_name, in_pallas)`` for every host-callback/debug
+    primitive anywhere in the program (pallas kernels included — a
+    ``pl.debug_print`` in a hot-path kernel serializes the grid)."""
+    out = []
+    for eqn, in_pallas in walk_eqns(closed.jaxpr):
+        nm = eqn.primitive.name
+        if nm in _CALLBACK_PRIM_NAMES or nm.endswith("_callback"):
+            out.append((nm, in_pallas))
+    return out
+
+
+def effect_names(closed: ClosedJaxpr) -> List[str]:
+    """Names of jaxpr-level effects that imply host round trips."""
+    out = []
+    for eff in getattr(closed, "effects", ()) or ():
+        nm = type(eff).__name__
+        if any(t in nm for t in ("Callback", "Debug", "IO", "Print")):
+            out.append(nm)
+    return sorted(out)
+
+
+# -- GV104: baked-in constants ----------------------------------------------
+
+def baked_consts(closed: ClosedJaxpr) -> List[Tuple[Tuple[int, ...], str, int]]:
+    """``(shape, dtype, nbytes)`` of every constant baked into the program
+    (top-level consts plus every nested closed sub-jaxpr's), deduped by
+    object identity."""
+    seen: Set[int] = set()
+    out: List[Tuple[Tuple[int, ...], str, int]] = []
+
+    def visit(cj: ClosedJaxpr) -> None:
+        for c in cj.consts:
+            if id(c) in seen:
+                continue
+            seen.add(id(c))
+            nbytes = getattr(c, "nbytes", None)
+            if nbytes is None:
+                continue
+            out.append((tuple(getattr(c, "shape", ())),
+                        str(getattr(c, "dtype", "?")), int(nbytes)))
+        for eqn in cj.jaxpr.eqns:
+            for sub in sub_closed_jaxprs(eqn.params):
+                visit(sub)
+
+    visit(closed)
+    return out
+
+
+# -- GV105: lowered input-output aliasing -----------------------------------
+
+_MAIN_SIG_RE = re.compile(r"func\.func public @main\((.*?)\)\s*->", re.S)
+_ARG_RE = re.compile(r"%arg(\d+): tensor<[^>]*>\s*(\{[^{}]*\})?")
+
+
+def aliased_arg_indices(lowered_text: str) -> Optional[Set[int]]:
+    """Indices of @main args carrying a ``tf.aliasing_output`` attribute
+    in the lowered StableHLO module — the lowering-level truth of buffer
+    donation. None when no public @main is found (caller reports GV000)."""
+    m = _MAIN_SIG_RE.search(lowered_text)
+    if m is None:
+        return None
+    out: Set[int] = set()
+    for idx, attrs in _ARG_RE.findall(m.group(1)):
+        if attrs and "tf.aliasing_output" in attrs:
+            out.add(int(idx))
+    return out

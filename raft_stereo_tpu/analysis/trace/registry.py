@@ -1,0 +1,334 @@
+"""The traceable-entry-point registry: WHAT graftverify analyzes.
+
+Entries are built from the repo's real builders — ``serve/session.py``'s
+``build_program`` (the exact callables the serving cache jits),
+``engine/steps.py``'s ``make_train_step`` (the exact jitted+donated train
+step), and the eval forward — at pinned geometries, so the GV checkers
+walk the programs production compiles rather than hand-written stand-ins.
+
+Geometries:
+
+- ``headline``: the bench north-star shape (bench.py: Middlebury-F padded,
+  2016x2976, 32 iters, reg_tpu bf16). This is where the acceptance-grade
+  claims live — every kernel path engages, so GV102 can prove each
+  breaker rung and each ENV_KNOBS entry actually changes the program.
+- ``small``: a fast shape for development loops. Kernel engagement
+  heuristics (the 200k-pixel ``_batch_worthwhile`` threshold) do NOT
+  clear at this size, so ladder/knob probes are headline-only — at small
+  shapes several rungs are legitimately no-ops and GV102 would report
+  false vacuity (``ladder_variants``/``knob_flips`` are empty here).
+
+Everything is lazy: ``TraceEntry.build`` closures defer jax work to the
+runner, which converts a failing entry into a GV000 finding instead of a
+crash — the GL006 lesson (an extractor that silently resolves nothing
+must not read as "clean") applies doubly to a tracer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Callable, Dict, List, Optional, Tuple
+
+@dataclasses.dataclass(frozen=True)
+class KnobProbe:
+    """Where and how one env knob provably changes the traced program:
+    ``flip`` is a value different from the default; ``kind``/``batch``
+    pick the serving program the knob engages on (most knobs bite the
+    B=1 full forward; RAFT_BATCH_FUSE_PIXELS by construction only bites
+    batched programs — ``_batch_worthwhile`` short-circuits at B=1)."""
+
+    flip: str
+    kind: str = "full"
+    batch: int = 1
+
+
+#: Declared flip probe per registered env knob: a value provably different
+#: from the default that must change the traced program at headline
+#: geometry, on the program kind where the knob engages. A knob added to
+#: ENV_KNOBS without a probe here is itself a GV102 finding (the registry
+#: must stay exhaustive, mechanically).
+KNOB_FLIP_PROBES: Dict[str, KnobProbe] = {
+    "RAFT_STREAM_TAIL": KnobProbe("0"),          # default on -> off
+    "RAFT_FUSE_GRU1632": KnobProbe("0"),         # default on -> off
+    "RAFT_FUSED_ENCODERS": KnobProbe("0"),       # default on -> off
+    "RAFT_PACKED_L2": KnobProbe("0"),            # default on -> off
+    "RAFT_CORR_TILE": KnobProbe("1024"),         # 2048 -> half (new grid)
+    # The batch-fusion threshold is a no-op at B=1 (that is its spec:
+    # _batch_worthwhile gives B=1 an unconditional pass) — probe it on the
+    # continuous-batching advance program at b=2, where headline
+    # per-sample frames clear the 200k default and a never-fuse flip
+    # provably de-fuses the kernels.
+    "RAFT_BATCH_FUSE_PIXELS": KnobProbe("1000000000", kind="advance",
+                                        batch=2),
+}
+
+GEOMETRIES: Dict[str, Dict[str, int]] = {
+    # bench.py headline defaults (RAFT_BENCH_H/W), 32 refinement iters,
+    # segment length = valid_iters // segments with the serving defaults.
+    "headline": dict(h=2016, w=2976, iters=32, seg_iters=8),
+    "small": dict(h=256, w=320, iters=4, seg_iters=2),
+}
+
+#: Train-step trace geometry (shared by both registry geometries): the
+#: donation/callback/constant invariants are geometry-independent and the
+#: CPU lowering of the full value_and_grad program is the single most
+#: expensive trace — keep it at a tiny crop.
+TRAIN_GEOMETRY = dict(h=64, w=96, batch=1, iters=2)
+
+
+@dataclasses.dataclass
+class TraceEntry:
+    """One traceable program.
+
+    build: ``() -> (fn, args)`` — called by the runner inside the entry's
+        env override window, so trace-time env reads see exactly ``env``.
+    env: FULLY RESOLVED kernel-switch mapping (``None`` = unset) exported
+        around the trace; also what cache keys are computed from.
+    mixed_precision: GV101 applies (the program computes in bf16).
+    build_lowered: when set, GV105 applies — ``() -> (stablehlo_text,
+        donated_leaves)`` where ``donated_leaves`` is ``[(path, aval)]``
+        in flattened argument order for the donated argnums.
+    """
+
+    name: str
+    build: Callable[[], Tuple[Callable, Tuple]]
+    env: Dict[str, Optional[str]]
+    hot_path: str = "serve"
+    mixed_precision: bool = False
+    build_lowered: Optional[Callable[[], Tuple[str, List[Tuple[str, object]]]]] = None
+
+
+@dataclasses.dataclass
+class KnobFlip:
+    """One GV102 knob probe: flipping ``knob`` to ``flip_value`` must
+    change the traced program text IFF it changes the program-cache key.
+    ``flipped`` is None when no probe is declared for a registered knob —
+    itself a finding."""
+
+    knob: str
+    flip_value: Optional[str]
+    base: TraceEntry
+    flipped: Optional[TraceEntry]
+    base_key: object = None
+    flipped_key: object = None
+
+
+@dataclasses.dataclass
+class TraceRegistry:
+    """Everything one graftverify run analyzes, plus its thresholds and
+    table-level suppressions (trace findings have no source line to hang
+    a comment on, so suppressions are ``(code, context) -> reason``
+    entries here; a reasonless suppression is a GV000 finding, exactly
+    like graftlint's reasonless inline disables)."""
+
+    geometry: str
+    entries: List[TraceEntry]
+    ladder_variants: List[Tuple[str, TraceEntry]]
+    knob_flips: List[KnobFlip]
+    suppressions: Dict[Tuple[str, str], str] = dataclasses.field(
+        default_factory=dict)
+    gv101_min_elements: int = 4096
+    gv104_const_bytes: int = 2 * 1024 * 1024
+
+    def all_entries(self) -> List[TraceEntry]:
+        seen: Dict[str, TraceEntry] = {}
+        for e in self.entries:
+            seen.setdefault(e.name, e)
+        for _, e in self.ladder_variants:
+            seen.setdefault(e.name, e)
+        for kf in self.knob_flips:
+            seen.setdefault(kf.base.name, kf.base)
+            if kf.flipped is not None:
+                seen.setdefault(kf.flipped.name, kf.flipped)
+        return list(seen.values())
+
+
+def default_registry(geometry: str = "headline") -> TraceRegistry:
+    """The real tree's registry: five serving program kinds + the train
+    step + the eval forward, with ladder/knob probes at headline."""
+    import jax
+    import jax.numpy as jnp
+
+    from raft_stereo_tpu.analysis.knobs import ENV_KNOBS
+    from raft_stereo_tpu.config import RAFTStereoConfig, with_eval_precision
+    from raft_stereo_tpu.models.raft_stereo import (init_raft_stereo,
+                                                    raft_stereo_forward)
+    from raft_stereo_tpu.serve.session import (build_program,
+                                               config_fingerprint,
+                                               resolve_env)
+
+    if geometry not in GEOMETRIES:
+        raise ValueError(f"unknown trace geometry {geometry!r} "
+                         f"(have {sorted(GEOMETRIES)})")
+    g = GEOMETRIES[geometry]
+
+    # The bench headline config: reg_tpu corr + the shared eval bf16
+    # policy (config.eval_mixed_precision) — what serving/eval actually
+    # runs on TPU, kernels engaged.
+    cfg_serve = with_eval_precision(
+        RAFTStereoConfig(corr_implementation="reg_tpu"))
+    # The reference eval config: plain XLA, fp32 (reg corr).
+    cfg_eval = RAFTStereoConfig()
+    # The analyzer's canonical env: every registered switch UNSET, i.e.
+    # defaults — results never depend on the operator's live environment.
+    base_env: Dict[str, Optional[str]] = {k: None for k in ENV_KNOBS}
+
+    img = jax.ShapeDtypeStruct((1, g["h"], g["w"], 3), jnp.float32)
+
+    @functools.lru_cache(maxsize=None)
+    def params_spec():
+        return jax.eval_shape(
+            functools.partial(init_raft_stereo, cfg=cfg_serve),
+            jax.random.PRNGKey(0))
+
+    @functools.lru_cache(maxsize=None)
+    def state_spec(batch: int = 1):
+        # The refinement carry's structure, from the same prepare program
+        # serving compiles (shape-only — eval_shape executes nothing).
+        prep = build_program("prepare", cfg_serve, 0)
+        bimg = jax.ShapeDtypeStruct((batch, g["h"], g["w"], 3),
+                                    jnp.float32)
+        (state,) = jax.eval_shape(prep, params_spec(), bimg, bimg)
+        return state
+
+    def serve_entry(name: str, kind: str, iters: int, *,
+                    carry_input: bool) -> TraceEntry:
+        def build(kind=kind, iters=iters, carry_input=carry_input):
+            fn = build_program(kind, cfg_serve, iters)
+            args = ((params_spec(), state_spec()) if carry_input
+                    else (params_spec(), img, img))
+            return fn, args
+        return TraceEntry(name=name, build=build, env=dict(base_env),
+                          hot_path="serve", mixed_precision=True)
+
+    entries = [
+        serve_entry("serve/full", "full", g["iters"], carry_input=False),
+        serve_entry("serve/prepare", "prepare", 0, carry_input=False),
+        serve_entry("serve/segment", "segment", g["seg_iters"],
+                    carry_input=True),
+        serve_entry("serve/advance", "advance", g["seg_iters"],
+                    carry_input=True),
+        serve_entry("serve/epilogue", "epilogue", 0, carry_input=True),
+    ]
+
+    def build_eval():
+        def fwd(p, i1, i2):
+            return raft_stereo_forward(p, cfg_eval, i1, i2,
+                                       iters=g["iters"], test_mode=True)
+        return fwd, (params_spec(), img, img)
+    entries.append(TraceEntry(name="eval/forward", build=build_eval,
+                              env=dict(base_env), hot_path="eval",
+                              mixed_precision=False))
+
+    entries.append(_train_entry(base_env))
+
+    ladder_variants: List[Tuple[str, TraceEntry]] = []
+    knob_flips: List[KnobFlip] = []
+    if geometry == "headline":
+        from raft_stereo_tpu.serve.guard import KernelCircuitBreaker
+        breaker = KernelCircuitBreaker()
+        names = [p.name for p in breaker.ladder]
+        ladder_variants.append(("untripped", entries[0]))
+        for k in range(1, len(names) + 1):
+            run_cfg, env_over = breaker.apply(
+                cfg_serve, tripped=tuple(names[:k]))
+            env = resolve_env(env_over, base_env)
+
+            def build(run_cfg=run_cfg):
+                return (build_program("full", run_cfg, g["iters"]),
+                        (params_spec(), img, img))
+            ladder_variants.append((names[k - 1], TraceEntry(
+                name=f"serve/full@ladder:{k}:{names[k - 1]}",
+                build=build, env=env, hot_path="serve")))
+
+        base_key = config_fingerprint(cfg_serve, dict(base_env))
+
+        def probe_build(kind: str, batch: int):
+            def build(kind=kind, batch=batch):
+                iters = g["seg_iters"] if kind in ("segment", "advance") \
+                    else g["iters"]
+                fn = build_program(kind, cfg_serve, iters)
+                if kind in ("segment", "advance", "epilogue"):
+                    return fn, (params_spec(), state_spec(batch))
+                bimg = jax.ShapeDtypeStruct((batch, g["h"], g["w"], 3),
+                                            jnp.float32)
+                return fn, (params_spec(), bimg, bimg)
+            return build
+
+        probe_bases: Dict[Tuple[str, int], TraceEntry] = {
+            ("full", 1): entries[0]}
+        for knob in ENV_KNOBS:
+            probe = KNOB_FLIP_PROBES.get(knob)
+            if probe is None:
+                knob_flips.append(KnobFlip(knob, None, entries[0], None))
+                continue
+            bk = (probe.kind, probe.batch)
+            if bk not in probe_bases:
+                probe_bases[bk] = TraceEntry(
+                    name=f"serve/{probe.kind}@b{probe.batch}",
+                    build=probe_build(*bk), env=dict(base_env),
+                    hot_path="serve")
+            env = resolve_env({knob: probe.flip}, base_env)
+            knob_flips.append(KnobFlip(
+                knob, probe.flip, probe_bases[bk],
+                TraceEntry(name=f"serve/{probe.kind}@b{probe.batch}"
+                                f"@knob:{knob}",
+                           build=probe_build(*bk), env=env,
+                           hot_path="serve"),
+                base_key=base_key,
+                flipped_key=config_fingerprint(cfg_serve, env)))
+
+    return TraceRegistry(geometry=geometry, entries=entries,
+                         ladder_variants=ladder_variants,
+                         knob_flips=knob_flips)
+
+
+def _train_entry(base_env: Dict[str, Optional[str]]) -> TraceEntry:
+    """The real jitted train step (optimizer stack + donation included)."""
+    import jax
+    import jax.numpy as jnp
+
+    from raft_stereo_tpu.config import RAFTStereoConfig
+    from raft_stereo_tpu.engine.optimizer import make_optimizer
+    from raft_stereo_tpu.engine.steps import (TRAIN_STEP_DONATE,
+                                              make_train_step)
+    from raft_stereo_tpu.models.raft_stereo import init_raft_stereo
+
+    tg = TRAIN_GEOMETRY
+    cfg_train = RAFTStereoConfig()
+
+    @functools.lru_cache(maxsize=None)
+    def pieces():
+        tx, _ = make_optimizer(0.0002, 100, skip_nonfinite=5)
+        step = make_train_step(cfg_train, tx, train_iters=tg["iters"])
+        pspec = jax.eval_shape(
+            functools.partial(init_raft_stereo, cfg=cfg_train),
+            jax.random.PRNGKey(0))
+        ospec = jax.eval_shape(tx.init, pspec)
+        b, h, w = tg["batch"], tg["h"], tg["w"]
+        batch = {
+            "image1": jax.ShapeDtypeStruct((b, h, w, 3), jnp.float32),
+            "image2": jax.ShapeDtypeStruct((b, h, w, 3), jnp.float32),
+            "flow": jax.ShapeDtypeStruct((b, h, w, 1), jnp.float32),
+            "valid": jax.ShapeDtypeStruct((b, h, w), jnp.float32),
+        }
+        return step, pspec, ospec, batch
+
+    def build():
+        step, pspec, ospec, batch = pieces()
+        return step, (pspec, ospec, batch)
+
+    def build_lowered():
+        step, pspec, ospec, batch = pieces()
+        donated_specs = (pspec, ospec)
+        assert TRAIN_STEP_DONATE == tuple(range(len(donated_specs))), \
+            "GV105's donated-leaf bookkeeping assumes donate_argnums " \
+            "covers a leading prefix of the step arguments"
+        leaves = jax.tree_util.tree_flatten_with_path(donated_specs)[0]
+        return (step.lower(pspec, ospec, batch).as_text(),
+                [(jax.tree_util.keystr(p), v) for p, v in leaves])
+
+    return TraceEntry(name="train/step", build=build, env=dict(base_env),
+                      hot_path="train", mixed_precision=False,
+                      build_lowered=build_lowered)

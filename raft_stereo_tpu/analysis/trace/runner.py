@@ -1,0 +1,169 @@
+"""graftverify runner: trace entries once, run the GV checkers, fold
+table suppressions into a :class:`~raft_stereo_tpu.analysis.core.Report`.
+
+Mirrors ``analysis/core.run_checkers``' contract: GV000 (trace/internal
+meta findings) is never suppressible and never filterable by ``--select``
+— an entry that fails to trace, or a reasonless suppression, must not be
+able to read as "clean".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Sequence
+
+from raft_stereo_tpu.analysis.core import Finding, Report
+from raft_stereo_tpu.analysis.trace.registry import TraceEntry, TraceRegistry
+
+#: Meta-code for graftverify itself: trace failures, missing probes,
+#: reasonless suppressions. Not suppressible, not selectable-away.
+GV_META_CODE = "GV000"
+
+
+class TraceFailure(Exception):
+    """An entry failed to build/trace — surfaced as a GV000 finding."""
+
+
+class TraceChecker:
+    """One GV finding code. Subclasses set the class attrs and implement
+    :meth:`check`. Use :meth:`finding` so contexts (the suppression keys)
+    stay uniform: ``trace:<entry-or-probe-name>``."""
+
+    code: str = "GV???"
+    name: str = ""
+    description: str = ""
+
+    def check(self, ctx: "TraceContext") -> Iterator[Finding]:
+        return iter(())
+
+    def finding(self, context: str, message: str) -> Finding:
+        return Finding(self.code, message, f"trace:{context}", 0)
+
+
+class TraceContext:
+    """Per-run cache of traced programs, shared by all checkers so the
+    expensive artifacts (jaxpr, scrubbed text, lowered module) are built
+    once per entry regardless of how many checkers read them."""
+
+    def __init__(self, registry: TraceRegistry):
+        self.registry = registry
+        self._jaxprs: Dict[str, object] = {}   # name -> ClosedJaxpr | Exception
+        self._texts: Dict[str, str] = {}
+        self._lowered: Dict[str, object] = {}
+
+    # Every accessor returns None on a failed entry — the failure itself
+    # is reported exactly once, by the runner's pre-trace pass.
+
+    def jaxpr(self, entry: TraceEntry):
+        cached = self._jaxprs.get(entry.name)
+        if cached is not None:
+            return None if isinstance(cached, Exception) else cached
+        try:
+            import jax
+
+            from raft_stereo_tpu.serve.session import _env_overrides
+            with _env_overrides(dict(entry.env)):
+                fn, args = entry.build()
+                closed = jax.make_jaxpr(fn)(*args)
+        except Exception as e:  # noqa: BLE001 — converted to GV000
+            self._jaxprs[entry.name] = e
+            return None
+        self._jaxprs[entry.name] = closed
+        return closed
+
+    def text(self, entry: TraceEntry) -> Optional[str]:
+        if entry.name not in self._texts:
+            from raft_stereo_tpu.analysis.trace.jaxprs import scrubbed_text
+            closed = self.jaxpr(entry)
+            if closed is None:
+                return None
+            self._texts[entry.name] = scrubbed_text(closed)
+        return self._texts[entry.name]
+
+    def lowered(self, entry: TraceEntry):
+        """``(stablehlo_text, donated_leaves)`` for a GV105 entry."""
+        cached = self._lowered.get(entry.name)
+        if cached is not None:
+            return None if isinstance(cached, Exception) else cached
+        if entry.build_lowered is None:
+            return None
+        try:
+            from raft_stereo_tpu.serve.session import _env_overrides
+            with _env_overrides(dict(entry.env)):
+                result = entry.build_lowered()
+        except Exception as e:  # noqa: BLE001 — converted to GV000
+            self._lowered[entry.name] = e
+            return None
+        self._lowered[entry.name] = result
+        return result
+
+    def trace_errors(self) -> List[Finding]:
+        out = []
+        for name in sorted(self._jaxprs):
+            e = self._jaxprs[name]
+            if isinstance(e, Exception):
+                out.append(Finding(
+                    GV_META_CODE,
+                    f"entry failed to trace: {type(e).__name__}: {e}",
+                    f"trace:{name}", 0))
+        for name in sorted(self._lowered):
+            e = self._lowered[name]
+            if isinstance(e, Exception):
+                out.append(Finding(
+                    GV_META_CODE,
+                    f"entry failed to lower: {type(e).__name__}: {e}",
+                    f"trace:{name}", 0))
+        return out
+
+    @property
+    def entries_traced(self) -> int:
+        return sum(1 for v in self._jaxprs.values()
+                   if not isinstance(v, Exception))
+
+
+def run_trace_analysis(registry: TraceRegistry, *,
+                       select: Optional[Sequence[str]] = None,
+                       checkers: Optional[Sequence[TraceChecker]] = None
+                       ) -> Report:
+    """Trace + check + suppress; the trace-side half of ``--trace``."""
+    if checkers is None:
+        from raft_stereo_tpu.analysis.trace.checkers import \
+            ALL_TRACE_CHECKERS
+        checkers = [c() for c in ALL_TRACE_CHECKERS]
+    ctx = TraceContext(registry)
+    raw: List[Finding] = []
+    # Pre-trace every declared entry: a dead entry is a finding even if no
+    # checker would have touched it (the analyzer must not silently shrink).
+    for entry in registry.all_entries():
+        ctx.jaxpr(entry)
+    for checker in checkers:
+        raw.extend(checker.check(ctx))
+    raw.extend(ctx.trace_errors())
+
+    sup = registry.suppressions
+    active: List[Finding] = []
+    suppressed: List[Finding] = []
+    for f in raw:
+        context = f.path[len("trace:"):] if f.path.startswith("trace:") \
+            else f.path
+        reason = sup.get((f.code, context))
+        if f.code != GV_META_CODE and reason is not None and reason.strip():
+            suppressed.append(dataclasses.replace(
+                f, suppressed=True, suppress_reason=reason.strip()))
+        else:
+            # Blank includes whitespace-only — a reasonless suppression
+            # must not be able to hide anything, itself included.
+            if f.code != GV_META_CODE and reason is not None:
+                active.append(Finding(
+                    GV_META_CODE,
+                    f"suppression for ({f.code}, {context!r}) has no "
+                    "reason — registry suppressions must say why",
+                    f.path, 0))
+            active.append(f)
+
+    def keep(f: Finding) -> bool:
+        return (select is None or f.code == GV_META_CODE
+                or f.code in select)
+    return Report([f for f in active if keep(f)],
+                  [f for f in suppressed if keep(f)],
+                  files_analyzed=0, entries_traced=ctx.entries_traced)

@@ -119,23 +119,62 @@ DEFAULT_LADDER: Tuple[FastPath, ...] = (
     ),
 )
 
-# Failure-classifier markers: a raised exception is a *kernel* failure —
-# breaker territory — only if it is an injected kernel error, an XLA
-# runtime error, or its message carries one of these. Anything else
-# (a TypeError in our own code, a KeyboardInterrupt) must propagate.
-_KERNEL_FAILURE_MARKERS = (
-    "resource_exhausted", "out of memory", "mosaic", "pallas",
-    "internal: ", "xla runtime error",
+@dataclasses.dataclass(frozen=True)
+class FailureMarker:
+    """One failure-classifier marker: a lowercase substring whose presence
+    in ``str(exc).lower()`` marks the exception as *kernel-failure*
+    territory (breaker trips + rebuild-one-rung-down), with the failure
+    category it attributes and why it is specific enough to trust."""
+
+    substring: str
+    category: str  # 'oom' | 'kernel_compiler' | 'xla_runtime'
+    note: str
+
+
+# The ONE table of kernel-failure markers (previously an anonymous tuple
+# matched inline): a raised exception is a *kernel* failure — breaker
+# territory — only if it is an injected kernel error, an XLA runtime
+# error by type name, or its message carries one of these substrings.
+# Anything else (a TypeError in our own code, a KeyboardInterrupt) must
+# propagate. Kept deliberately specific: a marker loose enough to match
+# an application error would convert crashes into silent rung walks.
+KERNEL_FAILURE_MARKERS: Tuple[FailureMarker, ...] = (
+    FailureMarker("resource_exhausted", "oom",
+                  "XLA RESOURCE_EXHAUSTED status text (HBM/VMEM OOM)"),
+    FailureMarker("out of memory", "oom",
+                  "allocator message form of the same OOM class"),
+    FailureMarker("mosaic", "kernel_compiler",
+                  "Mosaic (TPU Pallas backend) compile/verify errors"),
+    FailureMarker("pallas", "kernel_compiler",
+                  "pallas_call lowering/interpret errors name the layer"),
+    FailureMarker("internal: ", "xla_runtime",
+                  "XLA INTERNAL status prefix (miscompiled/failed launch)"),
+    FailureMarker("xla runtime error", "xla_runtime",
+                  "generic XLA runtime failure text"),
 )
+
+#: Exception type NAMES (not classes — jaxlib import is optional here)
+#: that are kernel failures regardless of message.
+KERNEL_FAILURE_TYPE_NAMES = ("XlaRuntimeError", "JaxRuntimeError")
+
+
+def match_failure_marker(exc: BaseException) -> Optional[FailureMarker]:
+    """The first marker whose substring appears in the exception message,
+    else None. Exposed (rather than inlined) so tests pin every entry and
+    the classification table has one reviewable home."""
+    msg = str(exc).lower()
+    for marker in KERNEL_FAILURE_MARKERS:
+        if marker.substring in msg:
+            return marker
+    return None
 
 
 def is_kernel_failure(exc: BaseException) -> bool:
     if isinstance(exc, InjectedKernelError):
         return True
-    if type(exc).__name__ in ("XlaRuntimeError", "JaxRuntimeError"):
+    if type(exc).__name__ in KERNEL_FAILURE_TYPE_NAMES:
         return True
-    msg = str(exc).lower()
-    return any(m in msg for m in _KERNEL_FAILURE_MARKERS)
+    return match_failure_marker(exc) is not None
 
 
 @dataclasses.dataclass

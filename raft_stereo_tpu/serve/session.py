@@ -248,6 +248,71 @@ def config_fingerprint(cfg: RAFTStereoConfig,
     return cfg_part, env_part
 
 
+# Every serving program kind the session can compile — ONE list shared by
+# `_build_fn` and the graftverify trace registry
+# (analysis/trace/registry.py), which traces each kind at pinned shapes so
+# the GV checkers walk exactly the programs serving would compile.
+PROGRAM_KINDS = ("full", "prepare", "segment", "advance", "epilogue")
+
+
+def build_program(kind: str, cfg, iters: int):
+    """The RAW (unjitted) python callable for one serving program kind.
+
+    This is the traceable entry-point registry's view of the session: the
+    session jits exactly this callable (``_build_fn``), so a jaxpr of
+    ``build_program(kind, ...)`` at a padded shape IS the program the
+    serving cache would compile — graftverify's checkers (GV101-GV104)
+    walk these, and any drift between serving and analysis is structurally
+    impossible because there is one builder.
+    """
+    import jax.numpy as jnp
+    from raft_stereo_tpu.models import (raft_stereo_epilogue,
+                                        raft_stereo_forward,
+                                        raft_stereo_prepare,
+                                        raft_stereo_segment,
+                                        raft_stereo_segment_carry)
+    if kind == "full":
+        # The exact program engine/evaluate.make_eval_forward compiles
+        # (flow plus a checksum whose host fetch is the completion
+        # barrier) — byte-identical serving vs the eval/demo path.
+        def fwd(p, image1, image2):
+            _, flow_up = raft_stereo_forward(
+                p, cfg, image1, image2, iters=iters, test_mode=True)
+            return flow_up, jnp.sum(flow_up.astype(jnp.float32))
+        return fwd
+    if kind == "prepare":
+        def prep(p, image1, image2):
+            # 1-tuple so every program returns a tuple (invoke()'s
+            # fetch iterates outputs; the carry dict is one output).
+            return (raft_stereo_prepare(p, cfg, image1, image2),)
+        return prep
+    if kind == "segment":
+        def seg(p, state):
+            state, _, flow_up = raft_stereo_segment(
+                p, cfg, state, iters=iters)
+            return state, flow_up, jnp.sum(flow_up.astype(jnp.float32))
+        return seg
+    if kind == "advance":
+        # The continuous-batching tick: advance the whole device batch
+        # WITHOUT the mask-head epilogue (exiting rows pay it once, in
+        # the batched "epilogue" program). The per-row coords sums are
+        # the host fetch that doubles as the completion barrier.
+        def adv(p, state):
+            state = raft_stereo_segment_carry(p, cfg, state, iters=iters)
+            rowsum = jnp.sum(state["coords1"].astype(jnp.float32),
+                             axis=(1, 2, 3))
+            return state, rowsum
+        return adv
+    if kind == "epilogue":
+        # Mask head + convex upsample for a batch of exiting carries —
+        # one stacked round trip for every row that finished this tick.
+        def epi(p, state):
+            _, flow_up = raft_stereo_epilogue(p, cfg, state)
+            return (flow_up,)
+        return epi
+    raise ValueError(f"unknown program kind {kind!r}")
+
+
 class InferenceSession:
     """Owns params + config; admits arbitrary pairs, serves disparity."""
 
@@ -411,53 +476,7 @@ class InferenceSession:
         return (kind, b, h, w, iters, self._fingerprint(cfg, env))
 
     def _build_fn(self, kind: str, cfg, iters: int):
-        import jax.numpy as jnp
-        from raft_stereo_tpu.models import (raft_stereo_epilogue,
-                                            raft_stereo_forward,
-                                            raft_stereo_prepare,
-                                            raft_stereo_segment,
-                                            raft_stereo_segment_carry)
-        jax = self._jax
-        if kind == "full":
-            # The exact program engine/evaluate.make_eval_forward compiles
-            # (flow plus a checksum whose host fetch is the completion
-            # barrier) — byte-identical serving vs the eval/demo path.
-            def fwd(p, image1, image2):
-                _, flow_up = raft_stereo_forward(
-                    p, cfg, image1, image2, iters=iters, test_mode=True)
-                return flow_up, jnp.sum(flow_up.astype(jnp.float32))
-            return jax.jit(fwd)
-        if kind == "prepare":
-            def prep(p, image1, image2):
-                # 1-tuple so every program returns a tuple (invoke()'s
-                # fetch iterates outputs; the carry dict is one output).
-                return (raft_stereo_prepare(p, cfg, image1, image2),)
-            return jax.jit(prep)
-        if kind == "segment":
-            def seg(p, state):
-                state, _, flow_up = raft_stereo_segment(
-                    p, cfg, state, iters=iters)
-                return state, flow_up, jnp.sum(flow_up.astype(jnp.float32))
-            return jax.jit(seg)
-        if kind == "advance":
-            # The continuous-batching tick: advance the whole device batch
-            # WITHOUT the mask-head epilogue (exiting rows pay it once, in
-            # the batched "epilogue" program). The per-row coords sums are
-            # the host fetch that doubles as the completion barrier.
-            def adv(p, state):
-                state = raft_stereo_segment_carry(p, cfg, state, iters=iters)
-                rowsum = jnp.sum(state["coords1"].astype(jnp.float32),
-                                 axis=(1, 2, 3))
-                return state, rowsum
-            return jax.jit(adv)
-        if kind == "epilogue":
-            # Mask head + convex upsample for a batch of exiting carries —
-            # one stacked round trip for every row that finished this tick.
-            def epi(p, state):
-                _, flow_up = raft_stereo_epilogue(p, cfg, state)
-                return (flow_up,)
-            return jax.jit(epi)
-        raise ValueError(f"unknown program kind {kind!r}")
+        return self._jax.jit(build_program(kind, cfg, iters))
 
     def get_program(self, kind: str, h: int, w: int, iters: int,
                     cfg=None, env=None, b: int = 1) -> _Program:

@@ -4,14 +4,25 @@
 # scripts/release_gate.sh; run locally after any change to models/ops/
 # corr/serve:
 #
-#   bash scripts/lint.sh                 # full tree
+#   bash scripts/lint.sh                 # full tree (AST only, milliseconds)
 #   bash scripts/lint.sh --changed-only  # git-changed files only
+#   bash scripts/lint.sh --trace         # + graftverify (GV101-GV105):
+#                                        # trace-level jaxpr/HLO analysis,
+#                                        # ~40 s on CPU (DESIGN.md r10)
 #   bash scripts/lint.sh <paths...>      # explicit targets (tests use this
 #                                        # to prove an injected violation
 #                                        # fails the gate)
 #
-# Exits with the linter's status: 0 clean, 1 findings, 2 internal error.
-# No jax import — this is milliseconds, not minutes.
+# Exits with the analyzer's status: 0 clean, 1 findings, 2 internal error.
+# The default AST stage never imports jax; the --trace stage does — it is
+# pinned to the CPU backend so the analyzer can never grab (or wait on)
+# a TPU.
 set -uo pipefail
 cd "$(dirname "$0")/.."
+for a in "$@"; do
+    if [ "$a" = "--trace" ]; then
+        export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+        break
+    fi
+done
 exec python -m raft_stereo_tpu.analysis "$@"

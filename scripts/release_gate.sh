@@ -20,6 +20,21 @@ step() { echo; echo "== $* =="; }
 step "graftlint (zero unsuppressed findings)"
 bash scripts/lint.sh || { echo "FAIL: graftlint"; fail=1; }
 
+# graftverify second (DESIGN.md "Trace-level analysis (r10)"): traces the
+# real entry points at headline geometry on CPU (~40 s, no TPU touched)
+# and proves the jaxpr/HLO-level invariants the AST stage can only grep
+# for — rung non-vacuity, knob/cache-key agreement, dtype discipline,
+# donation. The merged graftlint+graftverify JSON report is the release
+# artifact; on failure it is echoed so the findings are in the log.
+step "graftverify (trace-level invariants, merged JSON artifact)"
+if env JAX_PLATFORMS=cpu python -m raft_stereo_tpu.analysis --trace --json \
+        > analysis_report.json; then
+    echo "ok: analysis_report.json written"
+else
+    cat analysis_report.json
+    echo "FAIL: graftverify"; fail=1
+fi
+
 step "tier-1 suite"
 bash scripts/run_tier1.sh || { echo "FAIL: tier-1"; fail=1; }
 

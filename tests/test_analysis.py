@@ -259,14 +259,53 @@ def test_gl002_registered_knob_ok(tmp_path):
     assert rep.ok
 
 
-def test_gl002_outside_forward_dirs_ignored(tmp_path):
+def test_gl002_host_module_unregistered_fires(tmp_path):
+    # Widened scan (r10): a RAFT_* read in serve/ or native/ must appear
+    # in SOME registry — previously invisible to lint.
     rep = lint(tmp_path, {"serve/k.py": """
         import os
 
         def f():
             return os.environ.get("RAFT_WHATEVER", "1")
-    """}, knobs=())
+    """}, knobs=(), serve_knobs=())
+    assert codes(rep) == ["GL002"]
+    assert "host/serving module" in rep.findings[0].message
+
+
+def test_gl002_host_module_any_registry_ok(tmp_path):
+    src = {"native/k.py": """
+        import os
+
+        def f():
+            return os.environ.get("RAFT_PIPE", "1")
+    """}
+    # serve/native reads may live in the host/serving registries...
+    assert lint(tmp_path, dict(src), knobs=(),
+                serve_knobs=("RAFT_PIPE",)).ok
+    # ...or in ENV_KNOBS (a forward knob legitimately read from serve/).
+    assert lint(tmp_path, dict(src), knobs=("RAFT_PIPE",),
+                serve_knobs=()).ok
+
+
+def test_gl002_outside_scanned_dirs_ignored(tmp_path):
+    rep = lint(tmp_path, {"data/k.py": """
+        import os
+
+        def f():
+            return os.environ.get("RAFT_WHATEVER", "1")
+    """}, knobs=(), serve_knobs=())
     assert rep.ok
+
+
+def test_gl002_real_tree_native_knob_registered():
+    # RAFT_NATIVE (native/__init__.py) is covered by HOST_ENV_KNOBS; drop
+    # it from the host registries and GL002 must fire at the read site —
+    # the widened scan provably sees native/.
+    files = collect_files([str(PACKAGE)], base=str(REPO))
+    rep = run_checkers(Project(files, serve_knobs=knobs.SERVE_ENV_KNOBS))
+    hits = [f for f in rep.findings if f.code == "GL002"]
+    assert hits and "RAFT_NATIVE" in hits[0].message
+    assert hits[0].path.endswith("native/__init__.py")
 
 
 def test_gl002_real_tree_dropped_knob_fails():

@@ -731,3 +731,73 @@ def test_unpad_np_matches_unpad(rng):
     assert b.shape == x.shape
     assert bucket_shape((40, 60), 32) == (64, 64)
     assert bucket_shape((64, 64), 64) == (64, 64)
+
+
+# ---------------------------------------------------------------------------
+# Failure-classification table (guard.py KERNEL_FAILURE_MARKERS)
+# ---------------------------------------------------------------------------
+
+def test_kernel_failure_marker_table():
+    """Every marker in the named table classifies, with a category and a
+    note (the table replaced an anonymous inline tuple — each entry must
+    say what it matches and why it is specific enough to trust)."""
+    from raft_stereo_tpu.serve.guard import (KERNEL_FAILURE_MARKERS,
+                                             is_kernel_failure,
+                                             match_failure_marker)
+    assert len(KERNEL_FAILURE_MARKERS) >= 6
+    for marker in KERNEL_FAILURE_MARKERS:
+        assert marker.substring == marker.substring.lower()
+        assert marker.category in ("oom", "kernel_compiler", "xla_runtime")
+        assert marker.note
+        exc = RuntimeError(f"prefix {marker.substring.upper()} suffix")
+        assert match_failure_marker(exc) is marker
+        assert is_kernel_failure(exc)
+    # Non-kernel exceptions must propagate, not walk the ladder.
+    for benign in (ValueError("bad argument"), KeyError("missing"),
+                   RuntimeError("deadline blown")):
+        assert match_failure_marker(benign) is None
+        assert not is_kernel_failure(benign)
+
+
+def test_kernel_failure_by_type_name():
+    from raft_stereo_tpu.serve.guard import is_kernel_failure
+
+    class XlaRuntimeError(Exception):
+        pass
+
+    assert is_kernel_failure(XlaRuntimeError("totally generic message"))
+
+
+def test_unclassified_kernel_failure_falls_to_next_in_order():
+    """Regression for the classification contract: a kernel failure whose
+    message matches NO rung matchers must fall through to the first
+    untripped rung in ladder order — and after that rung trips, the same
+    generic failure targets the NEXT rung, never re-trips the dark one."""
+    from raft_stereo_tpu.serve.guard import KernelCircuitBreaker
+
+    class XlaRuntimeError(Exception):
+        pass
+
+    breaker = KernelCircuitBreaker()
+    generic = XlaRuntimeError("INTERNAL: something nonspecific happened")
+    order = [p.name for p in breaker.ladder]
+    walked = []
+    for _ in order:
+        path = breaker.classify(generic)
+        walked.append(path.name)
+        breaker.trip(path.name, "runtime_failure", generic)
+    assert walked == order
+    assert breaker.classify(generic) is None  # exhausted
+    assert breaker.exhausted
+
+
+def test_matcher_beats_ladder_order_until_tripped():
+    """A message matching a deep rung's matchers trips THAT rung first;
+    once it is dark, the same message falls back to next-in-order."""
+    from raft_stereo_tpu.serve.guard import KernelCircuitBreaker
+    breaker = KernelCircuitBreaker()
+    exc = RuntimeError("mosaic verify failed in pallas_reg gather_lerp")
+    assert breaker.classify(exc).name == "corr_kernel"
+    breaker.trip("corr_kernel", "compile_failure", exc)
+    # corr_kernel is dark -> first untripped in ladder order
+    assert breaker.classify(exc).name == breaker.ladder[0].name

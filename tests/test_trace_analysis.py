@@ -1,0 +1,243 @@
+"""graftverify battery: per-GV-checker poisoned-fixture vacuity guards
+(each checker must FIRE on its poison — the GL006 lesson, applied to the
+tracer), the clean-tree gates, the headline ladder non-vacuity proof, and
+the CLI / lint.sh wiring.
+
+Everything traces on CPU via eval_shape/make_jaxpr/.lower() — no
+execution, no TPU. The poisoned fixtures live in tests/trace_fixtures/
+and are driven through the REAL CLI entry (``--trace-registry``), so the
+exit-code contract (0 clean / 1 findings / 2 internal) is what is pinned.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from raft_stereo_tpu.analysis.cli import main as cli_main
+from raft_stereo_tpu.analysis.knobs import ENV_KNOBS
+from raft_stereo_tpu.analysis.trace import (TraceRegistry, default_registry,
+                                            run_trace_analysis)
+from raft_stereo_tpu.analysis.trace.checkers.gv102_ladder_vacuity import \
+    LadderVacuityChecker
+
+pytestmark = pytest.mark.trace_lint
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = Path(__file__).resolve().parent / "trace_fixtures"
+
+POISONS = [
+    ("gv101_upcast.py", "GV101"),
+    ("gv102_noop_rung.py", "GV102"),
+    ("gv103_debug_print.py", "GV103"),
+    ("gv104_big_const.py", "GV104"),
+    ("gv105_no_donation.py", "GV105"),
+]
+
+
+def _load_fixture(name):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        f"_gvfix_{name[:-3]}", str(FIXTURES / name))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.build_registry()
+
+
+# ---------------------------------------------------------------------------
+# Poisoned-fixture vacuity guards: every checker fires, through the CLI
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("fixture,code", POISONS)
+def test_poisoned_fixture_exits_one(fixture, code, capsys):
+    rc = cli_main(["--trace", "--trace-registry",
+                   str(FIXTURES / fixture), "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    found = {f["code"] for f in payload["findings"]}
+    assert rc == 1
+    assert code in found, (fixture, found)
+    # The poison must fire the CHECKER, not crash the tracer.
+    assert "GV000" not in found, payload["findings"]
+
+
+def test_gv102_fixture_fires_both_flavors():
+    rep = run_trace_analysis(_load_fixture("gv102_noop_rung.py"),
+                             checkers=[LadderVacuityChecker()])
+    msgs = sorted(f.message for f in rep.findings)
+    assert len(msgs) == 2
+    assert "IDENTICAL" in msgs[0] or "IDENTICAL" in msgs[1]  # vacuous rung
+    assert any("stale-program" in m for m in msgs)           # key gap
+
+
+def test_gv105_fixture_names_missing_leaves():
+    rep = run_trace_analysis(_load_fixture("gv105_no_donation.py"))
+    hits = [f for f in rep.findings if f.code == "GV105"]
+    assert len(hits) == 1
+    assert "2 of 2 donated" in hits[0].message
+
+
+# ---------------------------------------------------------------------------
+# Registry/suppression contract
+# ---------------------------------------------------------------------------
+
+def test_registry_suppression_with_reason(capsys):
+    reg = _load_fixture("gv104_big_const.py")
+    reg.suppressions[("GV104", "fixture/big_const")] = \
+        "fixture: measured and accepted"
+    rep = run_trace_analysis(reg)
+    assert rep.ok
+    assert [f.code for f in rep.suppressed] == ["GV104"]
+    assert rep.suppressed[0].suppress_reason == \
+        "fixture: measured and accepted"
+
+
+@pytest.mark.parametrize("blank", ["", "   "])
+def test_registry_reasonless_suppression_is_gv000(blank):
+    reg = _load_fixture("gv104_big_const.py")
+    reg.suppressions[("GV104", "fixture/big_const")] = blank
+    rep = run_trace_analysis(reg)
+    codes = sorted(f.code for f in rep.findings)
+    assert codes == ["GV000", "GV104"]  # can't hide itself
+
+
+def test_dead_entry_is_gv000_not_clean():
+    from raft_stereo_tpu.analysis.trace.registry import TraceEntry
+
+    def build():
+        raise RuntimeError("entry builder exploded")
+    reg = TraceRegistry(geometry="fixture",
+                        entries=[TraceEntry(name="fixture/dead",
+                                            build=build, env={})],
+                        ladder_variants=[], knob_flips=[])
+    rep = run_trace_analysis(reg)
+    assert [f.code for f in rep.findings] == ["GV000"]
+    assert "entry builder exploded" in rep.findings[0].message
+
+
+def test_select_filter_keeps_gv000():
+    reg = _load_fixture("gv104_big_const.py")
+    rep = run_trace_analysis(reg, select=("GV103",))
+    assert rep.findings == []  # GV104 filtered away by --select
+    from raft_stereo_tpu.analysis.trace.registry import TraceEntry
+
+    def build():
+        raise RuntimeError("boom")
+    dead = TraceRegistry(geometry="fixture",
+                         entries=[TraceEntry(name="fixture/dead",
+                                             build=build, env={})],
+                         ladder_variants=[], knob_flips=[])
+    rep = run_trace_analysis(dead, select=("GV103",))
+    assert [f.code for f in rep.findings] == ["GV000"]  # never filterable
+
+
+# ---------------------------------------------------------------------------
+# Clean-tree gates + vacuity guards on the REAL registry
+# ---------------------------------------------------------------------------
+
+def test_clean_tree_small_geometry_resolves_all_entries():
+    """The analyzer must resolve (build AND trace) every real entry point
+    — a refactor that renames raft_stereo_prepare or reshapes the carry
+    must blind graftverify loudly, not silently."""
+    rep = run_trace_analysis(default_registry("small"))
+    assert rep.findings == [], "\n".join(f.render() for f in rep.findings)
+    assert rep.entries_traced >= 5
+
+
+def test_headline_registry_structure():
+    """Registry vacuity guard: the headline registry must carry the full
+    ladder walk (6 rungs + untripped) and one flip probe per registered
+    env knob — a knob added to ENV_KNOBS without a probe surfaces as a
+    GV102 finding rather than silent shrinkage, and this pins the
+    expected counts so the extraction itself can't rot."""
+    reg = default_registry("headline")
+    names = {e.name for e in reg.entries}
+    assert {"serve/full", "serve/prepare", "serve/segment", "serve/advance",
+            "serve/epilogue", "eval/forward", "train/step"} <= names
+    assert len(reg.ladder_variants) == 7  # untripped + 6 rungs
+    from raft_stereo_tpu.serve.guard import DEFAULT_LADDER
+    assert [label for label, _ in reg.ladder_variants[1:]] == \
+        [p.name for p in DEFAULT_LADDER]
+    assert len(reg.knob_flips) == len(ENV_KNOBS)
+    assert all(kf.flipped is not None for kf in reg.knob_flips), \
+        "every registered knob needs a KNOB_FLIP_PROBES entry"
+    # Every flip must already differ in cache key (fingerprint covers
+    # ENV_KNOBS); GV102's trace proves the program side.
+    assert all(kf.base_key != kf.flipped_key for kf in reg.knob_flips)
+
+
+def test_headline_ladder_pairwise_non_vacuous():
+    """The acceptance proof, in-process: all six breaker rungs produce
+    pairwise-different programs at headline geometry (the full CLI run
+    additionally proves the knob side; release_gate.sh runs it)."""
+    reg = default_registry("headline")
+    trimmed = TraceRegistry(geometry="headline", entries=[],
+                            ladder_variants=reg.ladder_variants,
+                            knob_flips=[])
+    rep = run_trace_analysis(trimmed, checkers=[LadderVacuityChecker()])
+    assert rep.findings == [], "\n".join(f.render() for f in rep.findings)
+    assert rep.entries_traced == 7
+
+
+def test_scrubbed_text_is_deterministic():
+    import jax
+
+    from raft_stereo_tpu.analysis.trace.jaxprs import scrubbed_text
+    reg = default_registry("small")
+    epi = next(e for e in reg.entries if e.name == "serve/epilogue")
+    from raft_stereo_tpu.serve.session import _env_overrides
+    with _env_overrides(dict(epi.env)):
+        fn, args = epi.build()
+        t1 = scrubbed_text(jax.make_jaxpr(fn)(*args))
+        t2 = scrubbed_text(jax.make_jaxpr(fn)(*args))
+    assert t1 == t2
+    assert "0x7" not in t1  # addresses actually scrubbed
+
+
+# ---------------------------------------------------------------------------
+# CLI / scripts wiring
+# ---------------------------------------------------------------------------
+
+def test_cli_trace_registry_missing_is_internal_error(capsys):
+    rc = cli_main(["--trace", "--trace-registry",
+                   str(FIXTURES / "does_not_exist.py")])
+    capsys.readouterr()
+    assert rc == 2  # an internal error must never read as "clean"
+
+
+def test_cli_trace_options_require_trace(capsys):
+    # A poisoned registry passed WITHOUT --trace must not silently skip
+    # the trace stage and exit 0 — that would read as clean.
+    rc = cli_main(["--trace-registry",
+                   str(FIXTURES / "gv103_debug_print.py")])
+    capsys.readouterr()
+    assert rc == 2
+    rc = cli_main(["--trace-geometry", "small"])
+    capsys.readouterr()
+    assert rc == 2
+
+
+def test_lint_sh_trace_stage_fails_on_poison():
+    res = subprocess.run(
+        ["bash", "scripts/lint.sh", "--trace", "--trace-registry",
+         str(FIXTURES / "gv103_debug_print.py")],
+        cwd=str(REPO), capture_output=True, text=True)
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert "GV103" in res.stdout
+
+
+def test_release_gate_runs_graftverify_step():
+    gate = (REPO / "scripts" / "release_gate.sh").read_text()
+    assert "--trace --json" in gate
+    assert "analysis_report.json" in gate
+    # graftverify must run BEFORE the tier-1 suite (cheap gates first).
+    assert gate.index('step "graftverify') < gate.index('step "tier-1')
+
+
+def test_cli_list_checkers_includes_gv(capsys):
+    rc = cli_main(["--list-checkers"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    for code in ("GV101", "GV102", "GV103", "GV104", "GV105"):
+        assert code in out

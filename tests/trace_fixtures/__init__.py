@@ -1,0 +1,4 @@
+# Poisoned trace-registry fixtures for the graftverify vacuity guards:
+# each <code>_*.py defines build_registry() returning a registry on which
+# exactly that GV checker must fire (tests/test_trace_analysis.py drives
+# them through the real CLI via --trace-registry).

@@ -1,0 +1,27 @@
+"""Poisoned registry: a scan body silently upcasts its bf16 hidden state
+to fp32, computes, and downcasts back — the exact shape of "this layer
+quietly runs in fp32 every iteration". GV101 must fire: the upcast
+neither reaches an fp32 carry nor feeds a reduction."""
+
+from raft_stereo_tpu.analysis.trace.registry import TraceEntry, TraceRegistry
+
+
+def build_registry():
+    def build():
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        def fn(x):
+            def step(h, _):
+                h32 = h.astype(jnp.float32)      # the poisoned upcast
+                h = (h32 * 1.5).astype(jnp.bfloat16)
+                return h, None
+            h, _ = lax.scan(step, x, None, length=4)
+            return h
+        return fn, (jax.ShapeDtypeStruct((64, 64, 16), jnp.bfloat16),)
+
+    entry = TraceEntry(name="fixture/upcast", build=build, env={},
+                       hot_path="serve", mixed_precision=True)
+    return TraceRegistry(geometry="fixture", entries=[entry],
+                         ladder_variants=[], knob_flips=[])

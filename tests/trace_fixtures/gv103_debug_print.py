@@ -1,0 +1,25 @@
+"""Poisoned registry: a hot-path program with a ``jax.debug.print`` left
+in the scan body — a device->host round trip per iteration. GV103 must
+fire."""
+
+from raft_stereo_tpu.analysis.trace.registry import TraceEntry, TraceRegistry
+
+
+def build_registry():
+    def build():
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        def fn(x):
+            def step(h, _):
+                jax.debug.print("h sum = {}", h.sum())
+                return h * 1.5, None
+            h, _ = lax.scan(step, x, None, length=2)
+            return h
+        return fn, (jax.ShapeDtypeStruct((8, 8), jnp.float32),)
+
+    entry = TraceEntry(name="fixture/debug_print", build=build, env={},
+                       hot_path="serve")
+    return TraceRegistry(geometry="fixture", entries=[entry],
+                         ladder_variants=[], knob_flips=[])

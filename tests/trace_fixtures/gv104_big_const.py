@@ -1,0 +1,24 @@
+"""Poisoned registry: a closure-captured 4 MiB concrete array baked into
+the program as a jaxpr constant (the "oversized closure constant" class —
+should have been an argument). GV104 must fire at the default 2 MiB
+threshold."""
+
+from raft_stereo_tpu.analysis.trace.registry import TraceEntry, TraceRegistry
+
+
+def build_registry():
+    def build():
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        baked = np.ones((1024, 1024), np.float32)  # 4 MiB closure capture
+
+        def fn(x):
+            return x + jnp.asarray(baked)
+        return fn, (jax.ShapeDtypeStruct((1024, 1024), jnp.float32),)
+
+    entry = TraceEntry(name="fixture/big_const", build=build, env={},
+                       hot_path="serve")
+    return TraceRegistry(geometry="fixture", entries=[entry],
+                         ladder_variants=[], knob_flips=[])

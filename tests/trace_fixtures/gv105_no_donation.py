@@ -1,0 +1,39 @@
+"""Poisoned registry: a train-step-shaped program whose jit lost its
+``donate_argnums`` — the lowered module aliases nothing, peak HBM holds
+params twice. GV105 must fire on every non-scalar donated leaf."""
+
+from raft_stereo_tpu.analysis.trace.registry import TraceEntry, TraceRegistry
+
+
+def _pieces():
+    import jax
+    import jax.numpy as jnp
+
+    def step(params, batch):
+        new = jax.tree_util.tree_map(lambda a: a * 0.99, params)
+        return new, batch.sum()
+
+    pspec = {"w": jax.ShapeDtypeStruct((64, 64), jnp.float32),
+             "b": jax.ShapeDtypeStruct((64,), jnp.float32)}
+    bspec = jax.ShapeDtypeStruct((8, 64), jnp.float32)
+    # The poison: donate_argnums deleted — jax.jit(step) instead of
+    # jax.jit(step, donate_argnums=(0,)).
+    return jax.jit(step), pspec, bspec
+
+
+def build_registry():
+    def build():
+        step, pspec, bspec = _pieces()
+        return step, (pspec, bspec)
+
+    def build_lowered():
+        import jax
+        step, pspec, bspec = _pieces()
+        leaves = jax.tree_util.tree_flatten_with_path((pspec,))[0]
+        return (step.lower(pspec, bspec).as_text(),
+                [(jax.tree_util.keystr(p), v) for p, v in leaves])
+
+    entry = TraceEntry(name="fixture/train_no_donate", build=build, env={},
+                       hot_path="train", build_lowered=build_lowered)
+    return TraceRegistry(geometry="fixture", entries=[entry],
+                         ladder_variants=[], knob_flips=[])
