@@ -340,7 +340,7 @@ def main() -> None:
     dispatch_s = device_s if device_s else elapsed / n_frames
     mfu = (flops / dispatch_s / peak) if (flops and peak) else None
 
-    print(json.dumps({
+    doc = {
         "metric": (f"middlebury_F_disparity_fps_per_chip_{iters}iters_"
                    f"{h}x{w}_{corr}_{'bf16' if mixed else 'fp32'}"
                    + (f"_batch{batch}" if batch > 1 else "")),
@@ -352,7 +352,17 @@ def main() -> None:
         "device_s": round(device_s, 4) if device_s else None,
         "flops": flops,
         "mfu": round(mfu, 4) if mfu else None,
-    }))
+    }
+    print(json.dumps(doc))
+
+    # Perf-trajectory gate (DESIGN.md r11): when RAFT_TRAJECTORY is
+    # exported (the release gate does), the headline fps lands in the
+    # consolidated TRAJECTORY.json next to requests/s and steps/s, where
+    # the per-metric pinned bands catch a regression in ANY of them.
+    from raft_stereo_tpu.obs.trajectory import emit
+    emit(doc["metric"], fps, "frames/s",
+         backend=jax.default_backend(), source="bench.py",
+         extra={"mfu": doc["mfu"], "device_s": doc["device_s"]})
 
 
 if __name__ == "__main__":

@@ -9,12 +9,14 @@ missing from the registry is the stale-program class the session can only
 runtime-check for ladder rungs: two requests under different switch
 values would silently share one compiled program.
 
-The scan also covers ``serve/`` and ``native/`` (widened in r10): a
-``RAFT_*`` read there is host/serving behavior rather than program shape,
-so it may live in ANY registry (``ENV_KNOBS``, ``SERVE_ENV_KNOBS`` or
-``HOST_ENV_KNOBS``) — but it must live somewhere.  Before the widening, a
-new env read in serve/ (e.g. ``RAFT_NATIVE``-style pipeline switches) was
-simply invisible to lint and the flag matrix drifted.
+The scan also covers ``serve/`` and ``native/`` (widened in r10) and
+``obs/`` (r11): a ``RAFT_*`` read there is host/serving behavior rather
+than program shape, so it may live in ANY registry (``ENV_KNOBS``,
+``SERVE_ENV_KNOBS`` or ``HOST_ENV_KNOBS``) — but it must live somewhere.
+Before the widening, a new env read in serve/ (e.g. ``RAFT_NATIVE``-style
+pipeline switches) was simply invisible to lint and the flag matrix
+drifted; the r11 telemetry knobs (``RAFT_TRACE``/``RAFT_PROFILE_DIR``/
+``RAFT_TRAJECTORY``) are covered from birth.
 """
 
 from __future__ import annotations
@@ -32,7 +34,7 @@ FORWARD_DIRS = ("models", "ops", "corr")
 #: Path segments whose RAFT_* reads are host/serving behavior: they must
 #: appear in SOME registry (ENV_KNOBS counts too — a forward knob read
 #: from serve/ is legal) so the flag matrix has one home.
-HOST_DIRS = ("serve", "native")
+HOST_DIRS = ("serve", "native", "obs")
 
 
 def is_forward_module(relpath: str) -> bool:

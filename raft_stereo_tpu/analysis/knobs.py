@@ -55,15 +55,28 @@ SERVE_ENV_KNOBS: Tuple[str, ...] = (
 )
 
 # Host-pipeline env knobs: they steer HOST code (the data loader's native
-# photometric kernels) and can never reach a trace, so they belong in
-# neither ENV_KNOBS (no compiled program depends on them) nor
-# SERVE_ENV_KNOBS (they are not serving behavior). Registered so GL002's
-# widened scan (native/, serve/) has an answer for every RAFT_* read and a
-# NEW host knob must be deliberately placed here rather than silently
-# invisible to lint.
+# photometric kernels, the graftscope telemetry sinks) and can never reach
+# a trace, so they belong in neither ENV_KNOBS (no compiled program
+# depends on them) nor SERVE_ENV_KNOBS (they are not serving behavior).
+# Registered so GL002's widened scan (native/, serve/, obs/) has an answer
+# for every RAFT_* read and a NEW host knob must be deliberately placed
+# here rather than silently invisible to lint.
+#
+# The obs/ knobs stay OUT of the program fingerprint for the same reason
+# RAFT_SCHED_TICK_MS does: each selects where host-side telemetry is
+# WRITTEN (a JSONL sink path, a profiler dump dir, the trajectory
+# artifact), read once at object construction, and no compiled program's
+# bytes depend on any of them — fingerprinting them would recompile every
+# cached program just because an operator turned tracing on.
 HOST_ENV_KNOBS: Tuple[str, ...] = (
     "RAFT_NATIVE",          # force the numpy photometric path
                             # (native/__init__.py:lib, read at first use)
+    "RAFT_TRACE",           # request-trace JSONL sink path
+                            # (obs/tracing.py Tracer, read at construction)
+    "RAFT_PROFILE_DIR",     # on-demand jax.profiler window output dir
+                            # (obs/profiler.py, read at construction)
+    "RAFT_TRAJECTORY",      # perf-trajectory artifact the benches emit
+                            # into (obs/trajectory.py emit(), read per call)
 )
 
 

@@ -45,9 +45,26 @@ class _JsonlWriter:
 
 
 class Logger:
-    def __init__(self, log_dir: str = "runs", scheduler=None):
+    def __init__(self, log_dir: str = "runs", scheduler=None,
+                 registry=None):
         self.log_dir = log_dir
         self.scheduler = scheduler
+        # graftscope (obs/metrics.py): when a MetricsRegistry is attached,
+        # every push also lands in raft_train_* series — a steps counter,
+        # a bounded step-wall-time histogram (the steps/s behind the
+        # trajectory gate's training metric) and a last-value gauge per
+        # scalar — and close() writes the Prometheus snapshot next to the
+        # TensorBoard events (<log_dir>/metrics.prom), so a training run's
+        # telemetry has the same shape as the serving stack's /metrics.
+        self.registry = registry
+        self._last_push_t = None
+        if registry is not None:
+            self._m_steps = registry.counter(
+                "raft_train_steps_total", "train-loop steps pushed")
+            self._step_hist = registry.histogram(
+                "raft_train_step_seconds",
+                "wall time between metric pushes (bounded reservoir)",
+                reservoir=512)
         self.total_steps = 0
         # Steps whose update was skipped (non-finite grads) don't advance
         # the optimizer's schedule position; the train loop keeps this at
@@ -83,6 +100,17 @@ class Logger:
 
     def push(self, metrics: Dict[str, float]):
         self.total_steps += 1
+        if self.registry is not None:
+            import time
+            now = time.monotonic()
+            self._m_steps.inc()
+            if self._last_push_t is not None:
+                self._step_hist.observe(now - self._last_push_t)
+            self._last_push_t = now
+            for key, value in metrics.items():
+                self.registry.gauge(
+                    "raft_train_metric", "last pushed train scalar",
+                    key=key).set(float(value))
         for key, value in metrics.items():
             self.running_loss[key] = self.running_loss.get(key, 0.0) + float(value)
             self.running_count[key] = self.running_count.get(key, 0) + 1
@@ -101,5 +129,13 @@ class Logger:
             writer.add_scalar(key, value, self.total_steps)
 
     def close(self):
+        if self.registry is not None and self.total_steps:
+            try:
+                os.makedirs(self.log_dir, exist_ok=True)
+                with open(os.path.join(self.log_dir, "metrics.prom"),
+                          "w") as f:
+                    f.write(self.registry.render_prometheus())
+            except OSError:  # telemetry must never kill a finished run
+                logger.exception("could not write metrics.prom")
         if self.writer is not None:
             self.writer.close()

@@ -234,7 +234,11 @@ def train(cfg: RAFTStereoConfig, tcfg: TrainConfig,
     train_loader = fetch_dataloader(tcfg, root=data_root,
                                     local_rows=local_rows)
     train_step = make_train_step(cfg, tx, tcfg.train_iters, mesh=mesh)
-    log = Logger(scheduler=schedule) if is_lead else _NullLogger()
+    if is_lead:
+        from raft_stereo_tpu.obs.metrics import MetricsRegistry
+        log = Logger(scheduler=schedule, registry=MetricsRegistry())
+    else:
+        log = _NullLogger()
     log.total_steps = start_step
 
     if faults is not None:

@@ -1,0 +1,31 @@
+"""graftscope — unified telemetry for the serving/training stack.
+
+Four pieces, one contract (DESIGN.md "Observability (r11)"):
+
+- :mod:`~raft_stereo_tpu.obs.metrics` — the metrics registry
+  (counters / gauges / bounded reservoir histograms) that is the single
+  truth behind ``/healthz`` and the Prometheus-text ``/metrics`` view;
+- :mod:`~raft_stereo_tpu.obs.tracing` — per-request span timelines
+  (trace id at admission; host-side spans at program boundaries only),
+  ring-buffered and optionally JSONL-sunk via ``RAFT_TRACE``;
+- :mod:`~raft_stereo_tpu.obs.profiler` — on-demand ``jax.profiler``
+  windows (``RAFT_PROFILE_DIR``);
+- :mod:`~raft_stereo_tpu.obs.trajectory` — the consolidated
+  perf-trajectory gate (``TRAJECTORY.json`` + pinned bands) folding
+  fps/chip, requests/s and steps/s into one release-gate verdict.
+
+Import-light: nothing here imports jax at module scope (the registry and
+trajectory tooling run in the linter's jax-free environment).
+"""
+
+from raft_stereo_tpu.obs.metrics import (Counter, Gauge, Histogram,
+                                         MetricsRegistry)
+from raft_stereo_tpu.obs.profiler import ProfilerWindow
+from raft_stereo_tpu.obs.tracing import (NULL_TRACE, RequestTrace, Span,
+                                         Tracer)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "ProfilerWindow",
+    "NULL_TRACE", "RequestTrace", "Span", "Tracer",
+]

@@ -1,0 +1,92 @@
+"""On-demand ``jax.profiler`` windows for a live serving process.
+
+``bench.py`` already wraps one frame in ``jax.profiler.trace`` for
+offline attribution; a server needs the same capture **on demand**,
+against live traffic, without a restart.  :class:`ProfilerWindow` wraps
+``jax.profiler.start_trace``/``stop_trace`` behind a guarded toggle:
+
+- the output directory comes from ``RAFT_PROFILE_DIR`` (read once, at
+  construction — never at import time) or an explicit argument; with
+  neither, the window is **disabled** and ``start()`` is a recorded
+  no-op — an operator can always poke the endpoint safely;
+- windows are serialized (``start`` while active is refused), counted,
+  and visible in /healthz via ``status()``.
+
+The profiler captures device activity for everything the process runs
+during the window, so one window around N requests gives the op-level
+device timeline that the host-side span traces (obs/tracing.py)
+deliberately do not claim to know.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from typing import Dict, Optional
+
+
+class ProfilerWindow:
+    def __init__(self, out_dir: Optional[str] = None):
+        if out_dir is None:
+            out_dir = os.environ.get("RAFT_PROFILE_DIR") or None
+        self.out_dir = out_dir
+        self._active = False
+        self._windows = 0
+        self._refused = 0
+        self._lock = threading.Lock()
+
+    @property
+    def enabled(self) -> bool:
+        return self.out_dir is not None
+
+    def start(self) -> bool:
+        """Open a capture window. Returns False (and counts the refusal)
+        when disabled or already active — never raises at the operator."""
+        with self._lock:
+            if self.out_dir is None or self._active:
+                self._refused += 1
+                return False
+            self._active = True
+        import jax
+        try:
+            jax.profiler.start_trace(self.out_dir)
+        except Exception:
+            with self._lock:
+                self._active = False
+            raise
+        return True
+
+    def stop(self) -> Optional[str]:
+        """Close the window; returns the output dir (None if no window
+        was open). The stop is CLAIMED under the lock (flag cleared
+        before the profiler call) so two racing stop() calls cannot both
+        reach ``jax.profiler.stop_trace`` — the loser returns None."""
+        with self._lock:
+            if not self._active:
+                return None
+            self._active = False
+        import jax
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            with self._lock:
+                self._windows += 1
+        return self.out_dir
+
+    @contextlib.contextmanager
+    def window(self):
+        opened = self.start()
+        try:
+            yield opened
+        finally:
+            if opened:
+                self.stop()
+
+    def status(self) -> Dict:
+        with self._lock:
+            return {"enabled": self.out_dir is not None,
+                    "dir": self.out_dir,
+                    "active": self._active,
+                    "windows": self._windows,
+                    "refused": self._refused}

@@ -31,6 +31,7 @@ from typing import Tuple
 
 import numpy as np
 
+from raft_stereo_tpu.obs.tracing import NULL_TRACE
 from raft_stereo_tpu.ops.padder import InputPadder
 
 # Predicted-time inflation: stop one segment EARLY when the prediction is
@@ -83,7 +84,8 @@ def warm_segmented_half(session, half_zeros: np.ndarray) -> None:
 
 
 def _run_segmented(session, padder: InputPadder, left: np.ndarray,
-                   right: np.ndarray, deadline: float) -> Outcome:
+                   right: np.ndarray, deadline: float,
+                   trace=NULL_TRACE) -> Outcome:
     """Full-resolution anytime loop: prepare, then segments until done or
     out of budget. The first segment always runs."""
     segments, m = _segment_plan(session)
@@ -91,7 +93,7 @@ def _run_segmented(session, padder: InputPadder, left: np.ndarray,
     lp, rp = padder.pad_np(left, right)
 
     prep = session.get_program("prepare", ph, pw, 0)
-    (state,) = session.invoke(prep, lp, rp)
+    (state,) = session.invoke(prep, lp, rp, trace=trace)
     seg = session.get_program("segment", ph, pw, m)
 
     flow = None
@@ -101,10 +103,14 @@ def _run_segmented(session, padder: InputPadder, left: np.ndarray,
             est = session.estimate(seg.key)
             now = session.clock.now()
             if now >= deadline:
+                trace.event("degrade", label=f"reduced_iters:{done}",
+                            reason="deadline_expired")
                 break
             if est is not None and now + est * SAFETY > deadline:
+                trace.event("degrade", label=f"reduced_iters:{done}",
+                            reason="predicted_overshoot")
                 break
-        state, flow, _checksum = session.invoke(seg, state)
+        state, flow, _checksum = session.invoke(seg, state, trace=trace)
         done += m
     missed = session.clock.now() > deadline
     quality = "full" if done == session.cfg.valid_iters \
@@ -161,16 +167,21 @@ def _half_res_viable(session, padder: InputPadder, deadline: float) -> bool:
 
 def run_with_deadline(session, padder: InputPadder, left: np.ndarray,
                       right: np.ndarray, deadline: float, *,
-                      allow_half_res: bool = True) -> Outcome:
+                      allow_half_res: bool = True,
+                      trace=NULL_TRACE) -> Outcome:
     """The degrade policy: full-res segmented scan, or half-res when the
     budget provably cannot fit one full-res segment."""
     if allow_half_res and _half_res_viable(session, padder, deadline):
+        trace.event("degrade", label="half_res",
+                    reason="budget_below_one_full_res_segment")
         orig_h, orig_w = left.shape[1], left.shape[2]
         left_h = _downscale_half(left)
         right_h = _downscale_half(right)
         half_padder = session.padder_for(left_h.shape)
-        out = _run_segmented(session, half_padder, left_h, right_h, deadline)
+        out = _run_segmented(session, half_padder, left_h, right_h,
+                             deadline, trace=trace)
         flow_half = half_padder.unpad_np(out.flow_padded)
         flow = _restore_half(flow_half, orig_h, orig_w)
         return Outcome(flow, "half_res", out.iters, out.deadline_missed)
-    return _run_segmented(session, padder, left, right, deadline)
+    return _run_segmented(session, padder, left, right, deadline,
+                          trace=trace)

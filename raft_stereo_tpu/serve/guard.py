@@ -199,7 +199,12 @@ class KernelCircuitBreaker:
     moment they happen.
     """
 
-    def __init__(self, ladder: Tuple[FastPath, ...] = DEFAULT_LADDER):
+    def __init__(self, ladder: Tuple[FastPath, ...] = DEFAULT_LADDER,
+                 registry=None):
+        # obs/metrics.py registry (optional): when bound, every trip also
+        # increments raft_breaker_trips_total{rung,reason} so /metrics
+        # carries the ladder walk without a second bookkeeping path.
+        self._registry = registry
         self.ladder = tuple(ladder)
         self._by_name = {p.name: p for p in self.ladder}
         if len(self._by_name) != len(self.ladder):
@@ -217,6 +222,13 @@ class KernelCircuitBreaker:
                     "untripped programs key on it too", p.name, p.env_var)
         self._tripped: Dict[str, TripRecord] = {}
         self._lock = threading.Lock()
+
+    def bind_registry(self, registry) -> None:
+        """Attach a metrics registry (first bind wins — a breaker shared
+        between sessions keeps reporting into the store it started
+        with)."""
+        if self._registry is None:
+            self._registry = registry
 
     # -- state ------------------------------------------------------------
 
@@ -263,6 +275,11 @@ class KernelCircuitBreaker:
              error: Optional[BaseException] = None) -> TripRecord:
         if name not in self._by_name:
             raise KeyError(f"unknown fast path {name!r}")
+        if self._registry is not None:
+            self._registry.counter(
+                "raft_breaker_trips_total",
+                "circuit-breaker trips by rung and reason",
+                rung=name, reason=reason).inc()
         with self._lock:
             rec = self._tripped.get(name)
             if rec is None:

@@ -45,6 +45,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from raft_stereo_tpu.obs.tracing import NULL_TRACE
 from raft_stereo_tpu.serve.degrade import SAFETY
 from raft_stereo_tpu.serve.guard import is_kernel_failure
 from raft_stereo_tpu.serve.session import (InferenceFailed, InferenceSession,
@@ -80,6 +81,12 @@ class _Row:
         self.upload_error: Optional[Exception] = None
         self.uploaded = threading.Event()
 
+    @property
+    def trace(self):
+        """The request's span timeline (NULL when the request came in
+        without one — tests driving the scheduler directly)."""
+        return self.request.get("_trace") or NULL_TRACE
+
 
 class _Bucket:
     """Active batch + FIFO of waiting joiners for one padded shape."""
@@ -110,9 +117,13 @@ class _Uploader:
     """Background host->device transfer: pads and uploads a joiner's image
     pair while the current segment executes on device, so a join costs the
     batch a carry concat, not a host round trip (train.py's
-    ``device_prefetch`` pattern applied to serving)."""
+    ``device_prefetch`` pattern applied to serving). Each upload lands in
+    the row's trace as a CONCURRENT span — visible in the timeline,
+    excluded from the tiled latency partition (it overlaps a running
+    segment by design)."""
 
-    def __init__(self):
+    def __init__(self, clock):
+        self._clock = clock
         self._q: "queue.Queue[Optional[_Row]]" = queue.Queue()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="stereo-uploader")
@@ -130,12 +141,15 @@ class _Uploader:
             row = self._q.get()
             if row is None:
                 return
+            t0 = self._clock.now()
             try:
                 lp, rp = row.padder.pad_np(row.request["left"],
                                            row.request["right"])
                 row.dev_pair = (jax.device_put(lp), jax.device_put(rp))
             except Exception as e:  # noqa: BLE001 — surfaced per-row
                 row.upload_error = e
+            row.trace.add_span("upload", t0, self._clock.now(),
+                               concurrent=True)
             row.uploaded.set()
 
 
@@ -155,20 +169,35 @@ class BatchScheduler:
                              ">= 2; use the sequential worker path at 1")
         self.session = session
         self.resolve = resolve or self._default_resolve
-        self.uploader = _Uploader()
+        self.uploader = _Uploader(session.clock)
         self._buckets: Dict[Tuple[int, int], _Bucket] = {}
         self._rr: List[Tuple[int, int]] = []   # round-robin bucket order
         self._rr_next = 0
-        # Guards the metrics AND the bucket map itself: /healthz readers
-        # iterate the map from other threads while submit() (scheduler
-        # thread) inserts new shape buckets. Per-bucket rows/carries need
-        # no lock — they are touched only by the scheduling thread.
+        # Guards the bucket map: /healthz readers iterate it from other
+        # threads while submit() (scheduler thread) inserts new shape
+        # buckets. Per-bucket rows/carries need no lock — they are touched
+        # only by the scheduling thread. Aggregate metrics live in the
+        # session's registry (self-locking instruments), so a restart's
+        # fresh scheduler keeps accumulating into the same series.
         self._lock = threading.Lock()
-        self._m = {"ticks": 0, "joins": 0, "exits": 0,
-                   "pad_rows": 0, "batch_rows": 0}
-        self._occupancy: collections.Counter = collections.Counter()
-        self._tick_lat: "collections.deque[float]" = collections.deque(
-            maxlen=512)
+        reg = session.registry
+        self.registry = reg
+        self._m_ticks = reg.counter("raft_sched_ticks_total",
+                                    "scheduler ticks run")
+        self._m_joins = reg.counter("raft_sched_joins_total",
+                                    "requests joined into a device batch")
+        self._m_exits = reg.counter("raft_sched_exits_total",
+                                    "rows exited at a segment boundary")
+        self._m_pad_rows = reg.counter(
+            "raft_sched_pad_rows_total",
+            "dead pad rows advanced (batch-bucket padding waste)")
+        self._m_batch_rows = reg.counter(
+            "raft_sched_batch_rows_total",
+            "total rows advanced (live + pad)")
+        self._tick_hist = reg.histogram(
+            "raft_sched_tick_seconds",
+            "wall time of one scheduler tick (bounded reservoir)",
+            reservoir=512)
 
     # -- request intake ---------------------------------------------------
 
@@ -225,10 +254,8 @@ class BatchScheduler:
         except Exception as e:  # noqa: BLE001 — the crash-proof boundary
             logger.exception("tick failed for bucket %s", bucket.key)
             self._fail_bucket(bucket, e)
-        dt = time.perf_counter() - t0
-        with self._lock:
-            self._m["ticks"] += 1
-            self._tick_lat.append(dt)
+        self._m_ticks.inc()
+        self._tick_hist.observe(time.perf_counter() - t0)
         return True
 
     def _next_bucket(self) -> Optional[_Bucket]:
@@ -268,6 +295,8 @@ class BatchScheduler:
                     "deadline_exceeded_in_queue",
                     "deadline expired before the request joined a batch"))
                 continue
+            # Queue wait ends here: admission-to-join is the span.
+            row.trace.mark("queue_wait")
             joiners.append(row)
             capacity -= 1
         if joiners:
@@ -278,7 +307,12 @@ class BatchScheduler:
             pad = bb - len(joiners)
             lb = jnp.concatenate(lefts + [lefts[0]] * pad, axis=0)
             rb = jnp.concatenate(rights + [rights[0]] * pad, axis=0)
-            (state_j,) = self._device_call("prepare", ph, pw, 0, bb, lb, rb)
+            p0 = clock.now()
+            (state_j,) = self._device_call("prepare", ph, pw, 0, bb, lb, rb,
+                                           traces=[r.trace for r in joiners])
+            p1 = clock.now()
+            for r in joiners:  # one device interval, fanned to every rider
+                r.trace.add_span("prepare", p0, p1, batch=len(joiners))
             if pad:
                 state_j = take_refinement_rows(state_j, range(len(joiners)))
             if bucket.carry is None:
@@ -290,8 +324,7 @@ class BatchScheduler:
                                              range(len(bucket.rows))))
                 bucket.carry = stack_refinement_states([live, state_j])
             bucket.rows.extend(joiners)
-            with self._lock:
-                self._m["joins"] += len(joiners)
+            self._m_joins.inc(len(joiners))
 
         n = len(bucket.rows)
         if n == 0:
@@ -306,15 +339,21 @@ class BatchScheduler:
             bucket.carry = take_refinement_rows(
                 bucket.carry, list(range(n)) + [0] * (bb - n))
         adv_key = session.cache_key("advance", ph, pw, m_iters, b=bb)
+        a0 = clock.now()
         state, _rowsum = self._device_call(
-            "advance", ph, pw, m_iters, bb, bucket.carry)
+            "advance", ph, pw, m_iters, bb, bucket.carry,
+            traces=[r.trace for r in bucket.rows])
+        a1 = clock.now()
         bucket.carry = state
         for row in bucket.rows:
             row.iters_done += m_iters
-        with self._lock:
-            self._occupancy[n] += 1
-            self._m["batch_rows"] += bb
-            self._m["pad_rows"] += bb - n
+            row.trace.add_span("advance", a0, a1, iters=m_iters,
+                               occupancy=n, batch=bb)
+        self.registry.counter(
+            "raft_sched_occupancy_total",
+            "ticks by live-row occupancy", rows=str(n)).inc()
+        self._m_batch_rows.inc(bb)
+        self._m_pad_rows.inc(bb - n)
 
         # 3. Exits: finished rows, plus rows whose deadline cannot absorb
         # another batched segment (per-row anytime degradation — the first
@@ -329,18 +368,28 @@ class BatchScheduler:
                     now >= row.deadline
                     or (est is not None
                         and now + est * SAFETY > row.deadline)):
+                row.trace.event(
+                    "degrade", label=f"reduced_iters:{row.iters_done}",
+                    reason=("deadline_expired" if now >= row.deadline
+                            else "predicted_overshoot"))
                 exits.append(i)
         if not exits:
             return
         eb = session.batch_bucket(len(exits))
         ex_state = take_refinement_rows(
             bucket.carry, exits + [exits[0]] * (eb - len(exits)))
-        (flow_up,) = self._device_call("epilogue", ph, pw, 0, eb, ex_state)
+        e0 = clock.now()
+        (flow_up,) = self._device_call(
+            "epilogue", ph, pw, 0, eb, ex_state,
+            traces=[bucket.rows[i].trace for i in exits])
+        e1 = clock.now()
+        for i in exits:
+            bucket.rows[i].trace.add_span("epilogue", e0, e1,
+                                          batch=len(exits))
         now = clock.now()
         for j, i in enumerate(exits):
             self._finish(bucket.rows[i], flow_up[j:j + 1], now)
-        with self._lock:
-            self._m["exits"] += len(exits)
+        self._m_exits.inc(len(exits))
         survivors = [i for i in range(n) if i not in set(exits)]
         bucket.rows = [bucket.rows[i] for i in survivors]
         bucket.carry = (take_refinement_rows(bucket.carry, survivors)
@@ -349,10 +398,13 @@ class BatchScheduler:
     # -- device calls with breaker retry ----------------------------------
 
     def _device_call(self, kind: str, ph: int, pw: int, iters: int,
-                     b: int, *args):
+                     b: int, *args, traces=()):
         """get_program + invoke, walking the breaker ladder on classified
         kernel failures exactly like the sequential path (the carry is
-        plain data — it composes with a rebuilt rung's programs)."""
+        plain data — it composes with a rebuilt rung's programs).
+        ``traces``: timelines of every request riding this call — a trip
+        becomes a decision event on each (the span itself is fanned out by
+        the caller, which knows the per-phase interval)."""
         session = self.session
         last: Optional[Exception] = None
         for _ in range(len(session.breaker.ladder) + 1):
@@ -364,7 +416,8 @@ class BatchScheduler:
                     raise
                 last = e
                 session._breaker_retry(
-                    e, getattr(e, "_raft_phase", "runtime_failure"))
+                    e, getattr(e, "_raft_phase", "runtime_failure"),
+                    traces=traces)
         raise InferenceFailed(
             "ladder_exhausted", f"breaker retries exhausted: {last}")
 
@@ -373,11 +426,14 @@ class BatchScheduler:
     def _respond(self, row: _Row, resp: Dict) -> None:
         if row.request.get("id") is not None:
             resp.setdefault("id", row.request["id"])
+        row.trace.finish(status=resp["status"], code=resp.get("code"),
+                         quality=resp.get("quality"))
         self.resolve(row.request, resp)
 
     def _finish(self, row: _Row, flow_padded: np.ndarray, now: float) -> None:
         session = self.session
-        flow = row.padder.unpad_np(flow_padded)[0, ..., 0]
+        with row.trace.span("unpad"):
+            flow = row.padder.unpad_np(flow_padded)[0, ..., 0]
         quality = ("full" if row.iters_done >= session.cfg.valid_iters
                    else f"reduced_iters:{row.iters_done}")
         if flow.shape != (row.orig_h, row.orig_w):
@@ -448,30 +504,34 @@ class BatchScheduler:
     # -- reporting --------------------------------------------------------
 
     def status(self) -> Dict:
-        with self._lock:
-            m = dict(self._m)
-            occ = {str(k): v for k, v in sorted(self._occupancy.items())}
-            lat = sorted(self._tick_lat)
+        """The /healthz "batching" document — every aggregate is a
+        registry read (same series /metrics exposes)."""
+        ticks = int(self._m_ticks.value)
+        joins = int(self._m_joins.value)
+        exits = int(self._m_exits.value)
+        pad_rows = int(self._m_pad_rows.value)
+        batch_rows = int(self._m_batch_rows.value)
+        occ = {labels["rows"]: int(v) for labels, v in self.registry.series(
+            "raft_sched_occupancy_total")}
+        occ = {k: occ[k] for k in sorted(occ, key=int)}
 
         def pct(p: float) -> Optional[float]:
-            if not lat:
-                return None
-            return lat[min(len(lat) - 1, int(p * len(lat)))] * 1e3
+            v = self._tick_hist.percentile(p)
+            return None if v is None else v * 1e3
 
-        ticks = max(1, m["ticks"])
+        denom = max(1, ticks)
         return {
             "max_batch": self.session.cfg.max_batch,
             "batch_buckets": list(self.session.batch_buckets),
             "active": self.active_rows,
             "pending": sum(len(b.pending) for b in self._bucket_list()),
-            "ticks": m["ticks"],
-            "joins": m["joins"],
-            "exits": m["exits"],
-            "joins_per_tick": m["joins"] / ticks,
-            "exits_per_tick": m["exits"] / ticks,
+            "ticks": ticks,
+            "joins": joins,
+            "exits": exits,
+            "joins_per_tick": joins / denom,
+            "exits_per_tick": exits / denom,
             "occupancy_hist": occ,
-            "pad_waste": (m["pad_rows"] / m["batch_rows"]
-                          if m["batch_rows"] else 0.0),
+            "pad_waste": (pad_rows / batch_rows if batch_rows else 0.0),
             "tick_latency_ms": {"p50": pct(0.50), "p99": pct(0.99),
-                                "n": len(lat)},
+                                "n": self._tick_hist.n},
         }

@@ -37,10 +37,10 @@ from __future__ import annotations
 import dataclasses
 import queue
 import threading
-from collections import Counter, deque
 from concurrent.futures import Future
 from typing import Dict, Optional
 
+from raft_stereo_tpu.obs.tracing import NULL_TRACE
 from raft_stereo_tpu.serve.session import (DeadlineExceeded, InferenceSession,
                                            SessionError)
 from raft_stereo_tpu.serve.validate import InputRejected, validate_pair
@@ -79,8 +79,17 @@ class StereoService:
         self._queue: "queue.Queue" = queue.Queue(maxsize=self.cfg.max_queue)
         self._workers = []
         self._stop = threading.Event()
-        self._counts: Counter = Counter()
-        self._latencies: deque = deque(maxlen=self.cfg.latency_window)
+        # graftscope: request counters and the latency reservoir live in
+        # the session's ONE registry (shared with the scheduler), so
+        # /healthz is derivable from /metrics byte-for-byte — no service-
+        # private Counter/deque to fold in.
+        self.registry = session.registry
+        self.tracer = session.tracer
+        self.profiler = session.profiler
+        self._latency = self.registry.histogram(
+            "raft_request_latency_seconds",
+            "end-to-end served-request latency (bounded reservoir)",
+            reservoir=self.cfg.latency_window)
         self._lock = threading.Lock()
         self._started = False
         # Continuous batching engages when the SESSION was built for it
@@ -163,8 +172,8 @@ class StereoService:
                            "service stopped before this request ran")
             if request.get("id") is not None:
                 resp["id"] = request["id"]
-            with self._lock:
-                self._counts["rejected:service_stopped"] += 1
+            self._count("rejected:service_stopped")
+            self._finish_trace(request, resp)
             try:
                 fut.set_result(resp)
             except Exception:  # already resolved/cancelled
@@ -179,18 +188,40 @@ class StereoService:
 
     # -- request path -----------------------------------------------------
 
+    def _count(self, outcome: str) -> None:
+        """One request outcome into the registry (same keys /healthz has
+        always reported: 'ok', 'rejected:<code>', 'error:<code>',
+        'degraded')."""
+        self.registry.counter(
+            "raft_requests_total", "request outcomes by disposition",
+            outcome=outcome).inc()
+
+    @staticmethod
+    def _finish_trace(request: Dict, resp: Dict) -> None:
+        trace = request.get("_trace")
+        if trace is not None:
+            trace.finish(status=resp["status"], code=resp.get("code"),
+                         quality=resp.get("quality"))
+
     def _admit(self, request: Dict) -> Optional[Dict]:
         """Validation + deadline stamping; returns a rejection dict or
-        None. Mutates ``request``: the absolute ``_deadline`` is stamped
-        and left/right are replaced with their validated canonical form,
-        so the session skips a second O(N) validation pass on dequeue."""
+        None. Mutates ``request``: a trace is opened (trace id at
+        admission), the absolute ``_deadline`` is stamped and left/right
+        are replaced with their validated canonical form, so the session
+        skips a second O(N) validation pass on dequeue."""
+        trace = request.get("_trace")
+        if trace is None:
+            trace = self.tracer.start_request(request.get("id"))
+            request["_trace"] = trace
         try:
             request["left"], request["right"] = validate_pair(
                 request["left"], request["right"],
                 self.session.cfg.admission)
         except InputRejected as e:
+            trace.mark("admission", rejected=e.code)
             return _reject(f"invalid_input:{e.code}", str(e))
         except KeyError as e:
+            trace.mark("admission", rejected="missing_field")
             return _reject("invalid_input:missing_field",
                            f"request missing {e}")
         deadline_ms = request.get("deadline_ms",
@@ -198,11 +229,16 @@ class StereoService:
         request["_deadline"] = (
             None if deadline_ms is None
             else self.session.clock.now() + deadline_ms / 1e3)
+        trace.mark("admission", h=int(request["left"].shape[1]),
+                   w=int(request["left"].shape[2]),
+                   deadline_ms=deadline_ms)
         return None
 
     def _respond(self, request: Dict) -> Dict:
         """One request, synchronously, never raising."""
         rid = request.get("id")
+        trace = request.get("_trace") or NULL_TRACE
+        trace.mark("queue_wait")
         try:
             deadline = request.get("_deadline")
             if deadline is not None and self.session.clock.now() >= deadline:
@@ -214,10 +250,8 @@ class StereoService:
                 result = self.session.infer(
                     request["left"], request["right"], deadline=deadline,
                     allow_half_res=request.get("allow_half_res"),
-                    prevalidated=True)
-                with self._lock:
-                    self._latencies.append(
-                        self.session.clock.now() - t0)
+                    prevalidated=True, trace=trace)
+                self._latency.observe(self.session.clock.now() - t0)
                 resp = {
                     "status": "ok",
                     "quality": result.quality,
@@ -236,13 +270,13 @@ class StereoService:
             resp = _error("internal", f"{type(e).__name__}: {e}")
         if rid is not None:
             resp["id"] = rid
-        with self._lock:
-            key = resp["status"]
-            if resp["status"] != "ok":
-                key = f'{resp["status"]}:{resp["code"]}'
-            elif resp.get("quality") != "full":
-                self._counts["degraded"] += 1
-            self._counts[key] += 1
+        key = resp["status"]
+        if resp["status"] != "ok":
+            key = f'{resp["status"]}:{resp["code"]}'
+        elif resp.get("quality") != "full":
+            self._count("degraded")
+        self._count(key)
+        self._finish_trace(request, resp)
         return resp
 
     def handle(self, request: Dict) -> Dict:
@@ -258,8 +292,8 @@ class StereoService:
         if rejection is not None:
             if request.get("id") is not None:
                 rejection["id"] = request["id"]
-            with self._lock:
-                self._counts[f'rejected:{rejection["code"]}'] += 1
+            self._count(f'rejected:{rejection["code"]}')
+            self._finish_trace(request, rejection)
             return rejection
         return self._respond(request)
 
@@ -287,8 +321,8 @@ class StereoService:
         if rejection is not None:
             if request.get("id") is not None:
                 rejection["id"] = request["id"]
-            with self._lock:
-                self._counts[f'rejected:{rejection["code"]}'] += 1
+            self._count(f'rejected:{rejection["code"]}')
+            self._finish_trace(request, rejection)
             fut.set_result(rejection)
         return fut
 
@@ -296,16 +330,16 @@ class StereoService:
 
     def _resolve_scheduled(self, request: Dict, resp: Dict) -> None:
         """Scheduler response sink: fold counters/latency exactly like the
-        sequential ``_respond`` path, then resolve the caller's Future."""
-        with self._lock:
-            key = resp["status"]
-            if resp["status"] != "ok":
-                key = f'{resp["status"]}:{resp["code"]}'
-            else:
-                self._latencies.append(resp["elapsed_ms"] / 1e3)
-                if resp.get("quality") != "full":
-                    self._counts["degraded"] += 1
-            self._counts[key] += 1
+        sequential ``_respond`` path, then resolve the caller's Future.
+        (The scheduler already finished the request's trace.)"""
+        key = resp["status"]
+        if resp["status"] != "ok":
+            key = f'{resp["status"]}:{resp["code"]}'
+        else:
+            self._latency.observe(resp["elapsed_ms"] / 1e3)
+            if resp.get("quality") != "full":
+                self._count("degraded")
+        self._count(key)
         fut = request.get("_future")
         if fut is not None:
             try:
@@ -371,14 +405,14 @@ class StereoService:
     # -- health -----------------------------------------------------------
 
     def status(self) -> Dict:
-        with self._lock:
-            lat = sorted(self._latencies)
-            counts = dict(self._counts)
+        """The /healthz document — every number here is a registry read
+        (the same counters /metrics exposes), no service-private state."""
+        counts = {labels["outcome"]: int(v) for labels, v in
+                  self.registry.series("raft_requests_total")}
 
         def pct(p: float) -> Optional[float]:
-            if not lat:
-                return None
-            return lat[min(len(lat) - 1, int(p * len(lat)))] * 1e3
+            v = self._latency.percentile(p)
+            return None if v is None else v * 1e3
 
         return {
             "queue": {"depth": self._queue.qsize(),
@@ -387,8 +421,14 @@ class StereoService:
                                   else self.cfg.workers)},
             "requests": counts,
             "latency_ms": {"p50": pct(0.50), "p99": pct(0.99),
-                           "n": len(lat)},
+                           "n": self._latency.n},
             "batching": (self._scheduler.status()
                          if self._scheduler is not None else None),
             "session": self.session.status(),
         }
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition of the shared registry — the
+        /metrics endpoint body (session + service + scheduler series,
+        one scrape)."""
+        return self.registry.render_prometheus()

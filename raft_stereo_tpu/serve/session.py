@@ -46,6 +46,9 @@ from raft_stereo_tpu.analysis.knobs import ENV_KNOBS as _ENV_KNOBS
 from raft_stereo_tpu.config import RAFTStereoConfig
 from raft_stereo_tpu.faults import (RealClock, ServeFaultPlan, ServeFaults,
                                     poison_disparity)
+from raft_stereo_tpu.obs.metrics import MetricsRegistry
+from raft_stereo_tpu.obs.profiler import ProfilerWindow
+from raft_stereo_tpu.obs.tracing import NULL_TRACE, Tracer
 from raft_stereo_tpu.ops.padder import InputPadder
 from raft_stereo_tpu.serve.guard import (KernelCircuitBreaker, CANARY_ATOL,
                                          CANARY_RTOL, is_kernel_failure)
@@ -248,6 +251,21 @@ def config_fingerprint(cfg: RAFTStereoConfig,
     return cfg_part, env_part
 
 
+# Session counters (obs/metrics.py registry): the short names /healthz has
+# always reported, mapped to their Prometheus series. ONE table so
+# ``metrics()`` (the legacy dict view) and the /metrics exposition can
+# never drift.
+_SESSION_COUNTERS = {
+    "compiles": "programs built (jit closures created)",
+    "evictions": "programs evicted from the LRU cache",
+    "requests_ok": "requests served with a finite disparity",
+    "requests_failed": "requests that raised (all serving modes)",
+    "degraded": "served requests whose quality label was not 'full'",
+    "nonfinite_outputs": "forwards whose disparity failed validation",
+    "rebuilds": "breaker-driven session rebuilds (one rung down)",
+}
+
+
 # Every serving program kind the session can compile — ONE list shared by
 # `_build_fn` and the graftverify trace registry
 # (analysis/trace/registry.py), which traces each kind at pinned shapes so
@@ -320,11 +338,23 @@ class InferenceSession:
                  session_cfg: Optional[SessionConfig] = None, *,
                  breaker: Optional[KernelCircuitBreaker] = None,
                  fault_plan: Optional[ServeFaultPlan] = None,
-                 clock=None):
+                 clock=None, registry: Optional[MetricsRegistry] = None,
+                 tracer: Optional[Tracer] = None):
         import jax
         self._jax = jax
         self.cfg = session_cfg or SessionConfig()
         self.clock = clock if clock is not None else RealClock()
+        # graftscope (obs/): ONE registry + tracer per serving process —
+        # service and scheduler share these, so /healthz, /metrics and the
+        # span timelines describe the same counters by construction.
+        self.registry = registry if registry is not None else \
+            MetricsRegistry()
+        self.tracer = tracer if tracer is not None else \
+            Tracer(clock=self.clock)
+        self.profiler = ProfilerWindow()  # RAFT_PROFILE_DIR, read once
+        self._ctr = {
+            name: self.registry.counter(f"raft_session_{name}_total", help)
+            for name, help in _SESSION_COUNTERS.items()}
         self._params = params
         self._base_cfg = cfg
         # Kernel switches are captured ONCE, here: every cache key and
@@ -357,18 +387,13 @@ class InferenceSession:
         # whose env var drifted out of the registry still reaches the
         # trace correctly — it just won't key untripped programs.
         self.breaker = breaker or KernelCircuitBreaker()
+        self.breaker.bind_registry(self.registry)
         self.faults = ServeFaults(fault_plan, clock=self.clock)
         self._cache: "OrderedDict[Tuple, _Program]" = OrderedDict()
         self._cache_lock = threading.Lock()
         self._key_locks: Dict[Tuple, threading.Lock] = {}
         self._estimates: Dict[Tuple, float] = {}
         self._est_lock = threading.Lock()
-        self._metrics = {
-            "compiles": 0, "evictions": 0, "requests_ok": 0,
-            "requests_failed": 0, "degraded": 0, "nonfinite_outputs": 0,
-            "rebuilds": 0,
-        }
-        self._metrics_lock = threading.Lock()
         self._canary_state = {"enabled": self.cfg.canary, "ran": False,
                               "passed": None, "attempts": 0}
         self._run_cfg, self._env = self.breaker.apply(cfg)
@@ -391,20 +416,25 @@ class InferenceSession:
         keyed under the old fingerprint become unreachable (and age out of
         the LRU) — they are never served for the new config."""
         self._run_cfg, self._env = self.breaker.apply(self._base_cfg)
-        with self._metrics_lock:
-            self._metrics["rebuilds"] += 1
+        self._ctr["rebuilds"].inc()
         logger.warning("session rebuilt one rung down (%s); tripped=%s",
                        why, list(self.breaker.tripped_names))
 
-    def _breaker_retry(self, exc: Exception, phase: str) -> None:
+    def _breaker_retry(self, exc: Exception, phase: str,
+                       traces=()) -> None:
         """Classify a kernel failure, trip the rung, rebuild — or give up
-        with a structured error when the ladder is exhausted."""
+        with a structured error when the ladder is exhausted. ``traces``
+        are the request timelines riding the failed program (one for the
+        sequential path, every batch row for the scheduler) — the trip is
+        a decision event on each."""
         path = self.breaker.classify(exc)
         if path is None:
             raise InferenceFailed(
                 "ladder_exhausted",
                 f"plain-XLA program still failing: {exc}") from exc
         self.breaker.trip(path.name, phase, exc)
+        for trace in traces:
+            trace.event("breaker_trip", rung=path.name, phase=phase)
         self._rebuild(f"{path.name}: {exc}")
 
     # -- padding / bucketing ----------------------------------------------
@@ -511,8 +541,7 @@ class InferenceSession:
                     # would otherwise leak for the process lifetime
                     self._key_locks.pop(key, None)
                 raise
-            with self._metrics_lock:
-                self._metrics["compiles"] += 1
+            self._ctr["compiles"].inc()
             prog = _Program(key, fn, kind, trace_env)
             evicted = 0
             with self._cache_lock:
@@ -524,8 +553,7 @@ class InferenceSession:
                         self._estimates.pop(old_key, None)
                     evicted += 1
             if evicted:
-                with self._metrics_lock:
-                    self._metrics["evictions"] += evicted
+                self._ctr["evictions"].inc(evicted)
             return prog
 
     def has_program(self, kind: str, h: int, w: int, iters: int,
@@ -538,7 +566,8 @@ class InferenceSession:
             prog = self._cache.get(key)
         return prog is not None and prog.warmed
 
-    def invoke(self, prog: _Program, *args) -> Tuple[np.ndarray, ...]:
+    def invoke(self, prog: _Program, *args,
+               trace=NULL_TRACE) -> Tuple[np.ndarray, ...]:
         """Run a cached program, fetch results to host, apply fault hooks.
 
         The first invocation (which triggers the actual XLA compile under
@@ -547,6 +576,12 @@ class InferenceSession:
         requests for one bucket compile once and trace-time env reads see
         the switches this program was keyed under (the breaker's overrides
         for serving programs; all-off for the canary reference).
+
+        ``trace`` (a :class:`~raft_stereo_tpu.obs.tracing.RequestTrace`)
+        gets one span per invocation, named by program kind — the
+        sequential path's per-segment timeline. The batched scheduler
+        passes no trace here; it fans the interval out to every row
+        itself.
         """
         # Array outputs come back as host numpy (the fetch doubles as the
         # completion barrier); dict outputs (the segment carry) stay on
@@ -557,25 +592,53 @@ class InferenceSession:
 
         was_warm = prog.warmed
         t0 = self.clock.now()
+        t_disp = t0
         try:
             if not prog.warmed:
                 with prog.lock:
                     with _TRACE_LOCK, _env_overrides(prog.env):
-                        out = fetch(prog.fn(self._params, *args))
+                        raw = prog.fn(self._params, *args)
+                        t_disp = self.clock.now()
+                        out = fetch(raw)
                     prog.warmed = True
             else:
-                out = fetch(prog.fn(self._params, *args))
+                raw = prog.fn(self._params, *args)
+                t_disp = self.clock.now()
+                out = fetch(raw)
         except Exception as e:
             if not hasattr(e, "_raft_phase"):
                 setattr(e, "_raft_phase", "runtime_failure")
             raise
         ordinal = self.faults.on_forward()
+        t_end = self.clock.now()  # includes any injected device time
+        self.registry.counter(
+            "raft_program_calls_total",
+            "device-program invocations by kind", kind=prog.kind).inc()
         if was_warm:
             # The warming invocation's time includes the XLA compile
             # (minutes on TPU) — feeding it into the latency EMA would
             # make the degrade policy reject/halve requests for dozens of
             # calls after every cold bucket. Only steady-state runs count.
-            self._record_time(prog.key, self.clock.now() - t0)
+            self._record_time(prog.key, t_end - t0)
+            # Device-vs-host split per program kind: dispatch up to the
+            # async call's return is host work (python + jit call
+            # overhead); from there to the completed host fetch is device
+            # execution + transfer (the fetch IS the completion barrier).
+            self.registry.counter(
+                "raft_program_host_seconds_total",
+                "host-side dispatch time by program kind",
+                kind=prog.kind).inc(max(0.0, t_disp - t0))
+            self.registry.counter(
+                "raft_program_device_seconds_total",
+                "device wait (dispatch-to-fetch) by program kind",
+                kind=prog.kind).inc(max(0.0, t_end - t_disp))
+            trace.add_span(prog.kind, t0, t_end)
+        else:
+            self.registry.counter(
+                "raft_program_warmup_seconds_total",
+                "first-invocation (compile-inclusive) time by kind",
+                kind=prog.kind).inc(max(0.0, t_end - t0))
+            trace.add_span(prog.kind, t0, t_end, warming=True)
         if self.faults.poisoned(ordinal):
             flow_i = {"full": 0, "segment": 1, "epilogue": 0}.get(prog.kind)
             if flow_i is not None:
@@ -600,7 +663,8 @@ class InferenceSession:
     def infer(self, left, right, *, deadline: Optional[float] = None,
               budget_s: Optional[float] = None,
               allow_half_res: Optional[bool] = None,
-              prevalidated: bool = False) -> InferenceResult:
+              prevalidated: bool = False,
+              trace=NULL_TRACE) -> InferenceResult:
         """Serve one stereo pair.
 
         ``deadline`` is absolute on the session clock; ``budget_s`` is
@@ -615,16 +679,16 @@ class InferenceSession:
             return self._infer(left, right, deadline=deadline,
                                budget_s=budget_s,
                                allow_half_res=allow_half_res,
-                               prevalidated=prevalidated)
+                               prevalidated=prevalidated, trace=trace)
         except Exception:
-            with self._metrics_lock:
-                self._metrics["requests_failed"] += 1
+            self._ctr["requests_failed"].inc()
             raise
 
     def _infer(self, left, right, *, deadline: Optional[float],
                budget_s: Optional[float],
                allow_half_res: Optional[bool],
-               prevalidated: bool = False) -> InferenceResult:
+               prevalidated: bool = False,
+               trace=NULL_TRACE) -> InferenceResult:
         from raft_stereo_tpu.serve import degrade
 
         t_start = self.clock.now()
@@ -647,20 +711,21 @@ class InferenceSession:
         for _ in range(len(self.breaker.ladder) + 1):
             try:
                 if deadline is None:
-                    flow = self._run_full(padder, left, right)
+                    flow = self._run_full(padder, left, right, trace=trace)
                     out = degrade.Outcome(flow, "full", self.cfg.valid_iters,
                                           False)
                 else:
                     out = degrade.run_with_deadline(
                         self, padder, left, right, deadline,
-                        allow_half_res=half)
+                        allow_half_res=half, trace=trace)
                 break
             except Exception as e:  # noqa: BLE001 — filtered just below
                 if isinstance(e, SessionError) or not is_kernel_failure(e):
                     raise
                 last_exc = e
                 self._breaker_retry(
-                    e, getattr(e, "_raft_phase", "runtime_failure"))
+                    e, getattr(e, "_raft_phase", "runtime_failure"),
+                    traces=(trace,))
                 padder = self.padder_for(left.shape)  # unchanged, explicit
                 continue
         else:
@@ -668,13 +733,13 @@ class InferenceSession:
                 "ladder_exhausted",
                 f"breaker retries exhausted: {last_exc}") from last_exc
 
-        disparity = self._finish(out.flow_padded, padder, out.quality,
-                                 orig_h, orig_w)
+        with trace.span("unpad"):
+            disparity = self._finish(out.flow_padded, padder, out.quality,
+                                     orig_h, orig_w)
         elapsed = self.clock.now() - t_start
-        with self._metrics_lock:
-            self._metrics["requests_ok"] += 1
-            if out.quality != "full":
-                self._metrics["degraded"] += 1
+        self._ctr["requests_ok"].inc()
+        if out.quality != "full":
+            self._ctr["degraded"].inc()
         return InferenceResult(
             disparity=disparity, quality=out.quality, iters=out.iters,
             elapsed_s=elapsed, padded_shape=padder.padded_shape,
@@ -682,13 +747,13 @@ class InferenceSession:
 
     def _run_full(self, padder: InputPadder, left: np.ndarray,
                   right: np.ndarray, iters: Optional[int] = None,
-                  cfg=None, env=None) -> np.ndarray:
+                  cfg=None, env=None, trace=NULL_TRACE) -> np.ndarray:
         """Single-scan forward on the padded bucket; returns padded flow."""
         iters = iters if iters is not None else self.cfg.valid_iters
         lp, rp = padder.pad_np(left, right)
         ph, pw = padder.padded_shape
         prog = self.get_program("full", ph, pw, iters, cfg, env)
-        flow_up, _checksum = self.invoke(prog, lp, rp)
+        flow_up, _checksum = self.invoke(prog, lp, rp, trace=trace)
         return flow_up
 
     def _finish(self, flow_padded: np.ndarray, padder: InputPadder,
@@ -705,8 +770,7 @@ class InferenceSession:
                 "internal", f"output shape {flow.shape} != input "
                 f"({orig_h}, {orig_w})")
         if not np.isfinite(flow).all():
-            with self._metrics_lock:
-                self._metrics["nonfinite_outputs"] += 1
+            self._ctr["nonfinite_outputs"].inc()
             raise InferenceFailed(
                 "nonfinite_output",
                 "disparity contains NaN/Inf — refusing to serve it")
@@ -818,20 +882,19 @@ class InferenceSession:
         """Fold one externally-served request (the continuous-batching
         scheduler resolves its own responses) into the session counters,
         so /healthz sees one truth regardless of serving mode."""
-        with self._metrics_lock:
-            if ok:
-                self._metrics["requests_ok"] += 1
-                if degraded:
-                    self._metrics["degraded"] += 1
-            else:
-                self._metrics["requests_failed"] += 1
-                if nonfinite:
-                    self._metrics["nonfinite_outputs"] += 1
+        if ok:
+            self._ctr["requests_ok"].inc()
+            if degraded:
+                self._ctr["degraded"].inc()
+        else:
+            self._ctr["requests_failed"].inc()
+            if nonfinite:
+                self._ctr["nonfinite_outputs"].inc()
 
     def metrics(self) -> Dict:
-        with self._metrics_lock:
-            m = dict(self._metrics)
-        return m
+        """The legacy short-name counter dict — every value read straight
+        off the registry (/healthz numbers ARE registry numbers)."""
+        return {k: int(c.value) for k, c in self._ctr.items()}
 
     def status(self) -> Dict:
         with self._cache_lock:
@@ -851,4 +914,6 @@ class InferenceSession:
             "canary": dict(self._canary_state),
             "counts": {k: v for k, v in self.metrics().items()
                        if k not in ("compiles", "evictions")},
+            "profiler": self.profiler.status(),
+            "tracing": self.tracer.status(),
         }

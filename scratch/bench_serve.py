@@ -130,7 +130,7 @@ def main() -> None:
     bat = run_mode(max_batch)
     speedup = bat["rps"] / seq["rps"] if seq["rps"] else None
 
-    print(json.dumps({
+    doc = {
         "metric": (f"serve_requests_per_s_{h}x{w}_i{iters}_{corr}"
                    f"_b{max_batch}{'_tiny' if tiny else ''}"),
         "value": round(bat["rps"], 4),
@@ -142,7 +142,17 @@ def main() -> None:
         "occupancy_hist": bat.get("occupancy_hist"),
         "pad_waste": bat.get("pad_waste"),
         "backend": jax.default_backend(),
-    }))
+    }
+    print(json.dumps(doc))
+
+    # Consolidated perf-trajectory artifact (DESIGN.md r11): serve
+    # throughput rides TRAJECTORY.json alongside fps/chip and steps/s so
+    # the release gate's pinned bands cover it too.
+    from raft_stereo_tpu.obs.trajectory import emit
+    emit(doc["metric"], bat["rps"], "requests/s",
+         backend=jax.default_backend(), source="scratch/bench_serve.py",
+         extra={"sequential_rps": doc["sequential_rps"],
+                "speedup_vs_sequential": doc["speedup_vs_sequential"]})
 
 
 if __name__ == "__main__":

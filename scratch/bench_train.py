@@ -4,10 +4,25 @@ Reference config of record (README.md:106-110): batch 8 on 2 GPUs -> batch
 4/GPU; here batch 6 single chip (train_stereo.py default), 320x720 crops,
 train_iters 22, bf16 compute. Prints steps/s and the loss trajectory on a
 fixed synthetic batch (loss must drop = grads flow through scan + Pallas
-custom_vjp + optimizer on hardware).
+custom_vjp + optimizer on hardware), then ONE JSON line (bench.py's
+contract) and — when RAFT_TRAJECTORY is exported — a steps/s entry in the
+consolidated perf-trajectory artifact (DESIGN.md r11), so the release
+gate's pinned bands cover training throughput alongside fps/chip and
+requests/s.
+
+TRAIN_BENCH_TINY=1 is the CPU gate smoke: a 32-dim 1-GRU model at 64x96,
+fp32, XLA corr — it proves the wiring (step compiles, loss finite, JSON +
+trajectory emitted) on a machine where the real config would take hours;
+the throughput bar and the overfit assertion apply to the on-chip run.
 """
-import sys, time, os
-sys.path.insert(0, "/root/repo")
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
 import numpy as np
 import jax, jax.numpy as jnp
 from raft_stereo_tpu.config import RAFTStereoConfig
@@ -15,18 +30,23 @@ from raft_stereo_tpu.engine.optimizer import make_optimizer
 from raft_stereo_tpu.engine.steps import make_train_step
 from raft_stereo_tpu.models import init_raft_stereo
 
-corr = os.environ.get("TRAIN_BENCH_CORR", "reg_tpu")
-b = int(os.environ.get("TRAIN_BENCH_B", 6))
-h = int(os.environ.get("TRAIN_BENCH_H", 320))
-w = int(os.environ.get("TRAIN_BENCH_W", 720))
-iters = int(os.environ.get("TRAIN_BENCH_ITERS", 22))
-fused = os.environ.get("TRAIN_BENCH_FUSED", "1") not in ("0", "false")
+tiny = os.environ.get("TRAIN_BENCH_TINY", "0") not in ("0", "false")
+corr = os.environ.get("TRAIN_BENCH_CORR", "reg" if tiny else "reg_tpu")
+b = int(os.environ.get("TRAIN_BENCH_B", 2 if tiny else 6))
+h = int(os.environ.get("TRAIN_BENCH_H", 64 if tiny else 320))
+w = int(os.environ.get("TRAIN_BENCH_W", 96 if tiny else 720))
+iters = int(os.environ.get("TRAIN_BENCH_ITERS", 2 if tiny else 22))
+n_steps = int(os.environ.get("TRAIN_BENCH_STEPS", 6 if tiny else 12))
+fused = os.environ.get("TRAIN_BENCH_FUSED",
+                       "0" if tiny else "1") not in ("0", "false")
 # TRAIN_BENCH_FUSED_TRAIN=1 engages the streaming kernels in the train
 # step itself (with the save_only_these_names remat policy).
 fused_train = os.environ.get("TRAIN_BENCH_FUSED_TRAIN", "0") not in (
     "0", "false")
-cfg = RAFTStereoConfig(corr_implementation=corr, mixed_precision=True,
-                       fused_update=fused, fused_train=fused_train)
+arch = (dict(n_gru_layers=1, hidden_dims=(32, 32, 32), corr_levels=2,
+             corr_radius=2) if tiny else {})
+cfg = RAFTStereoConfig(corr_implementation=corr, mixed_precision=not tiny,
+                       fused_update=fused, fused_train=fused_train, **arch)
 params = jax.jit(lambda k: init_raft_stereo(k, cfg))(jax.random.PRNGKey(0))
 tx, _ = make_optimizer(lr=2e-4, num_steps=1000)
 opt_state = jax.jit(tx.init)(params)
@@ -46,14 +66,37 @@ batch = {
 }
 losses = []
 t0 = None
-for i in range(12):
+for i in range(n_steps):
     params, opt_state, m = step(params, opt_state, batch)
     losses.append(float(m["loss"]))  # host fetch = barrier
     if i == 1:
         t0 = time.perf_counter()  # skip 2 warmup/compile steps
 t1 = time.perf_counter()
+timed = n_steps - 2
+steps_per_s = timed / (t1 - t0)
 print(f"corr={corr} batch={b} {h}x{w} iters={iters}: "
-      f"{10 / (t1 - t0):.3f} steps/s ({(t1-t0)/10:.2f} s/step)")
+      f"{steps_per_s:.3f} steps/s ({(t1-t0)/timed:.2f} s/step)")
 print("loss trajectory:", " ".join(f"{l:.3f}" for l in losses))
-assert losses[-1] < losses[1] * 0.9, "loss did not decrease"
-print("overfit smoke OK")
+assert all(np.isfinite(losses)), "non-finite loss"
+if not tiny:
+    assert losses[-1] < losses[1] * 0.9, "loss did not decrease"
+    print("overfit smoke OK")
+else:
+    # 4 timed steps on random init prove wiring, not convergence — the
+    # overfit bar stays an on-chip (full-config) assertion.
+    print("tiny wiring smoke OK (overfit bar applies to the full config)")
+
+doc = {
+    "metric": (f"train_steps_per_s_{h}x{w}_b{b}_i{iters}_{corr}"
+               f"{'_tiny' if tiny else ''}"),
+    "value": round(steps_per_s, 4),
+    "unit": "steps/s",
+    "n_steps_timed": timed,
+    "final_loss": round(losses[-1], 4),
+    "backend": jax.default_backend(),
+}
+print(json.dumps(doc))
+from raft_stereo_tpu.obs.trajectory import emit
+emit(doc["metric"], steps_per_s, "steps/s",
+     backend=jax.default_backend(), source="scratch/bench_train.py",
+     extra={"final_loss": doc["final_loss"]})

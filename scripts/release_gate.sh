@@ -13,6 +13,14 @@ cd "$(dirname "$0")/.."
 fail=0
 step() { echo; echo "== $* =="; }
 
+# Consolidated perf-trajectory artifact (DESIGN.md r11): every bench step
+# below emits its headline metric (fps/chip, requests/s, steps/s) into
+# ONE TRAJECTORY.json via RAFT_TRAJECTORY; the trajectory gate at the end
+# checks all of them against the pinned bands in trajectory_bands.json.
+# Gitignored and echoed on failure, like analysis_report.json.
+export RAFT_TRAJECTORY="$PWD/TRAJECTORY.json"
+rm -f "$RAFT_TRAJECTORY"
+
 # graftlint first: it is the cheapest step (milliseconds, no jax) and a
 # finding here — an unregistered knob, an import-time kill-switch read, a
 # half-locked attribute — invalidates everything the later steps would
@@ -50,6 +58,14 @@ env JAX_PLATFORMS=cpu python -m pytest tests/test_serve.py \
     -p no:cacheprovider -p no:xdist -p no:randomly \
     || { echo "FAIL: serving fault storm"; fail=1; }
 
+# Observability battery (ISSUE 7 acceptance): FakeClock span timelines
+# that reconcile with reported latency, the /metrics golden, the
+# trajectory-gate failure mode, and the flat-memory reservoir pin.
+step "observability battery (graftscope: spans, /metrics, trajectory gate)"
+env JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py -q -m obs \
+    -p no:cacheprovider -p no:xdist -p no:randomly \
+    || { echo "FAIL: observability battery"; fail=1; }
+
 backend=$(python - <<'EOF'
 import jax
 print(jax.default_backend())
@@ -70,6 +86,18 @@ if [ "$backend" != "tpu" ]; then
 else
     python scratch/bench_serve.py \
         || { echo "FAIL: serve throughput bench"; fail=1; }
+fi
+
+# Train-throughput bench: steps/s into the trajectory. On CPU a tiny
+# wiring smoke (TRAIN_BENCH_TINY: 32-dim model, ~40 s); on chip the full
+# reference-config run including the overfit assertion.
+step "train throughput bench (steps/s into the trajectory)"
+if [ "$backend" != "tpu" ]; then
+    env JAX_PLATFORMS=cpu TRAIN_BENCH_TINY=1 python scratch/bench_train.py \
+        || { echo "FAIL: train bench smoke"; fail=1; }
+else
+    python scratch/bench_train.py \
+        || { echo "FAIL: train throughput bench"; fail=1; }
 fi
 
 if [ "$backend" != "tpu" ]; then
@@ -102,6 +130,23 @@ else
     step "compiled-on-chip kernel battery"
     bash scripts/run_onchip_battery.sh \
         || { echo "FAIL: on-chip battery"; fail=1; }
+fi
+
+# Perf-trajectory gate: every emitted metric with a pinned band in
+# trajectory_bands.json must sit above its floor. --autopin (TPU only —
+# CPU numbers are machine-local, namespaced, never pinned) records a band
+# for a first-seen metric, loudly, mirroring RAFT_BENCH_AUTOPIN; check
+# `git diff trajectory_bands.json` after a run that printed "PINNED".
+step "perf trajectory gate (fps/chip + requests/s + steps/s vs pinned bands)"
+traj_flags=""
+if [ "$backend" = "tpu" ]; then traj_flags="--autopin"; fi
+if python -m raft_stereo_tpu.obs.trajectory check "$RAFT_TRAJECTORY" \
+        --bands trajectory_bands.json $traj_flags; then
+    echo "ok: trajectory in band ($RAFT_TRAJECTORY)"
+else
+    echo "--- TRAJECTORY.json ---"
+    cat "$RAFT_TRAJECTORY" 2>/dev/null
+    echo "FAIL: perf trajectory gate"; fail=1
 fi
 
 echo

@@ -85,6 +85,12 @@ def build_parser() -> argparse.ArgumentParser:
                         help="never degrade to half resolution")
     parser.add_argument('--status_json', default=None,
                         help="also write the final /healthz status here")
+    parser.add_argument('--metrics_prom', default=None,
+                        help="write the final Prometheus /metrics text "
+                        "here (the same registry /healthz derives from; "
+                        "RAFT_TRACE=<path.jsonl> additionally streams "
+                        "per-request span timelines, RAFT_PROFILE_DIR "
+                        "arms on-demand jax.profiler windows)")
     add_model_args(parser)
     return parser
 
@@ -208,6 +214,8 @@ def serve(args) -> int:
     if args.status_json:
         Path(args.status_json).write_text(
             json.dumps(status, indent=2, default=str))
+    if args.metrics_prom:
+        Path(args.metrics_prom).write_text(service.metrics_text())
     if failures:
         print(f"{failures}/{len(left_images)} requests failed")
     return 1 if failures else 0

@@ -308,6 +308,20 @@ def test_gl002_real_tree_native_knob_registered():
     assert hits[0].path.endswith("native/__init__.py")
 
 
+def test_gl002_real_tree_obs_knob_registered():
+    # RAFT_TRACE (obs/tracing.py Tracer) is covered by HOST_ENV_KNOBS;
+    # drop it and GL002 must fire at the read site — the r11-widened scan
+    # provably sees obs/ (same for RAFT_PROFILE_DIR / RAFT_TRAJECTORY,
+    # which this registry drop leaves covered so the hit is unambiguous).
+    files = collect_files([str(PACKAGE)], base=str(REPO))
+    reduced = tuple(k for k in knobs.SERVE_ENV_KNOBS + knobs.HOST_ENV_KNOBS
+                    if k != "RAFT_TRACE")
+    rep = run_checkers(Project(files, serve_knobs=reduced))
+    hits = [f for f in rep.findings if f.code == "GL002"]
+    assert hits and "RAFT_TRACE" in hits[0].message
+    assert hits[0].path.endswith("obs/tracing.py")
+
+
 def test_gl002_real_tree_dropped_knob_fails():
     # Acceptance fixture: drop RAFT_CORR_TILE from the registry while its
     # read still exists in corr/pallas_reg.py -> GL002 must fire.

@@ -1,0 +1,548 @@
+"""graftscope battery: metrics registry, span timelines, profiler hooks,
+and the consolidated perf-trajectory gate (DESIGN.md "Observability
+(r11)").
+
+The serving integration tests drive the REAL stack (tiny model, CPU) on a
+FakeClock with plan-driven injected device time, so every span duration is
+exact and the timeline reconciliation is an equality, not a tolerance:
+
+- a batched request's spans reconcile with its reported end-to-end
+  latency (the ISSUE 7 acceptance bar: >= 6 span kinds including
+  per-segment advance ticks, tiled sum == total == elapsed);
+- /healthz numbers are registry reads — mutating a registry counter is
+  visible in ``status()`` byte-for-byte, with no surviving ad-hoc dicts;
+- the disabled-trace path is a no-op (nothing recorded, requests serve);
+- the reservoir histograms that replaced the sliding-window latency
+  deques stay at fixed memory under a long run;
+- a synthetic out-of-band requests/s entry FAILS the trajectory gate
+  through the real CLI.
+"""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+from raft_stereo_tpu.config import RAFTStereoConfig
+from raft_stereo_tpu.faults import FakeClock, ServeFaultPlan
+from raft_stereo_tpu.models import init_raft_stereo
+from raft_stereo_tpu.obs.metrics import Histogram, MetricsRegistry
+from raft_stereo_tpu.obs.profiler import ProfilerWindow
+from raft_stereo_tpu.obs.tracing import NULL_TRACE, Tracer
+from raft_stereo_tpu.obs import trajectory as tj
+from raft_stereo_tpu.serve import (InferenceSession, ServiceConfig,
+                                   SessionConfig, StereoService)
+
+pytestmark = pytest.mark.obs
+
+TINY = dict(n_gru_layers=1, hidden_dims=(32, 32, 32),
+            corr_levels=2, corr_radius=2)
+H, W = 40, 60
+
+
+@pytest.fixture(scope="module")
+def tiny_cfg():
+    return RAFTStereoConfig(**TINY)
+
+
+@pytest.fixture(scope="module")
+def tiny_params(tiny_cfg):
+    return init_raft_stereo(jax.random.PRNGKey(0), tiny_cfg)
+
+
+@pytest.fixture(scope="module")
+def pair():
+    rng = np.random.default_rng(3)
+    return (rng.uniform(0, 255, (H, W, 3)).astype(np.float32),
+            rng.uniform(0, 255, (H, W, 3)).astype(np.float32))
+
+
+#: Every device invocation advances the FakeClock by this much — spans
+#: get exact nonzero durations with zero real sleeping.
+TICK = 0.25
+
+
+def slow_plan(n: int = 64) -> ServeFaultPlan:
+    return ServeFaultPlan(slow_forwards={i: TICK for i in range(n)})
+
+
+def make_session(params, cfg, *, max_batch=1, valid_iters=4, segments=2,
+                 plan=None, clock=None, tracer=None):
+    scfg = SessionConfig(valid_iters=valid_iters, segments=segments,
+                         max_batch=max_batch, canary=False)
+    clock = clock or FakeClock()
+    if tracer is None:
+        tracer = Tracer(clock=clock, sink="")
+    return InferenceSession(params, cfg, scfg, fault_plan=plan,
+                            clock=clock, tracer=tracer)
+
+
+# ---------------------------------------------------------------------------
+# Metrics registry.
+
+
+def test_registry_get_or_create_and_type_conflict():
+    r = MetricsRegistry()
+    c1 = r.counter("x_total", "help", k="a")
+    c2 = r.counter("x_total", k="a")
+    assert c1 is c2
+    assert r.counter("x_total", k="b") is not c1
+    with pytest.raises(ValueError):
+        r.gauge("x_total")
+    with pytest.raises(ValueError):
+        r.counter("bad name")
+
+
+def test_counter_monotonic():
+    r = MetricsRegistry()
+    c = r.counter("c_total")
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_reservoir_memory_stays_flat():
+    """The satellite pin: the histograms replacing the sliding-window
+    latency lists hold FIXED memory under a long run — in both modes."""
+    for mode in ("window", "reservoir"):
+        h = Histogram("h", (), size=512, mode=mode)
+        for i in range(20_000):
+            h.observe(float(i % 997))
+        assert h.count == 20_000
+        assert h.n == 512
+        assert len(h._sample) == 512  # the actual buffer, not a view
+        assert h.percentile(0.5) is not None
+        assert 0 <= h.percentile(0.99) <= 996
+
+
+def test_window_histogram_tracks_recent_regression():
+    """The latency instruments sample the newest N (the old deque
+    semantics): after a regression, percentiles move immediately — a
+    lifetime-uniform reservoir would dilute it to invisibility."""
+    h = Histogram("h", (), size=64, mode="window")
+    for _ in range(10_000):
+        h.observe(0.01)          # long healthy history
+    for _ in range(64):
+        h.observe(1.0)           # fresh regression
+    assert h.percentile(0.5) == 1.0
+    assert sorted(h._sample) == [1.0] * 64
+
+
+def test_histogram_percentile_matches_legacy_formula():
+    """Same formula the pre-registry deques used — /healthz p50/p99
+    cannot shift at equal sample counts."""
+    h = Histogram("h", (), size=64)
+    vals = [0.5, 0.1, 0.9, 0.3, 0.7]
+    for v in vals:
+        h.observe(v)
+    lat = sorted(vals)
+    for p in (0.5, 0.99):
+        assert h.percentile(p) == lat[min(len(lat) - 1, int(p * len(lat)))]
+
+
+def test_metrics_prometheus_golden():
+    r = MetricsRegistry()
+    r.counter("test_requests_total", "served", outcome="ok").inc(3)
+    r.counter("test_requests_total", outcome="rejected:queue_full").inc()
+    r.gauge("test_queue_depth", "depth").set(2)
+    h = r.histogram("test_latency_seconds", "lat", reservoir=8)
+    for v in (1, 2, 3, 4):
+        h.observe(v)
+    golden = """\
+# HELP test_latency_seconds lat
+# TYPE test_latency_seconds summary
+test_latency_seconds{quantile="0.5"} 3
+test_latency_seconds{quantile="0.9"} 4
+test_latency_seconds{quantile="0.99"} 4
+test_latency_seconds_sum 10
+test_latency_seconds_count 4
+# HELP test_queue_depth depth
+# TYPE test_queue_depth gauge
+test_queue_depth 2
+# HELP test_requests_total served
+# TYPE test_requests_total counter
+test_requests_total{outcome="ok"} 3
+test_requests_total{outcome="rejected:queue_full"} 1
+"""
+    assert r.render_prometheus() == golden
+
+
+# ---------------------------------------------------------------------------
+# Tracing (unit level).
+
+
+def test_trace_tiling_and_summary():
+    clk = FakeClock()
+    tr = Tracer(clock=clk, sink="")
+    t = tr.start_request("r")
+    t.mark("admission")
+    clk.sleep(0.5)
+    t.mark("queue_wait")
+    with t.span("prepare"):
+        clk.sleep(0.25)
+    t.add_span("upload", 0.0, 0.4, concurrent=True)
+    t.event("breaker_trip", rung="corr_kernel")
+    t.finish(status="ok", quality="full")
+    doc = tr.last()
+    s = doc["summary"]
+    assert s["total_ms"] == pytest.approx(750.0)
+    assert s["tiled_ms"] == pytest.approx(750.0)  # concurrent excluded
+    assert s["kinds"]["upload"]["ms"] == pytest.approx(400.0)
+    assert doc["meta"] == {"status": "ok", "quality": "full"}
+    # finish is idempotent: a second resolution cannot double-record
+    t.finish(status="error")
+    assert len(tr.timelines()) == 1
+    assert tr.last()["meta"]["status"] == "ok"
+
+
+def test_tracer_jsonl_sink(tmp_path, monkeypatch):
+    path = tmp_path / "trace.jsonl"
+    monkeypatch.setenv("RAFT_TRACE", str(path))
+    clk = FakeClock()
+    tr = Tracer(clock=clk)  # picks the sink up from RAFT_TRACE
+    for i in range(2):
+        t = tr.start_request(f"r{i}")
+        clk.sleep(0.1)
+        t.mark("queue_wait")
+        t.finish(status="ok")
+    tr.close()
+    lines = [json.loads(line) for line in path.read_text().splitlines()]
+    assert [d["request_id"] for d in lines] == ["r0", "r1"]
+    assert lines[0]["spans"][0]["kind"] == "queue_wait"
+    assert lines[0]["total_ms"] == pytest.approx(100.0)
+
+
+def test_tracer_sink_failure_never_raises(tmp_path):
+    """Telemetry must never take serving down: a bad sink path (or a
+    disk-full mid-run) disables the sink and keeps the ring recording —
+    an escaped exception here would kill the scheduler thread and hang
+    every pending Future."""
+    clk = FakeClock()
+    tr = Tracer(clock=clk, sink=str(tmp_path / "no_such_dir" / "t.jsonl"))
+    t = tr.start_request("r0")
+    t.finish(status="ok")  # must not raise
+    assert tr.status()["sink"] is None  # sink dropped
+    t2 = tr.start_request("r1")
+    t2.finish(status="ok")
+    assert len(tr.timelines()) == 2  # ring unaffected
+
+
+def test_disabled_tracer_is_noop():
+    tr = Tracer(clock=FakeClock(), enabled=False, sink="")
+    t = tr.start_request("x")
+    assert t is NULL_TRACE
+    t.mark("a")
+    with t.span("b"):
+        pass
+    t.event("c")
+    t.finish()
+    assert tr.timelines() == []
+    assert tr.status()["recorded"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Profiler hooks.
+
+
+def test_profiler_disabled_without_dir(monkeypatch):
+    monkeypatch.delenv("RAFT_PROFILE_DIR", raising=False)
+    p = ProfilerWindow()
+    assert not p.enabled
+    assert p.start() is False  # recorded no-op, never raises
+    assert p.stop() is None
+    assert p.status()["refused"] == 1
+
+
+def test_profiler_window_counts(tmp_path, monkeypatch):
+    calls = []
+    monkeypatch.setattr(jax.profiler, "start_trace",
+                        lambda d: calls.append(("start", d)))
+    monkeypatch.setattr(jax.profiler, "stop_trace",
+                        lambda: calls.append(("stop", None)))
+    p = ProfilerWindow(out_dir=str(tmp_path))
+    assert p.start() is True
+    assert p.start() is False  # serialized: refuse a nested window
+    assert p.stop() == str(tmp_path)
+    assert p.stop() is None    # double stop: loser is a no-op
+    with p.window() as opened:
+        assert opened is True
+    assert [c[0] for c in calls] == ["start", "stop", "start", "stop"]
+    st = p.status()
+    assert st["windows"] == 2 and st["active"] is False
+
+
+def test_session_reads_profile_dir_env(tmp_path, monkeypatch, tiny_params,
+                                       tiny_cfg):
+    monkeypatch.setenv("RAFT_PROFILE_DIR", str(tmp_path))
+    sess = make_session(tiny_params, tiny_cfg)
+    assert sess.profiler.enabled
+    assert sess.status()["profiler"]["dir"] == str(tmp_path)
+
+
+# ---------------------------------------------------------------------------
+# Trajectory gate.
+
+
+def test_trajectory_emit_namespaces_and_appends(tmp_path):
+    path = str(tmp_path / "traj.json")
+    tj.emit("m1", 10.0, "requests/s", backend="cpu", path=path)
+    tj.emit("m2", 1.0, "frames/s", backend="tpu", path=path)
+    doc = tj.load(path)
+    assert [e["metric"] for e in doc["entries"]] == ["cpu:m1", "m2"]
+
+
+def test_trajectory_emit_noop_without_target(tmp_path, monkeypatch):
+    monkeypatch.delenv("RAFT_TRAJECTORY", raising=False)
+    assert tj.emit("m", 1.0, "u") is None
+
+
+def test_trajectory_check_bands():
+    doc = {"schema": 1, "entries": [
+        {"metric": "rps", "value": 8.0, "unit": "requests/s"},
+        {"metric": "unpinned", "value": 1.0, "unit": "x"}]}
+    bands = {"schema": 1,
+             "bands": {"rps": {"value": 10.0, "rel_band": 0.2}}}
+    res = tj.check(doc, bands)
+    assert res.ok and res.checked == 1 and res.unpinned == ["unpinned"]
+    doc["entries"][0]["value"] = 7.9  # below 10 * 0.8
+    res = tj.check(doc, bands)
+    assert not res.ok and "rps" in res.failures[0]
+    doc["entries"][0]["value"] = 13.0  # above band: a note, never a fail
+    res = tj.check(doc, bands)
+    assert res.ok and res.notes
+
+
+def test_trajectory_min_only_band_and_malformed_band():
+    doc = {"schema": 1, "entries": [
+        {"metric": "m", "value": 5.0, "unit": "x"}]}
+    # min-only band: a legal explicit floor (no pinned center, no notes)
+    bands = {"schema": 1, "bands": {"m": {"min": 1.0}}}
+    res = tj.check(doc, bands)
+    assert res.ok and res.checked == 1 and not res.notes
+    doc["entries"][0]["value"] = 0.5
+    res = tj.check(doc, bands)
+    assert not res.ok and "explicit min" in res.failures[0]
+    # a band with neither value nor min is malformed -> internal error
+    # (exit 2 via the CLI), never a silent pass
+    with pytest.raises(tj.TrajectoryError):
+        tj.check(doc, {"schema": 1, "bands": {"m": {"rel_band": 0.2}}})
+
+
+def test_trajectory_autopin_never_overwrites():
+    doc = {"schema": 1, "entries": [
+        {"metric": "a", "value": 5.0, "unit": "x"},
+        {"metric": "b", "value": 2.0, "unit": "x"},
+        {"metric": "cpu:c", "value": 9.0, "unit": "x"}]}
+    bands = {"schema": 1, "bands": {"a": {"value": 4.0, "rel_band": 0.2}}}
+    pinned = tj.autopin(doc, bands)
+    assert pinned == ["b"]                       # a existed, cpu:c skipped
+    assert bands["bands"]["a"]["value"] == 4.0   # untouched
+    assert bands["bands"]["b"]["value"] == 2.0
+
+
+def _traj_cli(args):
+    return subprocess.run(
+        [sys.executable, "-m", "raft_stereo_tpu.obs.trajectory"] + args,
+        capture_output=True, text=True)
+
+
+def test_trajectory_gate_cli_fails_out_of_band(tmp_path):
+    """ISSUE 7 acceptance: a synthetic out-of-band requests/s entry fails
+    the gate through the real CLI (the exact command release_gate.sh
+    runs)."""
+    traj = tmp_path / "TRAJECTORY.json"
+    bands = tmp_path / "bands.json"
+    traj.write_text(json.dumps({"schema": 1, "entries": [
+        {"metric": "serve_requests_per_s_tiny", "value": 3.0,
+         "unit": "requests/s", "source": "scratch/bench_serve.py"}]}))
+    bands.write_text(json.dumps({"schema": 1, "bands": {
+        "serve_requests_per_s_tiny": {"value": 10.0, "rel_band": 0.2}}}))
+    res = _traj_cli(["check", str(traj), "--bands", str(bands)])
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert "below the pinned floor" in res.stdout
+    # in-band value passes the same gate
+    traj.write_text(json.dumps({"schema": 1, "entries": [
+        {"metric": "serve_requests_per_s_tiny", "value": 9.5,
+         "unit": "requests/s"}]}))
+    res = _traj_cli(["check", str(traj), "--bands", str(bands)])
+    assert res.returncode == 0, res.stdout + res.stderr
+
+
+def test_trajectory_gate_cli_malformed_is_rc2(tmp_path):
+    traj = tmp_path / "TRAJECTORY.json"
+    traj.write_text("{not json")
+    res = _traj_cli(["check", str(traj), "--bands",
+                     str(tmp_path / "missing_bands.json")])
+    assert res.returncode == 2  # can never read as "clean"
+
+
+# ---------------------------------------------------------------------------
+# Serving integration: the batched span timeline (acceptance bar).
+
+
+def test_batched_request_span_timeline_reconciles(tiny_params, tiny_cfg,
+                                                  pair):
+    """One request through the batched scheduler: >= 6 span kinds incl.
+    per-segment advance ticks; tiled span sum == trace total == reported
+    end-to-end latency, exactly, under FakeClock."""
+    clock = FakeClock()
+    sess = make_session(tiny_params, tiny_cfg, max_batch=4,
+                        valid_iters=4, segments=2, plan=slow_plan(),
+                        clock=clock)
+    with StereoService(sess, ServiceConfig(max_queue=8)) as svc:
+        resp = svc.submit({"id": "r0", "left": pair[0],
+                           "right": pair[1]}).result(timeout=120)
+    assert resp["status"] == "ok" and resp["quality"] == "full"
+    doc = sess.tracer.last()
+    assert doc["meta"]["status"] == "ok"
+    kinds = doc["summary"]["kinds"]
+    # admission, queue_wait, upload, prepare, advance, epilogue, unpad
+    assert set(kinds) >= {"admission", "queue_wait", "upload", "prepare",
+                          "advance", "epilogue", "unpad"}
+    assert kinds["advance"]["count"] == 2          # one per segment tick
+    # prepare + 2 advances + epilogue, TICK injected device time each
+    assert resp["elapsed_ms"] == pytest.approx(4 * TICK * 1e3)
+    assert doc["summary"]["tiled_ms"] == pytest.approx(
+        doc["summary"]["total_ms"])
+    assert doc["summary"]["total_ms"] == pytest.approx(resp["elapsed_ms"])
+
+
+def test_batched_deadline_exit_records_degrade_event(tiny_params, tiny_cfg,
+                                                     pair):
+    clock = FakeClock()
+    sess = make_session(tiny_params, tiny_cfg, max_batch=4,
+                        valid_iters=4, segments=2, plan=slow_plan(),
+                        clock=clock)
+    with StereoService(sess, ServiceConfig(max_queue=8)) as svc:
+        # Warm + seed the EMAs (first request's invokes are warming runs).
+        assert svc.submit({"id": "w0", "left": pair[0],
+                           "right": pair[1]}).result(timeout=120)[
+                               "status"] == "ok"
+        assert svc.submit({"id": "w1", "left": pair[0],
+                           "right": pair[1]}).result(timeout=120)[
+                               "status"] == "ok"
+        # Budget fits prepare + ONE advance (0.5 s) but not a second
+        # (EMA predicts 0.25 * 1.15 overshoot past 0.6).
+        resp = svc.submit({"id": "d", "left": pair[0], "right": pair[1],
+                           "deadline_ms": 600.0}).result(timeout=120)
+    assert resp["status"] == "ok"
+    assert resp["quality"] == "reduced_iters:2"
+    doc = sess.tracer.last()
+    assert doc["meta"]["quality"] == "reduced_iters:2"
+    kinds = doc["summary"]["kinds"]
+    assert kinds["advance"]["count"] == 1
+    assert "degrade" in kinds
+    degrade = [s for s in doc["spans"] if s["kind"] == "degrade"][0]
+    assert degrade["attrs"]["label"] == "reduced_iters:2"
+    assert degrade["attrs"]["reason"] == "predicted_overshoot"
+
+
+def test_sequential_request_span_timeline(tiny_params, tiny_cfg, pair):
+    clock = FakeClock()
+    sess = make_session(tiny_params, tiny_cfg, plan=slow_plan(),
+                        clock=clock)
+    with StereoService(sess, ServiceConfig(max_queue=4, workers=1)) as svc:
+        resp = svc.submit({"id": "s0", "left": pair[0], "right": pair[1],
+                           "deadline_ms": 60_000.0}).result(timeout=120)
+    assert resp["status"] == "ok" and resp["quality"] == "full"
+    doc = sess.tracer.last()
+    kinds = doc["summary"]["kinds"]
+    assert set(kinds) >= {"admission", "queue_wait", "prepare", "segment",
+                          "unpad"}
+    assert kinds["segment"]["count"] == 2
+    assert doc["summary"]["tiled_ms"] == pytest.approx(
+        doc["summary"]["total_ms"])
+    assert doc["summary"]["total_ms"] == pytest.approx(resp["elapsed_ms"])
+
+
+def test_disabled_tracing_serves_and_records_nothing(tiny_params, tiny_cfg,
+                                                     pair):
+    clock = FakeClock()
+    tracer = Tracer(clock=clock, enabled=False, sink="")
+    sess = make_session(tiny_params, tiny_cfg, clock=clock, tracer=tracer)
+    with StereoService(sess, ServiceConfig(max_queue=4)) as svc:
+        resp = svc.submit({"id": "n", "left": pair[0],
+                           "right": pair[1]}).result(timeout=120)
+    assert resp["status"] == "ok"
+    assert tracer.timelines() == []
+
+
+# ---------------------------------------------------------------------------
+# /healthz derives from the registry (no surviving ad-hoc dicts).
+
+
+def test_healthz_is_registry_derived(tiny_params, tiny_cfg, pair):
+    sess = make_session(tiny_params, tiny_cfg)
+    svc = StereoService(sess, ServiceConfig(max_queue=4))
+    with svc:
+        assert svc.submit({"id": "h", "left": pair[0],
+                           "right": pair[1]}).result(timeout=120)[
+                               "status"] == "ok"
+    st = svc.status()
+    assert st["requests"]["ok"] == 1
+    assert st["latency_ms"]["n"] == 1
+    # Byte-for-byte: a registry mutation IS a /healthz mutation — there is
+    # no second store the document could be reading.
+    svc.registry.counter("raft_requests_total", outcome="ok").inc(41)
+    assert svc.status()["requests"]["ok"] == 42
+    sess.registry.counter("raft_session_requests_ok_total").inc(9)
+    assert sess.metrics()["requests_ok"] == 10
+    assert st["session"]["counts"]["requests_ok"] == 1  # pre-mutation copy
+    # the legacy ad-hoc stores are gone
+    assert not hasattr(svc, "_counts") and not hasattr(svc, "_latencies")
+    assert not hasattr(sess, "_metrics")
+
+
+def test_metrics_text_covers_all_subsystems(tiny_params, tiny_cfg, pair):
+    clock = FakeClock()
+    sess = make_session(tiny_params, tiny_cfg, max_batch=4, clock=clock)
+    with StereoService(sess, ServiceConfig(max_queue=8)) as svc:
+        assert svc.submit({"id": "m", "left": pair[0],
+                           "right": pair[1]}).result(timeout=120)[
+                               "status"] == "ok"
+    # After stop() the scheduler is quiesced: the registry is stable, and
+    # /metrics keeps answering (scrapes outlive the worker threads).
+    text = svc.metrics_text()
+    assert '# TYPE raft_requests_total counter' in text
+    assert 'raft_requests_total{outcome="ok"} 1' in text
+    assert "raft_session_compiles_total" in text
+    assert "raft_sched_ticks_total" in text
+    assert "# TYPE raft_request_latency_seconds summary" in text
+    assert "raft_program_calls_total" in text
+    # scheduler /healthz numbers equal the rendered series
+    b = svc.status()["batching"]
+    assert f"raft_sched_ticks_total {b['ticks']}" in text
+
+
+def test_program_device_host_split_recorded(tiny_params, tiny_cfg, pair):
+    """Per-program-kind device-vs-host time: the injected device time
+    lands in the device counter of the kind that ran it (steady-state
+    invocations only; warmups are compile-inclusive and binned apart)."""
+    clock = FakeClock()
+    sess = make_session(tiny_params, tiny_cfg, plan=slow_plan(),
+                        clock=clock)
+    # Two identical requests: the first warms, the second is steady.
+    sess.infer(*[p[None] for p in pair])
+    sess.infer(*[p[None] for p in pair])
+    dev = sess.registry.value("raft_program_device_seconds_total",
+                              kind="full")
+    warm = sess.registry.value("raft_program_warmup_seconds_total",
+                               kind="full")
+    assert dev == pytest.approx(TICK)   # one steady invocation
+    assert warm == pytest.approx(TICK)  # one warming invocation
+    assert sess.registry.value("raft_program_calls_total", kind="full") == 2
+
+
+def test_breaker_trip_counter_in_registry(tiny_params, tiny_cfg, pair):
+    from raft_stereo_tpu.faults import ServeFaultPlan
+    plan = ServeFaultPlan(compile_errors={0: "mosaic:gru1632"})
+    sess = make_session(tiny_params, tiny_cfg, plan=plan)
+    sess.infer(*[p[None] for p in pair])  # walks one rung, then serves
+    assert sess.registry.value("raft_breaker_trips_total",
+                               rung="fuse_gru1632",
+                               reason="compile_failure") == 1
+    doc = sess.tracer.last()
+    assert doc is None  # direct session.infer without a service trace
