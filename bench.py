@@ -137,9 +137,8 @@ def _trace_device_seconds(trace_dir: str):
     return total / 1e6 if total else None
 
 
-# Peak bf16 TFLOP/s by device kind (MFU denominator).
-_PEAK_FLOPS = {"TPU v5 lite": 197e12, "TPU v5e": 197e12,
-               "TPU v4": 275e12, "TPU v5p": 459e12, "TPU v6e": 918e12}
+# The chip peak table (MFU denominator) lives in obs/ledger.py now —
+# graftscope-device's roofline tables and this bench share one source.
 
 
 def main() -> None:
@@ -188,6 +187,17 @@ def main() -> None:
         # statistics: the signed sum and the cancellation-proof sum |d|.
         return flow_up, jnp.sum(flow_up), jnp.sum(jnp.abs(flow_up))
 
+    # graftscope-device: the headline program's cost/memory account comes
+    # from the ledger, not hand-rolled tables — AOT lower+compile (the
+    # same one compile the first jit call would pay) keeps the Compiled
+    # handle so cost_analysis/memory_analysis feed a ledger row, exactly
+    # like InferenceSession does for serving programs.
+    from raft_stereo_tpu.obs.ledger import (ProgramLedger, analyze_compiled,
+                                            chip_peaks)
+    ledger = ProgramLedger()
+    ledger_key = ("bench_full", batch, h, w, iters, corr)
+    device_kind = jax.devices()[0].device_kind
+
     rng = np.random.default_rng(0)
 
     def frame():
@@ -205,12 +215,25 @@ def main() -> None:
                 f"non-finite disparity checksum {checksum} / {sum_abs}")
         return checksum, sum_abs
 
-    def run(img1, img2):
-        return fetch_and_check(*forward(params, img1, img2)[1:])
-
     # Warmup: compile + one steady-state frame (reference discards frames 1-50;
-    # under jit a single post-compile frame reaches steady state).
+    # under AOT/jit a single post-compile frame reaches steady state). The
+    # ledger row is recorded at compile time; if the AOT API is
+    # unavailable the row carries no compiler numbers (graceful absence)
+    # and plain jit dispatch serves the bench unchanged.
     img1, img2 = frame()
+    try:
+        fwd = forward.lower(params, img1, img2).compile()
+        analysis = analyze_compiled(fwd)
+    except (TypeError, AttributeError, NotImplementedError):
+        fwd, analysis = forward, {}
+    row = ledger.record(ledger_key, kind="full", b=batch, h=h, w=w,
+                        iters=iters, analysis=analysis,
+                        backend=jax.default_backend(),
+                        device_kind=device_kind)
+
+    def run(img1, img2):
+        return fetch_and_check(*fwd(params, img1, img2)[1:])
+
     run(img1, img2)
     run(img1, img2)
 
@@ -230,7 +253,12 @@ def main() -> None:
     except Exception:  # noqa: BLE001 - diagnostics only
         pass
 
-    flops = None
+    # The ledger row's raw compiler flops count the refinement scan body
+    # ONCE (the r6 finding, re-verified for compiled programs in r12), so
+    # the headline row — a "full" program mixing encoders, scan and
+    # epilogue — carries no per-invocation estimate until this bench
+    # ANNOTATES it from the unrolled-slope measurement below. MFU is then
+    # read back off the ledger row, never hand-assembled.
     try:
         # Algorithmic flops from the XLA-twin program (fused_update off,
         # XLA corr): the production path's Pallas custom calls are
@@ -272,7 +300,9 @@ def main() -> None:
                              capture_output=True, text=True, timeout=300)
         for line in out.stdout.splitlines():
             if line.startswith("FLOPS "):
-                flops = float(line.split()[1]) or None
+                slope = float(line.split()[1]) or None
+                if slope:
+                    ledger.annotate(ledger_key, flops_est=slope)
     except Exception:  # noqa: BLE001 - diagnostics only
         pass
 
@@ -291,7 +321,7 @@ def main() -> None:
     # instead of per frame. The reference's own timing never synchronizes
     # per frame at all (the loop's only sync is the metric .cpu() fetch).
     t0 = time.perf_counter()
-    pending = [forward(params, img1, img2)[1:] for _ in range(n_frames)]
+    pending = [fwd(params, img1, img2)[1:] for _ in range(n_frames)]
     checksum = sum_abs = None
     for c in pending:
         checksum, sum_abs = fetch_and_check(*c)
@@ -331,14 +361,14 @@ def main() -> None:
             except (OSError, ValueError):
                 pass
 
-    kind = jax.devices()[0].device_kind
-    peak = next((v for k, v in _PEAK_FLOPS.items() if k in kind), None)
-    # MFU against the device-time of one dispatch (falls back to wall):
-    # XLA cost_analysis counts the algorithmic flops of the lowered
-    # program, which like the trace covers one dispatch (= ``batch``
-    # frames).
+    # MFU read off the ledger row (annotated flops_est over the device
+    # time of one dispatch, against the shared chip peak table; falls
+    # back to wall time when no profiler trace parsed). Absent inputs =>
+    # absent MFU — the ledger contract, never a fabricated ratio.
+    peaks = chip_peaks(device_kind)
     dispatch_s = device_s if device_s else elapsed / n_frames
-    mfu = (flops / dispatch_s / peak) if (flops and peak) else None
+    flops = row.flops_est
+    mfu = (flops / dispatch_s / peaks[0]) if (flops and peaks) else None
 
     doc = {
         "metric": (f"middlebury_F_disparity_fps_per_chip_{iters}iters_"
@@ -352,17 +382,24 @@ def main() -> None:
         "device_s": round(device_s, 4) if device_s else None,
         "flops": flops,
         "mfu": round(mfu, 4) if mfu else None,
+        "peak_hbm_bytes": row.peak_hbm_bytes,
+        "roofline": row.roofline(peaks),
     }
     print(json.dumps(doc))
 
     # Perf-trajectory gate (DESIGN.md r11): when RAFT_TRAJECTORY is
     # exported (the release gate does), the headline fps lands in the
     # consolidated TRAJECTORY.json next to requests/s and steps/s, where
-    # the per-metric pinned bands catch a regression in ANY of them.
+    # the per-metric pinned bands catch a regression in ANY of them. The
+    # ledger extras (flops/bytes/mfu) ride along as UNPINNED diagnostics:
+    # autopin copies them into the band so an out-of-band failure can say
+    # "program changed" vs "machine drifted" — the value pin and the
+    # checksum pins above are untouched by any of this.
     from raft_stereo_tpu.obs.trajectory import emit
     emit(doc["metric"], fps, "frames/s",
          backend=jax.default_backend(), source="bench.py",
-         extra={"mfu": doc["mfu"], "device_s": doc["device_s"]})
+         extra={"mfu": doc["mfu"], "device_s": doc["device_s"],
+                "flops": flops, "bytes": row.bytes_accessed})
 
 
 if __name__ == "__main__":

@@ -77,6 +77,11 @@ HOST_ENV_KNOBS: Tuple[str, ...] = (
                             # (obs/profiler.py, read at construction)
     "RAFT_TRAJECTORY",      # perf-trajectory artifact the benches emit
                             # into (obs/trajectory.py emit(), read per call)
+    "RAFT_FLIGHT_DIR",      # SLO flight-record output dir (obs/flight.py
+                            # FlightRecorder, read at construction)
+    "RAFT_LEDGER",          # device-ledger dump target the serve bench
+                            # writes for the gate's report step
+                            # (obs/ledger.py dump_path(), read per call)
 )
 
 

@@ -36,11 +36,21 @@ TRAIN_STEP_DONATE: Tuple[int, ...] = (0, 1)
 
 
 def make_train_step(cfg: RAFTStereoConfig, tx: optax.GradientTransformation,
-                    train_iters: int, mesh: Optional[Mesh] = None):
+                    train_iters: int, mesh: Optional[Mesh] = None,
+                    ledger=None):
     """Returns ``train_step(params, opt_state, batch) -> (params, opt_state, metrics)``.
 
     batch: dict with ``image1``, ``image2`` (B,H,W,3), ``flow`` (B,H,W,1),
     ``valid`` (B,H,W).
+
+    ledger: an optional :class:`~raft_stereo_tpu.obs.ledger.ProgramLedger`
+    (graftscope-device). When given, the step is compiled ahead of time on
+    first call (same one compile, donation preserved) and its compiler
+    cost/memory account lands in the ledger under the ``train_step`` key —
+    in particular ``temp_bytes``/``peak_hbm_bytes``, the number the
+    donation contract (``TRAIN_STEP_DONATE``, GV105) exists to keep flat.
+    ``scan_scale`` is ``None``: the step mixes scan and non-scan stages,
+    so no per-invocation flop estimate is honest (see DESIGN.md r12).
     """
     cfg = mesh_safe_cfg(cfg, mesh)
     from raft_stereo_tpu.parallel.mesh import space_mesh_of
@@ -74,14 +84,19 @@ def make_train_step(cfg: RAFTStereoConfig, tx: optax.GradientTransformation,
         return params, opt_state, metrics
 
     if mesh is None:
-        return jax.jit(step, donate_argnums=TRAIN_STEP_DONATE)
-
-    repl, bsh = replicated(mesh), data_sharding(mesh)
-    return jax.jit(
-        step,
-        in_shardings=(repl, repl, bsh),
-        out_shardings=(repl, repl, repl),
-        donate_argnums=TRAIN_STEP_DONATE)
+        jitted = jax.jit(step, donate_argnums=TRAIN_STEP_DONATE)
+    else:
+        repl, bsh = replicated(mesh), data_sharding(mesh)
+        jitted = jax.jit(
+            step,
+            in_shardings=(repl, repl, bsh),
+            out_shardings=(repl, repl, repl),
+            donate_argnums=TRAIN_STEP_DONATE)
+    if ledger is None:
+        return jitted
+    from raft_stereo_tpu.obs.ledger import AotLedgerFn
+    return AotLedgerFn(jitted, ledger, ("train_step", train_iters),
+                       kind="train_step", iters=train_iters)
 
 
 def make_eval_step(cfg: RAFTStereoConfig, valid_iters: int,

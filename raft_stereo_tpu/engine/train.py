@@ -233,7 +233,17 @@ def train(cfg: RAFTStereoConfig, tcfg: TrainConfig,
         local_rows = local_batch_rows(mesh, tcfg.batch_size)
     train_loader = fetch_dataloader(tcfg, root=data_root,
                                     local_rows=local_rows)
-    train_step = make_train_step(cfg, tx, tcfg.train_iters, mesh=mesh)
+    # graftscope-device: the lead records the train step's compiler
+    # cost/memory account (flops, peak HBM — the donation contract's
+    # observable) and dumps it next to the TensorBoard events at close.
+    # Non-lead processes keep plain jit dispatch: the ledger is telemetry,
+    # and one writer per run is enough.
+    ledger = None
+    if is_lead:
+        from raft_stereo_tpu.obs.ledger import ProgramLedger
+        ledger = ProgramLedger()
+    train_step = make_train_step(cfg, tx, tcfg.train_iters, mesh=mesh,
+                                 ledger=ledger)
     if is_lead:
         from raft_stereo_tpu.obs.metrics import MetricsRegistry
         log = Logger(scheduler=schedule, registry=MetricsRegistry())
@@ -430,6 +440,17 @@ def train(cfg: RAFTStereoConfig, tcfg: TrainConfig,
     finally:
         log.close()
         guard.restore()
+        if ledger is not None and len(ledger):
+            # Device-ledger artifact next to the training telemetry
+            # (metrics.prom/TensorBoard): what the compiled step cost.
+            from raft_stereo_tpu.obs.ledger import save_doc
+            try:
+                os.makedirs(log.log_dir, exist_ok=True)
+                save_doc(ledger.to_doc(backend=jax.default_backend()),
+                         os.path.join(log.log_dir, "ledger.json"))
+            except OSError:
+                logger.exception("could not write ledger.json "
+                                 "(training result is unaffected)")
     quarantined = getattr(train_loader, "quarantine_report", dict)()
     if quarantined:
         logger.warning("quarantine report: %d sample(s) substituted: %s",

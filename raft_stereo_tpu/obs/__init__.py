@@ -12,12 +12,24 @@ Four pieces, one contract (DESIGN.md "Observability (r11)"):
   windows (``RAFT_PROFILE_DIR``);
 - :mod:`~raft_stereo_tpu.obs.trajectory` — the consolidated
   perf-trajectory gate (``TRAJECTORY.json`` + pinned bands) folding
-  fps/chip, requests/s and steps/s into one release-gate verdict.
+  fps/chip, requests/s and steps/s into one release-gate verdict;
+- :mod:`~raft_stereo_tpu.obs.ledger` — graftscope-device: the
+  compiler-derived cost/memory ledger per compiled program, the chip
+  peak flops/bandwidth tables, per-program-kind MFU attribution and the
+  ``obs.ledger report`` CLI (DESIGN.md "Device observability (r12)");
+- :mod:`~raft_stereo_tpu.obs.flight` — the SLO flight recorder: bounded
+  per-breach artifacts (timeline + ledger rows + registry snapshot)
+  persisted to ``RAFT_FLIGHT_DIR``.
 
 Import-light: nothing here imports jax at module scope (the registry and
 trajectory tooling run in the linter's jax-free environment).
 """
 
+# obs.ledger is deliberately NOT imported here (same as obs.trajectory):
+# both are `python -m` entry points, and importing them from the package
+# __init__ would trip runpy's already-in-sys.modules warning on every CLI
+# invocation. Import them by module path.
+from raft_stereo_tpu.obs.flight import FlightRecorder
 from raft_stereo_tpu.obs.metrics import (Counter, Gauge, Histogram,
                                          MetricsRegistry)
 from raft_stereo_tpu.obs.profiler import ProfilerWindow
@@ -26,6 +38,6 @@ from raft_stereo_tpu.obs.tracing import (NULL_TRACE, RequestTrace, Span,
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
-    "ProfilerWindow",
+    "ProfilerWindow", "FlightRecorder",
     "NULL_TRACE", "RequestTrace", "Span", "Tracer",
 ]

@@ -1,0 +1,561 @@
+"""Program ledger: compiler-derived cost/memory accounting per program.
+
+graftscope (r11) can say *that* fps/chip or requests/s moved; this module
+is the device-facing half that says *why*.  At compile time every program
+the serving session (or the train loop) builds feeds its compiled
+executable's ``cost_analysis()`` / ``memory_analysis()`` into ONE ledger,
+keyed by the exact program-cache key, so the repo finally has a
+machine-readable answer to three questions the ROADMAP keeps asking:
+
+- **what does each compiled program cost** (flops, HBM bytes accessed,
+  argument/output/temp bytes, peak HBM while running) — straight from
+  the compiler, ``None`` where a backend doesn't report (the CPU backend
+  reports cost but thin memory stats; the contract is graceful absence,
+  never a fabricated number);
+- **what MFU does each program KIND achieve** — joining the ledger's
+  per-invocation flop estimates (accumulated into
+  ``raft_program_flops_total{kind=}`` by the session) against graftscope's
+  ``raft_program_device_seconds_total{kind=}`` and the chip's peak-flops
+  table yields per-kind MFU and a roofline class (compute- vs HBM-bound
+  against peak flops / peak HBM bandwidth). MFU is reported **absent**
+  whenever any join input is missing or zero — never divided into a lie;
+- **does the warm program set fit HBM** — the session sums the ledger's
+  peak-HBM column over its LRU cache per shape bucket (``/healthz``
+  ``cache_hbm``), the question ROADMAP item 1 must answer before
+  multiplying the bucket ladder by N chips.
+
+**The scan caveat (measured, not assumed).** XLA's cost analysis counts a
+``while``-loop body ONCE regardless of trip count (verified at 2 vs 8
+scan iterations: identical flops — the same undercount ``bench.py`` found
+in r6 and worked around with unrolled-slope extrapolation).  Ledger rows
+therefore carry the RAW compiler numbers in ``flops``/``bytes_accessed``
+plus a declared ``scan_scale``: the multiplier that converts
+body-counted-once numbers into per-invocation estimates
+(``flops_est = flops * scan_scale``).  Program kinds whose entire body
+rides the refinement scan declare ``scan_scale = iters`` (``segment``,
+``advance``); scan-free kinds declare ``1`` (``prepare``, ``epilogue``);
+kinds mixing scan and non-scan stages (``full``, the train step) declare
+``None`` and get NO estimate unless explicitly annotated (``bench.py``
+annotates its headline row from the unrolled-slope measurement) — an
+honest absence beats a 32x-wrong MFU.
+
+Import-light on purpose (stdlib only at module scope): the report CLI and
+the linter run without jax; ``analyze_compiled()`` only pokes at an
+already-compiled object with getattr.
+
+CLI::
+
+    python -m raft_stereo_tpu.obs.ledger report LEDGER.json [--json]
+
+exits 0 when every cached program has a ledger row, 1 when the dump
+reports missing rows (the release-gate completeness bar), 2 on a
+malformed file (never silently clean).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import hashlib
+import json
+import logging
+import os
+import sys
+import threading
+from typing import Dict, Iterable, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+SCHEMA = 1
+
+# -- chip peak tables ---------------------------------------------------------
+
+#: Peak dense bf16 TFLOP/s by device kind (the MFU denominator). Matched
+#: by substring of ``jax.devices()[0].device_kind``; moved here from
+#: bench.py so the bench and the serving ledger share one table.
+PEAK_FLOPS: Dict[str, float] = {
+    "TPU v5 lite": 197e12, "TPU v5e": 197e12,
+    "TPU v4": 275e12, "TPU v5p": 459e12, "TPU v6e": 918e12,
+}
+
+#: Peak HBM bandwidth, bytes/s — the roofline's other axis.
+PEAK_HBM_BW: Dict[str, float] = {
+    "TPU v5 lite": 819e9, "TPU v5e": 819e9,
+    "TPU v4": 1228e9, "TPU v5p": 2765e9, "TPU v6e": 1640e9,
+}
+
+#: HBM capacity, bytes — the cache-accounting ceiling ("will this bucket
+#: ladder fit one chip").
+HBM_BYTES: Dict[str, float] = {
+    "TPU v5 lite": 16 * 2**30, "TPU v5e": 16 * 2**30,
+    "TPU v4": 32 * 2**30, "TPU v5p": 95 * 2**30, "TPU v6e": 32 * 2**30,
+}
+
+
+def chip_peaks(device_kind: Optional[str]
+               ) -> Optional[Tuple[float, float]]:
+    """(peak_flops_per_s, peak_hbm_bytes_per_s) for a device kind, or
+    ``None`` when the chip is not in the table (CPU/GPU hosts: their
+    ledger rows are machine-local diagnostics, namespaced by ``backend``
+    in every dump, and their MFU is reported absent rather than computed
+    against a made-up peak — exactly like the ``cpu:``-namespaced metric
+    keys the trajectory gate never pins)."""
+    if not device_kind:
+        return None
+    for k, f in PEAK_FLOPS.items():
+        if k in device_kind:
+            return f, PEAK_HBM_BW[k]
+    return None
+
+
+def hbm_capacity(device_kind: Optional[str]) -> Optional[float]:
+    if not device_kind:
+        return None
+    for k, v in HBM_BYTES.items():
+        if k in device_kind:
+            return v
+    return None
+
+
+# -- compiled-program analysis extraction ------------------------------------
+
+#: memory_analysis() attribute -> row field. Every value is optional: a
+#: backend that doesn't implement the stat yields None, never 0.
+_MEMORY_FIELDS = (
+    ("argument_bytes", "argument_size_in_bytes"),
+    ("output_bytes", "output_size_in_bytes"),
+    ("temp_bytes", "temp_size_in_bytes"),
+    ("alias_bytes", "alias_size_in_bytes"),
+    ("generated_code_bytes", "generated_code_size_in_bytes"),
+)
+
+
+def analyze_compiled(compiled) -> Dict[str, Optional[float]]:
+    """Extract {flops, bytes_accessed, argument/output/temp/alias/
+    generated_code bytes} from a jax ``Compiled``.  Every key degrades to
+    ``None`` independently: older jax returns cost_analysis as a
+    one-element list, some backends return nothing, XLA reports -1 for
+    "unknown" — none of those may crash serving or fabricate a zero."""
+    out: Dict[str, Optional[float]] = {
+        "flops": None, "bytes_accessed": None}
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:  # noqa: BLE001 — telemetry never takes serving down
+        ca = None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if isinstance(ca, dict):
+        for field, key in (("flops", "flops"),
+                           ("bytes_accessed", "bytes accessed")):
+            v = ca.get(key)
+            if isinstance(v, (int, float)) and v > 0:
+                out[field] = float(v)
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:  # noqa: BLE001 — same boundary
+        ma = None
+    for field, attr in _MEMORY_FIELDS:
+        v = getattr(ma, attr, None) if ma is not None else None
+        out[field] = float(v) if isinstance(v, (int, float)) and v >= 0 \
+            else None
+    return out
+
+
+def ledger_id(key) -> str:
+    """Short stable display id for a program-cache key: the session's
+    ``kind@b<b>:<h>x<w>/it<iters>`` status format plus an 8-hex-char hash
+    of the FULL key (fingerprint included), so two configs sharing a
+    geometry still get distinct rows in traces and flight records."""
+    digest = hashlib.sha1(repr(key).encode()).hexdigest()[:8]
+    if (isinstance(key, tuple) and len(key) == 6
+            and isinstance(key[0], str)):
+        kind, b, h, w, iters, _fp = key
+        return f"{kind}@b{b}:{h}x{w}/it{iters}#{digest}"
+    head = key[0] if isinstance(key, tuple) and key else key
+    return f"{head}#{digest}"
+
+
+# -- the ledger ---------------------------------------------------------------
+
+@dataclasses.dataclass
+class LedgerRow:
+    """One compiled program's compiler-derived account.  ``flops`` /
+    ``bytes_accessed`` are the RAW compiler numbers (scan bodies counted
+    once — see the module docstring); ``flops_est`` / ``bytes_est`` are
+    the per-invocation estimates after ``scan_scale``, ``None`` when the
+    structure makes an estimate dishonest."""
+
+    id: str
+    kind: str
+    b: int = 1
+    h: Optional[int] = None
+    w: Optional[int] = None
+    iters: int = 0
+    scan_scale: Optional[int] = None
+    backend: Optional[str] = None
+    device_kind: Optional[str] = None
+    flops: Optional[float] = None
+    bytes_accessed: Optional[float] = None
+    argument_bytes: Optional[float] = None
+    output_bytes: Optional[float] = None
+    temp_bytes: Optional[float] = None
+    alias_bytes: Optional[float] = None
+    generated_code_bytes: Optional[float] = None
+    flops_est: Optional[float] = None
+    bytes_est: Optional[float] = None
+
+    @property
+    def peak_hbm_bytes(self) -> Optional[float]:
+        """Device-memory footprint while the program runs: arguments +
+        outputs + temporaries minus aliased buffers. ``None`` when the
+        backend reported no memory stats at all (an all-None row) —
+        absent, not zero, so cache accounting can say "unknown"."""
+        parts = [self.argument_bytes, self.output_bytes, self.temp_bytes]
+        if all(p is None for p in parts):
+            return None
+        total = sum(p for p in parts if p is not None)
+        return total - (self.alias_bytes or 0.0)
+
+    def intensity(self) -> Optional[float]:
+        """Arithmetic intensity flop/byte (scan scale cancels, so the raw
+        compiler numbers are the honest numerator/denominator)."""
+        if self.flops and self.bytes_accessed:
+            return self.flops / self.bytes_accessed
+        return None
+
+    def roofline(self, peaks: Optional[Tuple[float, float]]
+                 ) -> Optional[str]:
+        """'compute-bound' / 'hbm-bound' against the chip ridge point;
+        ``None`` off the table (CPU) or without compiler numbers."""
+        inten = self.intensity()
+        if peaks is None or inten is None:
+            return None
+        ridge = peaks[0] / peaks[1]
+        return "compute-bound" if inten >= ridge else "hbm-bound"
+
+    def to_dict(self) -> Dict:
+        d = dataclasses.asdict(self)
+        d["peak_hbm_bytes"] = self.peak_hbm_bytes
+        d["intensity"] = self.intensity()
+        d["roofline"] = self.roofline(chip_peaks(self.device_kind))
+        return d
+
+
+def _derive_estimates(row: LedgerRow) -> None:
+    if row.scan_scale is not None:
+        if row.flops is not None:
+            row.flops_est = row.flops * row.scan_scale
+        if row.bytes_accessed is not None:
+            row.bytes_est = row.bytes_accessed * row.scan_scale
+
+
+class ProgramLedger:
+    """Thread-safe map from the EXACT program-cache key to its
+    :class:`LedgerRow`.  The session records at compile (warm) time and
+    drops on LRU eviction; readers (``/healthz``, flight records, dumps)
+    see a consistent snapshot."""
+
+    def __init__(self):
+        self._rows: Dict[object, LedgerRow] = {}
+        self._lock = threading.Lock()
+
+    def record(self, key, *, kind: str, b: int = 1,
+               h: Optional[int] = None, w: Optional[int] = None,
+               iters: int = 0, scan_scale: Optional[int] = None,
+               analysis: Optional[Dict[str, Optional[float]]] = None,
+               backend: Optional[str] = None,
+               device_kind: Optional[str] = None) -> LedgerRow:
+        row = LedgerRow(id=ledger_id(key), kind=kind, b=b, h=h, w=w,
+                        iters=iters, scan_scale=scan_scale,
+                        backend=backend, device_kind=device_kind)
+        for field, value in (analysis or {}).items():
+            if field in LedgerRow.__dataclass_fields__:
+                setattr(row, field, value)
+        _derive_estimates(row)
+        with self._lock:
+            self._rows[key] = row
+        return row
+
+    def annotate(self, key, **fields) -> Optional[LedgerRow]:
+        """Attach out-of-band estimates to an existing row (``bench.py``
+        writes its unrolled-slope ``flops_est`` here). Unknown keys are a
+        no-op returning None — annotation is advisory telemetry."""
+        with self._lock:
+            row = self._rows.get(key)
+            if row is None:
+                return None
+            for f, v in fields.items():
+                if f in LedgerRow.__dataclass_fields__:
+                    setattr(row, f, v)
+            return row
+
+    def drop(self, key) -> Optional[LedgerRow]:
+        with self._lock:
+            return self._rows.pop(key, None)
+
+    def row(self, key) -> Optional[LedgerRow]:
+        with self._lock:
+            return self._rows.get(key)
+
+    def rows(self) -> List[LedgerRow]:
+        with self._lock:
+            return list(self._rows.values())
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._rows)
+
+    def rows_by_id(self, ids: Iterable[str]) -> List[Dict]:
+        wanted = set(ids)
+        return [r.to_dict() for r in self.rows() if r.id in wanted]
+
+    # -- the MFU join ------------------------------------------------------
+
+    def attribution(self, registry, *, device_kind: Optional[str] = None,
+                    peaks: Optional[Tuple[float, float]] = None) -> Dict:
+        """Per-program-kind MFU/roofline: join the session-accumulated
+        ``raft_program_flops_total`` / ``raft_program_hbm_bytes_total``
+        counters with graftscope's ``raft_program_device_seconds_total``
+        and the chip peak table.  Every output is ``None`` unless ALL of
+        its inputs exist and are positive — zero device-seconds, a
+        missing peak entry (CPU) or scan-opaque flops yield an absent
+        MFU, never a division."""
+        if peaks is None:
+            peaks = chip_peaks(device_kind)
+        kinds = {r.kind for r in self.rows()}
+        kinds |= {labels.get("kind") for labels, _ in
+                  registry.series("raft_program_device_seconds_total")}
+        out: Dict[str, Dict] = {}
+        for kind in sorted(k for k in kinds if k):
+            flops = registry.value("raft_program_flops_total", kind=kind)
+            hbm = registry.value("raft_program_hbm_bytes_total", kind=kind)
+            secs = registry.value("raft_program_device_seconds_total",
+                                  kind=kind)
+            calls = registry.value("raft_program_calls_total", kind=kind)
+            mfu = (flops / secs / peaks[0]
+                   if peaks and flops > 0 and secs > 0 else None)
+            bw_util = (hbm / secs / peaks[1]
+                       if peaks and hbm > 0 and secs > 0 else None)
+            roofline = None
+            if peaks and flops > 0 and hbm > 0:
+                roofline = ("compute-bound"
+                            if flops / hbm >= peaks[0] / peaks[1]
+                            else "hbm-bound")
+            out[kind] = {"calls": calls, "device_seconds": secs,
+                         "flops": flops or None, "hbm_bytes": hbm or None,
+                         "mfu": mfu, "hbm_bw_util": bw_util,
+                         "roofline": roofline}
+        return out
+
+    # -- dumps -------------------------------------------------------------
+
+    def to_doc(self, *, cache_keys: Iterable = (),
+               backend: Optional[str] = None,
+               device_kind: Optional[str] = None,
+               attribution: Optional[Dict] = None,
+               cache_hbm: Optional[Dict] = None) -> Dict:
+        """JSON-able dump + the completeness verdict the release gate
+        enforces: every live cache key must have a ledger row."""
+        cache_ids = [ledger_id(k) for k in cache_keys]
+        with self._lock:
+            have = {ledger_id(k) for k in self._rows}
+            rows = [r.to_dict() for r in self._rows.values()]
+        missing = sorted(i for i in cache_ids if i not in have)
+        return {"schema": SCHEMA, "backend": backend,
+                "device_kind": device_kind,
+                "hbm_capacity_bytes": hbm_capacity(device_kind),
+                "rows": rows, "cache": cache_ids, "missing": missing,
+                "complete": not missing,
+                "attribution": attribution or {},
+                "cache_hbm": cache_hbm or {}}
+
+
+def dump_path() -> Optional[str]:
+    """The ``RAFT_LEDGER`` dump target (function-scope read — GL001):
+    when the release gate exports it, the serve bench writes its
+    session's ledger doc there for the gate's report step."""
+    return os.environ.get("RAFT_LEDGER") or None
+
+
+def save_doc(doc: Dict, path: str) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+# -- AOT wrapper for the train/eval steps ------------------------------------
+
+class AotLedgerFn:
+    """Wrap a jitted callable: the FIRST call lowers + compiles ahead of
+    time to harvest the compiled program's analyses into the ledger, then
+    EVERY call (first included) executes via plain jit dispatch.
+
+    Why not execute the AOT executable directly, like the serving
+    session does?  The train step donates (params, opt_state), and its
+    output aliases identical rank-0 counters into one buffer (the GV105
+    scalar exemption) — feeding that back through ``Compiled.__call__``
+    is a hard XLA "donate the same buffer twice" error, while jit
+    dispatch deduplicates donated buffers (measured on the real step).
+    Serving programs donate nothing, which is why the session CAN run
+    its AOT executables.  The jit call after the AOT compile re-traces
+    but hits jax's in-process compilation cache (measured: ~7x cheaper
+    than a fresh compile; the XLA-compile half is not paid twice).
+
+    Not thread-safe by design: the train loop (its only caller) is
+    single-threaded; the serving session does its own AOT under the
+    program compile lock.
+    """
+
+    def __init__(self, jitted, ledger: ProgramLedger, key, *, kind: str,
+                 iters: int = 0, scan_scale: Optional[int] = None):
+        self._jitted = jitted
+        self._ledger = ledger
+        self._key = key
+        self._kind = kind
+        self._iters = iters
+        self._scan_scale = scan_scale
+        self._recorded = False
+
+    def _record(self, args) -> None:
+        import jax
+        backend = jax.default_backend()
+        device_kind = jax.devices()[0].device_kind
+        try:
+            compiled = self._jitted.lower(*args).compile()
+            analysis = analyze_compiled(compiled)
+        except Exception as e:  # noqa: BLE001 — telemetry-only compile
+            # The AOT compile here is PURE telemetry (execution always
+            # goes through jit dispatch, which compiles for itself), so
+            # ANY failure degrades to an empty row instead of taking the
+            # train loop down. Not hypothetical: on a multi-process CPU
+            # pod the AOT path raises "Multiprocess computations aren't
+            # implemented on the CPU backend" while jit dispatch trains
+            # fine (caught live by tests/test_multihost.py). The serving
+            # session is the opposite case — there the AOT executable IS
+            # the execution path, so its compile errors must propagate to
+            # the breaker.
+            logger.warning(
+                "ledger AOT compile unavailable for %s (%s: %s) — "
+                "recording an empty row; training is unaffected",
+                self._kind, type(e).__name__, e)
+            analysis = {}
+        self._ledger.record(self._key, kind=self._kind,
+                            iters=self._iters, scan_scale=self._scan_scale,
+                            analysis=analysis, backend=backend,
+                            device_kind=device_kind)
+
+    def __call__(self, *args):
+        if not self._recorded:
+            self._recorded = True
+            self._record(args)
+        return self._jitted(*args)
+
+
+# -- CLI ----------------------------------------------------------------------
+
+def _fmt_num(v: Optional[float]) -> str:
+    if v is None:
+        return "-"
+    if abs(v) >= 1e12:
+        return f"{v / 1e12:.2f}T"
+    if abs(v) >= 1e9:
+        return f"{v / 1e9:.2f}G"
+    if abs(v) >= 1e6:
+        return f"{v / 1e6:.2f}M"
+    return f"{v:.4g}"
+
+
+def _fmt_bytes(v: Optional[float]) -> str:
+    return "-" if v is None else f"{v / 2**20:.1f}MiB"
+
+
+def load_doc(path: str) -> Dict:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except OSError as e:
+        raise ValueError(f"cannot read {path}: {e}") from e
+    except ValueError as e:
+        raise ValueError(f"{path} is not valid JSON: {e}") from e
+    if not isinstance(doc, dict) or doc.get("schema") != SCHEMA or \
+            not isinstance(doc.get("rows"), list):
+        raise ValueError(
+            f"{path} is not a schema-{SCHEMA} ledger dump "
+            "({'schema': 1, 'rows': [...]})")
+    # Element-level validation: a truncated/corrupted dump whose rows are
+    # not id-carrying dicts must be exit 2 (malformed), not a misleading
+    # exit-1 completeness failure with a traceback.
+    for r in doc["rows"]:
+        if not isinstance(r, dict) or not isinstance(r.get("id"), str):
+            raise ValueError(
+                f"{path}: malformed ledger row {r!r} (rows must be "
+                "dicts carrying a string 'id')")
+    return doc
+
+
+def _cmd_report(args) -> int:
+    doc = load_doc(args.ledger)
+    if args.json:
+        print(json.dumps(doc, indent=1, sort_keys=True))
+    else:
+        print(f"ledger: {len(doc['rows'])} row(s), backend="
+              f"{doc.get('backend')}, device={doc.get('device_kind')}")
+        hdr = (f"{'program':<34} {'flops':>8} {'flops_est':>9} "
+               f"{'bytes':>8} {'peak_hbm':>10} {'roofline':>13}")
+        print(hdr)
+        for r in sorted(doc["rows"], key=lambda r: r["id"]):
+            print(f"{r['id']:<34} {_fmt_num(r.get('flops')):>8} "
+                  f"{_fmt_num(r.get('flops_est')):>9} "
+                  f"{_fmt_num(r.get('bytes_accessed')):>8} "
+                  f"{_fmt_bytes(r.get('peak_hbm_bytes')):>10} "
+                  f"{(r.get('roofline') or '-'):>13}")
+        for kind, a in sorted((doc.get("attribution") or {}).items()):
+            mfu = a.get("mfu")
+            print(f"mfu[{kind}]: "
+                  f"{f'{mfu:.2%}' if mfu is not None else 'absent'} "
+                  f"({a.get('calls', 0):.0f} calls, "
+                  f"{a.get('device_seconds', 0):.3f} device-s, "
+                  f"{a.get('roofline') or 'roofline unknown'})")
+        ch = doc.get("cache_hbm") or {}
+        for bucket, v in sorted((ch.get("by_bucket") or {}).items()):
+            print(f"cache_hbm[{bucket}]: {_fmt_bytes(v)}")
+        if ch.get("total_bytes") is not None:
+            cap = doc.get("hbm_capacity_bytes")
+            of = f" of {_fmt_bytes(cap)}" if cap else ""
+            print(f"cache_hbm[total]: {_fmt_bytes(ch['total_bytes'])}{of}")
+    if doc.get("missing"):
+        for m in doc["missing"]:
+            print(f"FAIL: cached program {m} has no ledger row", flush=True)
+        return 1
+    print(f"ledger: complete ({len(doc.get('cache', []))} cached "
+          "program(s) all have rows)")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m raft_stereo_tpu.obs.ledger",
+        description=__doc__.split("\n\n")[0])
+    sub = p.add_subparsers(dest="cmd", required=True)
+    r = sub.add_parser("report", help="print a ledger dump; exit 1 when "
+                       "any cached program lacks a row")
+    r.add_argument("ledger")
+    r.add_argument("--json", action="store_true")
+    r.set_defaults(func=_cmd_report)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return args.func(args)
+    except (ValueError, KeyError, TypeError) as e:
+        # Malformed input can never read as a (mis)classified verdict.
+        print(f"ledger: internal error: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -57,7 +57,17 @@ def _fmt(v: float) -> str:
 
 
 def _escape_label(v: str) -> str:
+    """Exposition-format label value escaping: backslash FIRST (or the
+    escapes it introduces would be re-escaped), then newline and quote —
+    a hostile label value must round-trip through a scraper, not corrupt
+    the line protocol (golden-pinned in tests/test_obs.py)."""
     return v.replace("\\", r"\\").replace("\n", r"\n").replace('"', r"\"")
+
+
+def _escape_help(v: str) -> str:
+    """HELP-line escaping per the exposition format: backslash and
+    newline only (quotes are legal in help text)."""
+    return v.replace("\\", r"\\").replace("\n", r"\n")
 
 
 class Counter:
@@ -327,7 +337,7 @@ class MetricsRegistry:
                 kind = {"Counter": "counter", "Gauge": "gauge",
                         "Histogram": "summary"}[cls.__name__]
                 if help_text:
-                    lines.append(f"# HELP {name} {help_text}")
+                    lines.append(f"# HELP {name} {_escape_help(help_text)}")
                 lines.append(f"# TYPE {name} {kind}")
             if isinstance(inst, Histogram):
                 for q in self.QUANTILES:

@@ -154,6 +154,39 @@ def band_floor(band: Dict) -> float:
         band.get("rel_band", DEFAULT_REL_BAND)))
 
 
+#: Extra keys autopin copies from an entry into its band: the device
+#: ledger's diagnostic account (graftscope-device, DESIGN.md r12). On a
+#: later out-of-band failure these pins let ``check`` say WHY: flops
+#: changed => the compiled program itself changed; flops same but the
+#: metric fell => same program, slower wall clock (machine/env drift).
+DIAGNOSTIC_EXTRAS = ("flops", "bytes", "mfu")
+
+#: Relative flops drift below which the program counts as "unchanged"
+#: for the diagnosis (compiler reassociation jitter, not a regression).
+FLOPS_DRIFT_RTOL = 0.02
+
+
+def _diagnose(entry: Dict, band: Dict) -> str:
+    """One-line failure attribution from the ledger extras (always
+    produced — absence of telemetry is itself stated, never silent)."""
+    e = entry.get("extra") or {}
+    b = band.get("extra") or {}
+    ef, bf = e.get("flops"), b.get("flops")
+    if isinstance(ef, (int, float)) and isinstance(bf, (int, float)) and bf:
+        drift = (ef - bf) / abs(bf)
+        if abs(drift) > FLOPS_DRIFT_RTOL:
+            return (f"diagnosis: program flops changed "
+                    f"{bf:.4g} -> {ef:.4g} ({drift:+.1%}) — the compiled "
+                    "program itself changed; suspect a model/lowering "
+                    "regression, not the machine")
+        return ("diagnosis: flops unchanged but the metric fell — same "
+                "program, slower wall clock; suspect machine/env drift "
+                "(backend flags, contention, thermal)")
+    return ("diagnosis: no pinned flops extra for this metric — emit the "
+            "device-ledger extras (obs/ledger.py) and re-pin to enable "
+            "program-vs-machine attribution")
+
+
 @dataclasses.dataclass
 class CheckResult:
     failures: List[str]
@@ -192,7 +225,7 @@ def check(doc: Dict, bands_doc: Dict) -> CheckResult:
                 f"{metric}: {value:.4f} {entry.get('unit', '')} is below "
                 f"the pinned floor {floor:.4f} ({ref}) — a perf "
                 "regression; if intentional, re-pin trajectory_bands.json "
-                "explicitly")
+                "explicitly | " + _diagnose(entry, band))
         elif pinned is not None and value > float(pinned) * (1.0 + float(
                 band.get("rel_band", DEFAULT_REL_BAND))):
             res.notes.append(
@@ -218,6 +251,15 @@ def autopin(doc: Dict, bands_doc: Dict,
         bands[metric] = {"value": float(entry["value"]),
                          "rel_band": rel_band,
                          "unit": entry.get("unit", "")}
+        # Pin the device-ledger diagnostics alongside the value: a later
+        # out-of-band failure can then attribute itself (program flops
+        # changed vs machine drift) instead of just failing.
+        extras = {k: (entry.get("extra") or {}).get(k)
+                  for k in DIAGNOSTIC_EXTRAS
+                  if isinstance((entry.get("extra") or {}).get(k),
+                                (int, float))}
+        if extras:
+            bands[metric]["extra"] = extras
         pinned.append(metric)
     return pinned
 

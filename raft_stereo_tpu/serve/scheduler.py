@@ -45,6 +45,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from raft_stereo_tpu.obs.ledger import ledger_id
 from raft_stereo_tpu.obs.tracing import NULL_TRACE
 from raft_stereo_tpu.serve.degrade import SAFETY
 from raft_stereo_tpu.serve.guard import is_kernel_failure
@@ -311,8 +312,12 @@ class BatchScheduler:
             (state_j,) = self._device_call("prepare", ph, pw, 0, bb, lb, rb,
                                            traces=[r.trace for r in joiners])
             p1 = clock.now()
+            # The program id joins this span to its ledger row (flight
+            # records collect the rows of every program a request rode).
+            prep_id = session.ledger_key_id("prepare", ph, pw, 0, b=bb)
             for r in joiners:  # one device interval, fanned to every rider
-                r.trace.add_span("prepare", p0, p1, batch=len(joiners))
+                r.trace.add_span("prepare", p0, p1, batch=len(joiners),
+                                 program=prep_id)
             if pad:
                 state_j = take_refinement_rows(state_j, range(len(joiners)))
             if bucket.carry is None:
@@ -345,10 +350,11 @@ class BatchScheduler:
             traces=[r.trace for r in bucket.rows])
         a1 = clock.now()
         bucket.carry = state
+        adv_id = ledger_id(adv_key)
         for row in bucket.rows:
             row.iters_done += m_iters
             row.trace.add_span("advance", a0, a1, iters=m_iters,
-                               occupancy=n, batch=bb)
+                               occupancy=n, batch=bb, program=adv_id)
         self.registry.counter(
             "raft_sched_occupancy_total",
             "ticks by live-row occupancy", rows=str(n)).inc()
@@ -383,9 +389,11 @@ class BatchScheduler:
             "epilogue", ph, pw, 0, eb, ex_state,
             traces=[bucket.rows[i].trace for i in exits])
         e1 = clock.now()
+        epi_id = session.ledger_key_id("epilogue", ph, pw, 0, b=eb)
         for i in exits:
             bucket.rows[i].trace.add_span("epilogue", e0, e1,
-                                          batch=len(exits))
+                                          batch=len(exits),
+                                          program=epi_id)
         now = clock.now()
         for j, i in enumerate(exits):
             self._finish(bucket.rows[i], flow_up[j:j + 1], now)

@@ -59,6 +59,14 @@ class ServiceConfig:
     # None reads RAFT_SCHED_TICK_MS at start() (default 2 ms). Purely a
     # host-side latency/CPU trade — it never shapes a compiled program.
     tick_ms: Optional[float] = None
+    # SLO flight recorder (obs/flight.py): a served request whose
+    # end-to-end latency exceeds slo_ms * slo_factor — or any request
+    # that tripped a breaker rung, missed its deadline or produced a
+    # non-finite output — persists a bounded flight record to
+    # RAFT_FLIGHT_DIR. slo_ms=None disables the latency criterion only;
+    # the other breach classes always record when the recorder is armed.
+    slo_ms: Optional[float] = None
+    slo_factor: float = 1.0
 
 
 def _reject(code: str, message: str) -> Dict:
@@ -203,6 +211,65 @@ class StereoService:
             trace.finish(status=resp["status"], code=resp.get("code"),
                          quality=resp.get("quality"))
 
+    # -- SLO flight recorder ----------------------------------------------
+
+    def _breach_reasons(self, resp: Dict, spans) -> list:
+        """Why this response is an SLO breach (empty = healthy). The
+        latency criterion needs an explicit slo_ms; breaker trips,
+        missed deadlines and non-finite outputs always count."""
+        reasons = []
+        if resp.get("code") == "nonfinite_output":
+            reasons.append("nonfinite_output")
+        if any(s.kind == "breaker_trip" for s in spans):
+            reasons.append("breaker_trip")
+        # Served-but-late (degrade sets deadline_missed) AND rejected-as-
+        # expired (deadline_exceeded / deadline_exceeded_in_queue) both
+        # count: the queue-backlog rejection is exactly the case whose
+        # queue_wait timeline an operator needs most.
+        if resp.get("deadline_missed") or \
+                str(resp.get("code", "")).startswith("deadline_exceeded"):
+            reasons.append("deadline_missed")
+        elapsed = resp.get("elapsed_ms")
+        if (self.cfg.slo_ms is not None and elapsed is not None
+                and elapsed > self.cfg.slo_ms * self.cfg.slo_factor):
+            reasons.append("latency_slo")
+        return reasons
+
+    def _maybe_flight(self, request: Dict, resp: Dict) -> None:
+        """Persist a flight record when this (already trace-finished)
+        response breached its SLO. Runs on the response-resolution path
+        for BOTH serving modes, so it must never raise — the recorder is
+        failure-isolated, and this wrapper only reads local state."""
+        flight = self.session.flight
+        if not flight.enabled:
+            return
+        trace = request.get("_trace")
+        spans = getattr(trace, "spans", None) or []
+        reasons = self._breach_reasons(resp, spans)
+        if not reasons:
+            return
+        # Ledger rows of every program the request actually rode: spans
+        # carry the program's ledger id (session.invoke / the scheduler
+        # stamp them), and the ledger is bounded by the LRU cache size.
+        ids = {s.attrs.get("program") for s in spans
+               if s.attrs.get("program")}
+        doc = {
+            "schema": 1,
+            "reasons": reasons,
+            "slo_ms": self.cfg.slo_ms,
+            "slo_factor": self.cfg.slo_factor,
+            "response": {k: resp.get(k) for k in
+                         ("id", "status", "code", "quality", "iters",
+                          "elapsed_ms", "deadline_missed")},
+            "trace": (trace.to_dict()
+                      if trace is not None and trace is not NULL_TRACE
+                      else None),
+            "programs": self.session.ledger.rows_by_id(ids),
+            "breaker": self.session.breaker.status(),
+            "metrics": self.registry.snapshot(),
+        }
+        flight.record(doc, trace_id=getattr(trace, "trace_id", None))
+
     def _admit(self, request: Dict) -> Optional[Dict]:
         """Validation + deadline stamping; returns a rejection dict or
         None. Mutates ``request``: a trace is opened (trace id at
@@ -277,6 +344,7 @@ class StereoService:
             self._count("degraded")
         self._count(key)
         self._finish_trace(request, resp)
+        self._maybe_flight(request, resp)
         return resp
 
     def handle(self, request: Dict) -> Dict:
@@ -340,6 +408,10 @@ class StereoService:
             if resp.get("quality") != "full":
                 self._count("degraded")
         self._count(key)
+        # Flight record BEFORE resolving the Future: a caller that wakes
+        # on .result() and immediately lists RAFT_FLIGHT_DIR must see the
+        # record its breach produced.
+        self._maybe_flight(request, resp)
         fut = request.get("_future")
         if fut is not None:
             try:

@@ -46,6 +46,9 @@ from raft_stereo_tpu.analysis.knobs import ENV_KNOBS as _ENV_KNOBS
 from raft_stereo_tpu.config import RAFTStereoConfig
 from raft_stereo_tpu.faults import (RealClock, ServeFaultPlan, ServeFaults,
                                     poison_disparity)
+from raft_stereo_tpu.obs.flight import FlightRecorder
+from raft_stereo_tpu.obs.ledger import (ProgramLedger, analyze_compiled,
+                                        hbm_capacity, ledger_id)
 from raft_stereo_tpu.obs.metrics import MetricsRegistry
 from raft_stereo_tpu.obs.profiler import ProfilerWindow
 from raft_stereo_tpu.obs.tracing import NULL_TRACE, Tracer
@@ -172,9 +175,14 @@ class InferenceResult:
 class _Program:
     """One cached compiled program + its first-call lock. ``env`` is the
     switch set the program must be TRACED under — the canary's plain-XLA
-    reference carries all-off switches regardless of the session's own."""
+    reference carries all-off switches regardless of the session's own.
+    ``compiled`` is the AOT executable produced at warm time (so its
+    ``cost_analysis``/``memory_analysis`` feed the program ledger with
+    zero extra compiles); ``None`` means the warming path fell back to
+    plain jit dispatch (``fn``)."""
 
-    __slots__ = ("key", "fn", "kind", "env", "warmed", "lock")
+    __slots__ = ("key", "fn", "kind", "env", "warmed", "lock", "compiled",
+                 "ledger_id")
 
     def __init__(self, key, fn, kind, env):
         self.key = key
@@ -183,6 +191,8 @@ class _Program:
         self.env = dict(env)
         self.warmed = False
         self.lock = threading.Lock()
+        self.compiled = None
+        self.ledger_id = ledger_id(key)
 
 
 @contextlib.contextmanager
@@ -272,6 +282,17 @@ _SESSION_COUNTERS = {
 # the GV checkers walk exactly the programs serving would compile.
 PROGRAM_KINDS = ("full", "prepare", "segment", "advance", "epilogue")
 
+# Scan-scale declaration per kind for the program ledger (obs/ledger.py):
+# XLA cost analysis counts a scan body ONCE regardless of trip count, so
+# kinds whose whole body rides the refinement scan scale by their
+# iteration count, scan-free kinds scale by 1, and "full" (encoders +
+# scan + epilogue in one program) declares None — no per-invocation flop
+# estimate is honest for it, so its MFU reports absent rather than ~32x
+# wrong ("segment" includes one mask-head pass per call, so its scaled
+# estimate slightly overcounts that head; documented in DESIGN.md r12).
+SCAN_SCALE = {"full": None, "prepare": 1, "segment": "iters",
+              "advance": "iters", "epilogue": 1}
+
 
 def build_program(kind: str, cfg, iters: int):
     """The RAW (unjitted) python callable for one serving program kind.
@@ -339,7 +360,9 @@ class InferenceSession:
                  breaker: Optional[KernelCircuitBreaker] = None,
                  fault_plan: Optional[ServeFaultPlan] = None,
                  clock=None, registry: Optional[MetricsRegistry] = None,
-                 tracer: Optional[Tracer] = None):
+                 tracer: Optional[Tracer] = None,
+                 ledger: Optional[ProgramLedger] = None,
+                 flight: Optional[FlightRecorder] = None):
         import jax
         self._jax = jax
         self.cfg = session_cfg or SessionConfig()
@@ -352,6 +375,22 @@ class InferenceSession:
         self.tracer = tracer if tracer is not None else \
             Tracer(clock=self.clock)
         self.profiler = ProfilerWindow()  # RAFT_PROFILE_DIR, read once
+        # graftscope-device (obs/ledger.py, obs/flight.py): the program
+        # ledger records every compiled program's compiler-derived
+        # cost/memory account; the flight recorder persists SLO-breaching
+        # requests' timelines (RAFT_FLIGHT_DIR, read once, here).
+        self.ledger = ledger if ledger is not None else ProgramLedger()
+        self.flight = flight if flight is not None else FlightRecorder()
+        self._backend = jax.default_backend()
+        try:
+            self._device_kind = jax.devices()[0].device_kind
+        except Exception:  # noqa: BLE001 — diagnostics label only
+            self._device_kind = None
+        # Per-shape-bucket cache-HBM gauges last published (so a bucket
+        # whose programs all evicted reads 0, not a stale sum). Mutated
+        # only under _hbm_lock.
+        self._hbm_lock = threading.Lock()
+        self._hbm_buckets: set = set()
         self._ctr = {
             name: self.registry.counter(f"raft_session_{name}_total", help)
             for name, help in _SESSION_COUNTERS.items()}
@@ -543,7 +582,7 @@ class InferenceSession:
                 raise
             self._ctr["compiles"].inc()
             prog = _Program(key, fn, kind, trace_env)
-            evicted = 0
+            evicted_keys = []
             with self._cache_lock:
                 self._cache[key] = prog
                 while len(self._cache) > self._max_programs:
@@ -551,9 +590,21 @@ class InferenceSession:
                     self._key_locks.pop(old_key, None)
                     with self._est_lock:
                         self._estimates.pop(old_key, None)
-                    evicted += 1
-            if evicted:
-                self._ctr["evictions"].inc(evicted)
+                    evicted_keys.append(old_key)
+            if evicted_keys:
+                self._ctr["evictions"].inc(len(evicted_keys))
+                for old_key in evicted_keys:
+                    # The eviction line names the ledger row being
+                    # dropped: operators correlating a recompile storm
+                    # with /healthz can see WHAT left and how much HBM it
+                    # was holding.
+                    row = self.ledger.drop(old_key)
+                    peak = row.peak_hbm_bytes if row is not None else None
+                    logger.info(
+                        "evicted program %s from the LRU cache "
+                        "(peak HBM %s)", ledger_id(old_key),
+                        f"{peak / 2**20:.1f} MiB" if peak else "unknown")
+                self._refresh_cache_hbm()
             return prog
 
     def has_program(self, kind: str, h: int, w: int, iters: int,
@@ -565,6 +616,51 @@ class InferenceSession:
         with self._cache_lock:
             prog = self._cache.get(key)
         return prog is not None and prog.warmed
+
+    def _aot_compile(self, prog: _Program, args):
+        """Lower + compile one program ahead of time and record its
+        compiler-derived account (cost_analysis / memory_analysis) in the
+        program ledger.  MUST run inside the caller's trace lock with the
+        program's switch set exported (the lowering reads env at trace
+        time).  Real compile failures propagate to the breaker exactly as
+        they did from the first jit call; only AOT *API* skew
+        (TypeError/AttributeError/NotImplementedError from the
+        lower/compile plumbing itself) falls back to plain jit dispatch —
+        the ledger row then carries no compiler numbers, which every
+        downstream consumer treats as "absent", never as zero."""
+        if prog.compiled is not None:
+            return prog.compiled
+        kind, b, h, w, iters = prog.key[:5]
+        scale = SCAN_SCALE.get(kind)
+
+        def record(analysis: Dict) -> None:
+            self.ledger.record(
+                prog.key, kind=kind, b=b, h=h, w=w, iters=iters,
+                scan_scale=(iters if scale == "iters" else scale),
+                analysis=analysis, backend=self._backend,
+                device_kind=self._device_kind)
+
+        try:
+            compiled = prog.fn.lower(self._params, *args).compile()
+        except (TypeError, AttributeError, NotImplementedError) as e:
+            logger.warning(
+                "AOT compile unavailable for %s (%s: %s) — using jit "
+                "dispatch; its ledger row has no compiler numbers",
+                prog.ledger_id, type(e).__name__, e)
+            record({})
+            return prog.fn
+        except Exception:
+            # A REAL compile failure propagates to the breaker exactly as
+            # before — but the _Program is already cached, and a rebuild
+            # leaves it lingering in the LRU. Record an empty row first
+            # so ledger completeness keeps reflecting the cache: a server
+            # healthily degraded one rung down must not false-fail the
+            # report gate over the rung that refused to compile.
+            record({})
+            raise
+        prog.compiled = compiled
+        record(analyze_compiled(compiled))
+        return compiled
 
     def invoke(self, prog: _Program, *args,
                trace=NULL_TRACE) -> Tuple[np.ndarray, ...]:
@@ -597,12 +693,19 @@ class InferenceSession:
             if not prog.warmed:
                 with prog.lock:
                     with _TRACE_LOCK, _env_overrides(prog.env):
-                        raw = prog.fn(self._params, *args)
+                        # AOT lower+compile (not jit dispatch): the same
+                        # one compile the first jit call would pay, but
+                        # the Compiled handle stays in hand so its
+                        # cost/memory analyses feed the program ledger.
+                        fn = self._aot_compile(prog, args)
+                        raw = fn(self._params, *args)
                         t_disp = self.clock.now()
                         out = fetch(raw)
                     prog.warmed = True
+                self._refresh_cache_hbm()
             else:
-                raw = prog.fn(self._params, *args)
+                raw = (prog.compiled if prog.compiled is not None
+                       else prog.fn)(self._params, *args)
                 t_disp = self.clock.now()
                 out = fetch(raw)
         except Exception as e:
@@ -632,13 +735,30 @@ class InferenceSession:
                 "raft_program_device_seconds_total",
                 "device wait (dispatch-to-fetch) by program kind",
                 kind=prog.kind).inc(max(0.0, t_end - t_disp))
-            trace.add_span(prog.kind, t0, t_end)
+            # The MFU join's numerator: ledger flop/byte estimates
+            # accumulated per kind, steady-state only (warmups are
+            # excluded from device seconds, so they must be excluded here
+            # too or the ratio lies). Scan-opaque rows (flops_est None,
+            # e.g. "full") accumulate nothing — their MFU reports absent.
+            row = self.ledger.row(prog.key)
+            if row is not None and row.flops_est:
+                self.registry.counter(
+                    "raft_program_flops_total",
+                    "ledger-estimated flops executed by program kind",
+                    kind=prog.kind).inc(row.flops_est)
+            if row is not None and row.bytes_est:
+                self.registry.counter(
+                    "raft_program_hbm_bytes_total",
+                    "ledger-estimated HBM bytes moved by program kind",
+                    kind=prog.kind).inc(row.bytes_est)
+            trace.add_span(prog.kind, t0, t_end, program=prog.ledger_id)
         else:
             self.registry.counter(
                 "raft_program_warmup_seconds_total",
                 "first-invocation (compile-inclusive) time by kind",
                 kind=prog.kind).inc(max(0.0, t_end - t0))
-            trace.add_span(prog.kind, t0, t_end, warming=True)
+            trace.add_span(prog.kind, t0, t_end, warming=True,
+                           program=prog.ledger_id)
         if self.faults.poisoned(ordinal):
             flow_i = {"full": 0, "segment": 1, "epilogue": 0}.get(prog.kind)
             if flow_i is not None:
@@ -875,6 +995,92 @@ class InferenceSession:
         self._canary_state["passed"] = False
         raise InferenceFailed("canary_failed", "canary never converged")
 
+    # -- device ledger / HBM accounting -----------------------------------
+
+    def ledger_key_id(self, kind: str, h: int, w: int, iters: int,
+                      b: int = 1) -> str:
+        """Ledger display id of the program this (kind, geometry, batch)
+        resolves to under the CURRENT run config — the scheduler stamps
+        it on its fanned spans so flight records can join a request's
+        timeline to the exact ledger rows it rode."""
+        return ledger_id(self.cache_key(kind, h, w, iters, b=b))
+
+    def _cache_hbm_parts(self) -> Tuple[Dict[str, float], float, int]:
+        """(by_bucket, total, unknown_rows): summed ledger peak-HBM of
+        the currently cached programs per shape bucket. Programs whose
+        backend reported no memory stats count as ``unknown_rows`` and
+        contribute nothing — absence is visible, never a fabricated 0."""
+        with self._cache_lock:
+            progs = list(self._cache.values())
+        by_bucket: Dict[str, float] = {}
+        total, unknown = 0.0, 0
+        for prog in progs:
+            row = self.ledger.row(prog.key)
+            peak = row.peak_hbm_bytes if row is not None else None
+            if peak is None:
+                unknown += 1
+                continue
+            bucket = f"{prog.key[2]}x{prog.key[3]}"
+            by_bucket[bucket] = by_bucket.get(bucket, 0.0) + peak
+            total += peak
+        return by_bucket, total, unknown
+
+    def cache_hbm(self) -> Dict:
+        """The /healthz cache-HBM document: will the warm set fit one
+        chip (ROADMAP item 1's precondition before multiplying by N)."""
+        by_bucket, total, unknown = self._cache_hbm_parts()
+        return {"by_bucket": by_bucket, "total_bytes": total,
+                "unknown_rows": unknown,
+                "hbm_capacity_bytes": hbm_capacity(self._device_kind)}
+
+    def _refresh_cache_hbm(self) -> None:
+        """Publish the per-bucket cache-HBM gauges after a warm or an
+        eviction; a bucket whose programs all evicted reads 0, never a
+        stale sum."""
+        by_bucket, total, _ = self._cache_hbm_parts()
+        with self._hbm_lock:
+            stale = self._hbm_buckets - set(by_bucket)
+            self._hbm_buckets = set(by_bucket)
+        for bucket in stale:
+            self.registry.gauge(
+                "raft_cache_hbm_bytes",
+                "summed peak HBM of cached programs by shape bucket",
+                bucket=bucket).set(0.0)
+        for bucket, v in by_bucket.items():
+            self.registry.gauge(
+                "raft_cache_hbm_bytes",
+                "summed peak HBM of cached programs by shape bucket",
+                bucket=bucket).set(v)
+        self.registry.gauge(
+            "raft_cache_hbm_total_bytes",
+            "summed peak HBM of every cached program").set(total)
+
+    def attribution(self, peaks=None) -> Dict:
+        """Per-program-kind MFU/roofline (the ledger ⋈ registry join) and
+        publish the non-absent MFUs as gauges. ``peaks`` overrides the
+        chip table (tests inject synthetic peaks on CPU)."""
+        doc = self.ledger.attribution(self.registry,
+                                      device_kind=self._device_kind,
+                                      peaks=peaks)
+        for kind, a in doc.items():
+            if a["mfu"] is not None:
+                self.registry.gauge(
+                    "raft_program_mfu",
+                    "model flops utilization by program kind "
+                    "(ledger flops / device seconds / chip peak)",
+                    kind=kind).set(a["mfu"])
+        return doc
+
+    def ledger_doc(self) -> Dict:
+        """The dumpable device-ledger artifact (``obs.ledger report``):
+        rows + cache completeness + attribution + cache-HBM accounting."""
+        with self._cache_lock:
+            keys = list(self._cache)
+        return self.ledger.to_doc(
+            cache_keys=keys, backend=self._backend,
+            device_kind=self._device_kind,
+            attribution=self.attribution(), cache_hbm=self.cache_hbm())
+
     # -- reporting --------------------------------------------------------
 
     def count_request(self, ok: bool, degraded: bool = False,
@@ -916,4 +1122,10 @@ class InferenceSession:
                        if k not in ("compiles", "evictions")},
             "profiler": self.profiler.status(),
             "tracing": self.tracer.status(),
+            "ledger": {"rows": len(self.ledger),
+                       "device_kind": self._device_kind,
+                       "backend": self._backend,
+                       "cache_hbm": self.cache_hbm(),
+                       "attribution": self.attribution()},
+            "flight": self.flight.status(),
         }

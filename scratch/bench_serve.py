@@ -115,6 +115,18 @@ def main() -> None:
                 f"mode max_batch={mb}: {len(bad)} non-ok responses, "
                 f"first: {bad[0]}")
         status = service.status()
+        # graftscope-device acceptance (DESIGN.md r12): after the serve
+        # battery, EVERY cached program must have a ledger row — enforced
+        # in-process here, and again by the gate's `obs.ledger report`
+        # step on the dumped artifact (RAFT_LEDGER).
+        ledger_doc = session.ledger_doc()
+        if not ledger_doc["complete"]:
+            raise AssertionError(
+                f"mode max_batch={mb}: cached programs with no ledger "
+                f"row: {ledger_doc['missing']}")
+        from raft_stereo_tpu.obs.ledger import dump_path, save_doc
+        if dump_path():
+            save_doc(ledger_doc, dump_path())
         out = {"rps": n_requests / elapsed, "elapsed_s": elapsed}
         if status.get("batching"):
             b = status["batching"]

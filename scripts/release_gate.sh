@@ -21,6 +21,12 @@ step() { echo; echo "== $* =="; }
 export RAFT_TRAJECTORY="$PWD/TRAJECTORY.json"
 rm -f "$RAFT_TRAJECTORY"
 
+# Device-ledger artifact (DESIGN.md r12): the serve bench dumps its
+# session's program ledger here; the report step below enforces that
+# every cached program has a ledger row. Gitignored, echoed on failure.
+export RAFT_LEDGER="$PWD/LEDGER.json"
+rm -f "$RAFT_LEDGER"
+
 # graftlint first: it is the cheapest step (milliseconds, no jax) and a
 # finding here — an unregistered knob, an import-time kill-switch read, a
 # half-locked attribute — invalidates everything the later steps would
@@ -58,10 +64,12 @@ env JAX_PLATFORMS=cpu python -m pytest tests/test_serve.py \
     -p no:cacheprovider -p no:xdist -p no:randomly \
     || { echo "FAIL: serving fault storm"; fail=1; }
 
-# Observability battery (ISSUE 7 acceptance): FakeClock span timelines
-# that reconcile with reported latency, the /metrics golden, the
-# trajectory-gate failure mode, and the flat-memory reservoir pin.
-step "observability battery (graftscope: spans, /metrics, trajectory gate)"
+# Observability battery (ISSUE 7 + 8 acceptance): FakeClock span
+# timelines that reconcile with reported latency, the /metrics golden,
+# the trajectory-gate failure mode, the flat-memory reservoir pin, the
+# device-ledger fallback paths and the flight-recorder smoke (injected
+# SLO breach -> exactly one bounded record).
+step "observability battery (graftscope: spans, /metrics, ledger, flight, trajectory)"
 env JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py -q -m obs \
     -p no:cacheprovider -p no:xdist -p no:randomly \
     || { echo "FAIL: observability battery"; fail=1; }
@@ -86,6 +94,19 @@ if [ "$backend" != "tpu" ]; then
 else
     python scratch/bench_serve.py \
         || { echo "FAIL: serve throughput bench"; fail=1; }
+fi
+
+# Device-ledger report (ISSUE 8 acceptance): the serve bench above dumped
+# its session's ledger; every program still cached after the battery must
+# have a row (exit 1 otherwise), and the per-program flops/HBM table +
+# MFU attribution are echoed into the gate log either way.
+step "device ledger report (every cached program has a ledger row)"
+if [ -f "$RAFT_LEDGER" ]; then
+    python -m raft_stereo_tpu.obs.ledger report "$RAFT_LEDGER" \
+        || { echo "--- LEDGER.json ---"; cat "$RAFT_LEDGER";
+             echo "FAIL: device ledger report"; fail=1; }
+else
+    echo "FAIL: serve bench wrote no $RAFT_LEDGER"; fail=1
 fi
 
 # Train-throughput bench: steps/s into the trajectory. On CPU a tiny

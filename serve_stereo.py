@@ -91,6 +91,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "RAFT_TRACE=<path.jsonl> additionally streams "
                         "per-request span timelines, RAFT_PROFILE_DIR "
                         "arms on-demand jax.profiler windows)")
+    parser.add_argument('--ledger_out', default=None,
+                        help="write the device ledger dump here (inspect "
+                        "with `python -m raft_stereo_tpu.obs.ledger "
+                        "report`): per-program compiler flops/bytes/peak "
+                        "HBM, MFU attribution, cache HBM accounting")
+    parser.add_argument('--slo_ms', type=float, default=None,
+                        help="latency SLO: a served request slower than "
+                        "this (or any breaker trip / missed deadline / "
+                        "non-finite output) persists a bounded flight "
+                        "record to RAFT_FLIGHT_DIR")
     add_model_args(parser)
     return parser
 
@@ -143,7 +153,7 @@ def serve(args) -> int:
             admission=AdmissionConfig(max_pixels=args.max_pixels)))
     service = StereoService(session, ServiceConfig(
         max_queue=args.max_queue, workers=args.workers,
-        tick_ms=args.tick_ms))
+        tick_ms=args.tick_ms, slo_ms=args.slo_ms))
 
     left_images = sorted(glob.glob(args.left_imgs, recursive=True))
     right_images = sorted(glob.glob(args.right_imgs, recursive=True))
@@ -216,6 +226,9 @@ def serve(args) -> int:
             json.dumps(status, indent=2, default=str))
     if args.metrics_prom:
         Path(args.metrics_prom).write_text(service.metrics_text())
+    if args.ledger_out:
+        from raft_stereo_tpu.obs.ledger import save_doc
+        save_doc(session.ledger_doc(), args.ledger_out)
     if failures:
         print(f"{failures}/{len(left_images)} requests failed")
     return 1 if failures else 0

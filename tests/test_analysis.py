@@ -322,6 +322,20 @@ def test_gl002_real_tree_obs_knob_registered():
     assert hits[0].path.endswith("obs/tracing.py")
 
 
+def test_gl002_real_tree_flight_knob_registered():
+    # RAFT_FLIGHT_DIR (obs/flight.py FlightRecorder) is covered by
+    # HOST_ENV_KNOBS; drop it and GL002 must fire at the read site — the
+    # r12 flight-recorder knob cannot silently drift out of the registry
+    # (the drop leaves RAFT_LEDGER covered so the hit is unambiguous).
+    files = collect_files([str(PACKAGE)], base=str(REPO))
+    reduced = tuple(k for k in knobs.SERVE_ENV_KNOBS + knobs.HOST_ENV_KNOBS
+                    if k != "RAFT_FLIGHT_DIR")
+    rep = run_checkers(Project(files, serve_knobs=reduced))
+    hits = [f for f in rep.findings if f.code == "GL002"]
+    assert hits and "RAFT_FLIGHT_DIR" in hits[0].message
+    assert hits[0].path.endswith("obs/flight.py")
+
+
 def test_gl002_real_tree_dropped_knob_fails():
     # Acceptance fixture: drop RAFT_CORR_TILE from the registry while its
     # read still exists in corr/pallas_reg.py -> GL002 must fire.

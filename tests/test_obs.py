@@ -30,6 +30,8 @@ import jax
 from raft_stereo_tpu.config import RAFTStereoConfig
 from raft_stereo_tpu.faults import FakeClock, ServeFaultPlan
 from raft_stereo_tpu.models import init_raft_stereo
+from raft_stereo_tpu.obs import ledger as lg
+from raft_stereo_tpu.obs.flight import FlightRecorder
 from raft_stereo_tpu.obs.metrics import Histogram, MetricsRegistry
 from raft_stereo_tpu.obs.profiler import ProfilerWindow
 from raft_stereo_tpu.obs.tracing import NULL_TRACE, Tracer
@@ -71,14 +73,16 @@ def slow_plan(n: int = 64) -> ServeFaultPlan:
 
 
 def make_session(params, cfg, *, max_batch=1, valid_iters=4, segments=2,
-                 plan=None, clock=None, tracer=None):
+                 plan=None, clock=None, tracer=None, flight=None,
+                 max_programs=8):
     scfg = SessionConfig(valid_iters=valid_iters, segments=segments,
-                         max_batch=max_batch, canary=False)
+                         max_batch=max_batch, canary=False,
+                         max_programs=max_programs)
     clock = clock or FakeClock()
     if tracer is None:
         tracer = Tracer(clock=clock, sink="")
     return InferenceSession(params, cfg, scfg, fault_plan=plan,
-                            clock=clock, tracer=tracer)
+                            clock=clock, tracer=tracer, flight=flight)
 
 
 # ---------------------------------------------------------------------------
@@ -546,3 +550,449 @@ def test_breaker_trip_counter_in_registry(tiny_params, tiny_cfg, pair):
                                reason="compile_failure") == 1
     doc = sess.tracer.last()
     assert doc is None  # direct session.infer without a service trace
+
+
+# ---------------------------------------------------------------------------
+# graftscope-device: the program ledger (obs/ledger.py).
+
+
+def _key(kind, b=1, h=64, w=96, iters=2):
+    return (kind, b, h, w, iters, ("fp",))
+
+
+def test_ledger_scan_scale_estimates():
+    """Raw compiler numbers are preserved; per-invocation estimates apply
+    the declared scan scale; 'full'-style scan-opaque rows get NO
+    estimate (absent beats 32x wrong)."""
+    led = lg.ProgramLedger()
+    adv = led.record(_key("advance", b=2, iters=4), kind="advance", b=2,
+                     h=64, w=96, iters=4, scan_scale=4,
+                     analysis={"flops": 100.0, "bytes_accessed": 10.0,
+                               "argument_bytes": 5.0, "output_bytes": 3.0,
+                               "temp_bytes": 2.0, "alias_bytes": 1.0})
+    assert adv.flops == 100.0 and adv.flops_est == 400.0
+    assert adv.bytes_est == 40.0
+    assert adv.peak_hbm_bytes == 9.0  # arg + out + temp - alias
+    prep = led.record(_key("prepare", iters=0), kind="prepare", iters=0,
+                      scan_scale=1, analysis={"flops": 7.0})
+    assert prep.flops_est == 7.0
+    full = led.record(_key("full", iters=32), kind="full", iters=32,
+                      scan_scale=None, analysis={"flops": 9.0})
+    assert full.flops_est is None and full.bytes_est is None
+
+
+def test_ledger_absent_and_partial_analysis():
+    """Backends that report nothing (or only some keys) yield absent
+    fields — never zeros that would poison sums or ratios."""
+    led = lg.ProgramLedger()
+    empty = led.record(_key("prepare"), kind="prepare", scan_scale=1,
+                       analysis={})
+    assert empty.flops is None and empty.flops_est is None
+    assert empty.peak_hbm_bytes is None  # unknown, not 0
+    partial = led.record(_key("segment", iters=2), kind="segment", iters=2,
+                         scan_scale=2, analysis={"flops": 5.0})
+    assert partial.flops_est == 10.0
+    assert partial.bytes_accessed is None and partial.bytes_est is None
+    assert partial.peak_hbm_bytes is None
+    assert partial.intensity() is None
+    assert partial.roofline((1e12, 1e11)) is None
+
+
+def test_ledger_attribution_never_divides_blind():
+    """MFU is absent unless flops, device seconds AND a chip peak all
+    exist and are positive — zero device-seconds (the satellite bar) and
+    off-table devices (CPU) must not produce a number."""
+    led = lg.ProgramLedger()
+    led.record(_key("segment", iters=2), kind="segment", iters=2,
+               scan_scale=2, analysis={"flops": 50.0,
+                                       "bytes_accessed": 10.0})
+    reg = MetricsRegistry()
+    reg.counter("raft_program_flops_total", kind="segment").inc(100.0)
+    # zero device seconds -> absent, no ZeroDivisionError
+    att = led.attribution(reg, peaks=(1e12, 1e11))
+    assert att["segment"]["mfu"] is None
+    reg.counter("raft_program_device_seconds_total",
+                kind="segment").inc(2.0)
+    att = led.attribution(reg, peaks=(1e12, 1e11))
+    assert att["segment"]["mfu"] == pytest.approx(100.0 / 2.0 / 1e12)
+    # off the chip table (CPU): absent even with full counters
+    att = led.attribution(reg, device_kind="cpu")
+    assert att["segment"]["mfu"] is None
+    # seconds but no flops (scan-opaque kind): absent
+    reg.counter("raft_program_device_seconds_total", kind="full").inc(1.0)
+    att = led.attribution(reg, peaks=(1e12, 1e11))
+    assert att["full"]["mfu"] is None
+
+
+def test_chip_peaks_table():
+    f, bw = lg.chip_peaks("TPU v5 lite chip")
+    assert f == 197e12 and bw == 819e9
+    assert lg.chip_peaks("TPU v4") == (275e12, 1228e9)
+    assert lg.chip_peaks("cpu") is None
+    assert lg.chip_peaks(None) is None
+    assert lg.hbm_capacity("TPU v5e") == 16 * 2**30
+    assert lg.hbm_capacity("cpu") is None
+
+
+def test_analyze_compiled_real_program():
+    """The extraction works against a real jax Compiled on this backend
+    (flops + argument bytes present on CPU)."""
+    import jax.numpy as jnp
+
+    def f(x):
+        return (jnp.sin(x) * 2.0).sum()
+
+    compiled = jax.jit(f).lower(jnp.ones((32, 32), jnp.float32)).compile()
+    a = lg.analyze_compiled(compiled)
+    assert a["flops"] and a["flops"] > 0
+    assert a["argument_bytes"] == 32 * 32 * 4
+
+
+def test_analyze_compiled_graceful_on_junk():
+    """A backend object whose analyses raise or return nothing yields
+    all-None — the fallback path the tentpole demands."""
+
+    class Junk:
+        def cost_analysis(self):
+            raise RuntimeError("not supported")
+
+        def memory_analysis(self):
+            return None
+
+    a = lg.analyze_compiled(Junk())
+    assert all(v is None for v in a.values())
+
+    class Weird:
+        def cost_analysis(self):
+            return [{"flops": -1.0}]  # XLA's "unknown" sentinel
+
+        def memory_analysis(self):
+            return object()  # no size attributes at all
+
+    a = lg.analyze_compiled(Weird())
+    assert all(v is None for v in a.values())
+
+
+def _ledger_cli(args):
+    return subprocess.run(
+        [sys.executable, "-m", "raft_stereo_tpu.obs.ledger"] + args,
+        capture_output=True, text=True)
+
+
+def test_ledger_report_cli(tmp_path):
+    led = lg.ProgramLedger()
+    key = _key("prepare")
+    led.record(key, kind="prepare", h=64, w=96, scan_scale=1,
+               analysis={"flops": 5.0, "argument_bytes": 10.0,
+                         "output_bytes": 2.0, "temp_bytes": 1.0,
+                         "alias_bytes": 0.0})
+    path = tmp_path / "LEDGER.json"
+
+    lg.save_doc(led.to_doc(cache_keys=[key], backend="cpu"), str(path))
+    res = _ledger_cli(["report", str(path)])
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "complete" in res.stdout
+
+    # a cached program with no row fails the report (the gate bar)
+    lg.save_doc(led.to_doc(cache_keys=[key, _key("segment")],
+                           backend="cpu"), str(path))
+    res = _ledger_cli(["report", str(path)])
+    assert res.returncode == 1
+    assert "no ledger row" in res.stdout
+
+    path.write_text("{not json")
+    res = _ledger_cli(["report", str(path)])
+    assert res.returncode == 2  # malformed can never read as clean
+
+
+def test_session_ledger_covers_cache_and_reports_hbm(tiny_params, tiny_cfg,
+                                                     pair):
+    """Every compiled program gets a ledger row at warm time; /healthz
+    reports cache HBM per shape bucket and the gauges follow."""
+    sess = make_session(tiny_params, tiny_cfg)
+    sess.infer(*[p[None] for p in pair])
+    doc = sess.ledger_doc()
+    assert doc["complete"] and not doc["missing"]
+    assert len(doc["rows"]) == len(doc["cache"]) == 1
+    row = doc["rows"][0]
+    assert row["kind"] == "full" and row["flops"] > 0
+    # CPU's compiled memory analysis reports argument/output sizes, so
+    # the cache-HBM account is positive and bucketed by padded shape.
+    st = sess.status()["ledger"]
+    assert st["rows"] == 1
+    by_bucket = st["cache_hbm"]["by_bucket"]
+    assert list(by_bucket) == ["64x64"]  # H=40,W=60 pads to 64x64
+    assert by_bucket["64x64"] > 0
+    assert sess.registry.value("raft_cache_hbm_bytes",
+                               bucket="64x64") == by_bucket["64x64"]
+    assert sess.registry.value(
+        "raft_cache_hbm_total_bytes") == st["cache_hbm"]["total_bytes"]
+
+
+def test_eviction_drops_ledger_row_and_names_it(tiny_params, tiny_cfg,
+                                                pair, caplog):
+    """LRU eviction drops the ledger row, logs a line NAMING it, and the
+    bucket gauge returns to zero when its programs all leave."""
+    import logging as _logging
+    sess = make_session(tiny_params, tiny_cfg, max_programs=1)
+    sess.infer(*[p[None] for p in pair])
+    assert len(sess.ledger) == 1
+    big = np.zeros((72, 100, 3), np.float32)  # pads to 96x128
+    with caplog.at_level(_logging.INFO,
+                         logger="raft_stereo_tpu.serve.session"):
+        sess.infer(big[None].copy(), big[None].copy())
+    assert len(sess.ledger) == 1  # old row dropped with its program
+    assert sess.ledger_doc()["complete"]
+    msgs = [r.message for r in caplog.records
+            if "evicted program" in r.message]
+    assert msgs and "full@b1:64x64" in msgs[0]
+    assert sess.registry.value("raft_cache_hbm_bytes", bucket="64x64") == 0
+    assert sess.registry.value("raft_cache_hbm_bytes",
+                               bucket="96x128") > 0
+
+
+def test_session_mfu_join_with_injected_peaks(tiny_params, tiny_cfg, pair):
+    """The MFU join end-to-end on CPU: steady segmented invocations
+    accumulate ledger flops per kind; attribution with injected peaks
+    yields a positive MFU and publishes the gauge; scan-opaque and
+    warmup-only kinds stay absent."""
+    clock = FakeClock()
+    sess = make_session(tiny_params, tiny_cfg, plan=slow_plan(),
+                        clock=clock)
+    for _ in range(2):  # first call warms, second is steady
+        sess.infer(*[p[None] for p in pair],
+                   deadline=clock.now() + 1e6)
+    assert sess.registry.value("raft_program_flops_total",
+                               kind="segment") > 0
+    att = sess.attribution(peaks=(1e12, 1e11))
+    assert att["segment"]["mfu"] is not None and att["segment"]["mfu"] > 0
+    assert att["segment"]["roofline"] in ("compute-bound", "hbm-bound")
+    assert sess.registry.value("raft_program_mfu",
+                               kind="segment") == att["segment"]["mfu"]
+    # without injected peaks this is a CPU host: absent, never fabricated
+    assert sess.attribution()["segment"]["mfu"] is None
+
+
+# ---------------------------------------------------------------------------
+# graftscope-device: the SLO flight recorder (obs/flight.py).
+
+
+def test_flight_disabled_without_dir(monkeypatch):
+    monkeypatch.delenv("RAFT_FLIGHT_DIR", raising=False)
+    rec = FlightRecorder()
+    assert not rec.enabled
+    assert rec.record({"x": 1}) is None
+    assert rec.status()["skipped"] == 1
+
+
+def test_flight_bounded_oldest_first(tmp_path):
+    rec = FlightRecorder(out_dir=str(tmp_path), limit=2)
+    for i in range(4):
+        assert rec.record({"i": i}, trace_id=f"req-{i}") is not None
+    paths = rec.records()
+    assert len(paths) == 2
+    docs = [json.loads(open(p).read()) for p in paths]
+    assert [d["i"] for d in docs] == [2, 3]  # oldest evicted first
+    st = rec.status()
+    assert st["recorded"] == 4 and st["evicted"] == 2
+    # a fresh recorder over the same dir continues the sequence: the
+    # eviction order survives restarts
+    rec2 = FlightRecorder(out_dir=str(tmp_path), limit=2)
+    rec2.record({"i": 4}, trace_id="req-4")
+    assert json.loads(open(rec2.records()[-1]).read())["i"] == 4
+
+
+def test_flight_sink_failure_disables(tmp_path):
+    blocker = tmp_path / "not_a_dir"
+    blocker.write_text("file, not dir")
+    rec = FlightRecorder(out_dir=str(blocker))
+    assert rec.record({"x": 1}) is None  # must not raise
+    assert not rec.enabled  # sink dropped, like RAFT_TRACE
+    assert rec.record({"x": 2}) is None
+    assert rec.status()["skipped"] == 1
+
+
+def test_flight_record_on_slo_breach_reconciles(tmp_path, tiny_params,
+                                                tiny_cfg, pair):
+    """ISSUE 8 acceptance: an injected SLO breach under FakeClock yields
+    EXACTLY ONE flight record whose span sum reconciles with the reported
+    latency, carrying the ledger rows of every program the request rode."""
+    clock = FakeClock()
+    flight = FlightRecorder(out_dir=str(tmp_path), limit=8)
+    sess = make_session(tiny_params, tiny_cfg, max_batch=4, valid_iters=4,
+                        segments=2, plan=slow_plan(), clock=clock,
+                        flight=flight)
+    with StereoService(sess, ServiceConfig(max_queue=8,
+                                           slo_ms=100.0)) as svc:
+        resp = svc.submit({"id": "r0", "left": pair[0],
+                           "right": pair[1]}).result(timeout=120)
+    assert resp["status"] == "ok"
+    # prepare + 2 advances + epilogue at TICK injected device-time each =
+    # 1000 ms >> the 100 ms SLO.
+    assert resp["elapsed_ms"] == pytest.approx(4 * TICK * 1e3)
+    paths = flight.records()
+    assert len(paths) == 1  # exactly one record for the one breach
+    doc = json.loads(open(paths[0]).read())
+    assert doc["reasons"] == ["latency_slo"]
+    s = doc["trace"]["summary"]
+    assert s["tiled_ms"] == pytest.approx(s["total_ms"])
+    assert s["total_ms"] == pytest.approx(resp["elapsed_ms"])
+    kinds = {p["kind"] for p in doc["programs"]}
+    assert {"prepare", "advance", "epilogue"} <= kinds
+    assert doc["metrics"]["raft_requests_total"]["series"]
+    assert doc["breaker"] is not None
+
+
+def test_flight_record_on_breaker_trip(tmp_path, tiny_params, tiny_cfg,
+                                       pair):
+    flight = FlightRecorder(out_dir=str(tmp_path))
+    plan = ServeFaultPlan(compile_errors={0: "mosaic:gru1632"})
+    sess = make_session(tiny_params, tiny_cfg, plan=plan, flight=flight)
+    with StereoService(sess, ServiceConfig(max_queue=4)) as svc:
+        resp = svc.submit({"id": "t", "left": pair[0],
+                           "right": pair[1]}).result(timeout=120)
+    assert resp["status"] == "ok"  # served one rung down
+    paths = flight.records()
+    assert len(paths) == 1
+    doc = json.loads(open(paths[0]).read())
+    assert doc["reasons"] == ["breaker_trip"]
+    assert doc["breaker"]["tripped"]
+
+
+def test_flight_record_on_nonfinite_output(tmp_path, tiny_params, tiny_cfg,
+                                           pair):
+    flight = FlightRecorder(out_dir=str(tmp_path))
+    plan = ServeFaultPlan(poison_outputs=(0,))
+    sess = make_session(tiny_params, tiny_cfg, plan=plan, flight=flight)
+    with StereoService(sess, ServiceConfig(max_queue=4)) as svc:
+        resp = svc.submit({"id": "p", "left": pair[0],
+                           "right": pair[1]}).result(timeout=120)
+    assert resp["status"] == "error"
+    assert resp["code"] == "nonfinite_output"
+    paths = flight.records()
+    assert len(paths) == 1
+    doc = json.loads(open(paths[0]).read())
+    assert "nonfinite_output" in doc["reasons"]
+
+
+def test_no_flight_record_when_healthy(tmp_path, tiny_params, tiny_cfg,
+                                       pair):
+    flight = FlightRecorder(out_dir=str(tmp_path))
+    sess = make_session(tiny_params, tiny_cfg, flight=flight)
+    with StereoService(sess, ServiceConfig(max_queue=4,
+                                           slo_ms=1e9)) as svc:
+        resp = svc.submit({"id": "h", "left": pair[0],
+                           "right": pair[1]}).result(timeout=120)
+    assert resp["status"] == "ok"
+    assert flight.records() == []
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition escaping (the hostile-label satellite).
+
+
+def test_metrics_prometheus_hostile_label_golden():
+    """Backslash, quote and newline in label values AND in help text must
+    render per the exposition-format escaping rules — raw, they corrupt
+    the line protocol for every scraper."""
+    r = MetricsRegistry()
+    r.counter("h_total", 'help with \\ back and\nnewline',
+              path='a\\b"c\nd').inc()
+    golden = (
+        '# HELP h_total help with \\\\ back and\\nnewline\n'
+        '# TYPE h_total counter\n'
+        'h_total{path="a\\\\b\\"c\\nd"} 1\n')
+    assert r.render_prometheus() == golden
+
+
+# ---------------------------------------------------------------------------
+# Trajectory failure diagnosis (graftscope-device part d).
+
+
+def test_trajectory_autopin_pins_diagnostic_extras():
+    doc = {"schema": 1, "entries": [
+        {"metric": "fps", "value": 5.0, "unit": "frames/s",
+         "extra": {"flops": 100.0, "mfu": 0.3, "note": "text"}}]}
+    bands = {"schema": 1, "bands": {}}
+    assert tj.autopin(doc, bands) == ["fps"]
+    # numeric diagnostic keys pinned, free-text extras dropped
+    assert bands["bands"]["fps"]["extra"] == {"flops": 100.0, "mfu": 0.3}
+
+
+def test_trajectory_failure_diagnosis_lines():
+    bands = {"schema": 1, "bands": {
+        "fps": {"value": 10.0, "rel_band": 0.2,
+                "extra": {"flops": 100.0}}}}
+
+    def fail_with(extra):
+        entry = {"metric": "fps", "value": 5.0, "unit": "frames/s"}
+        if extra is not None:
+            entry["extra"] = extra
+        res = tj.check({"schema": 1, "entries": [entry]}, bands)
+        assert not res.ok
+        return res.failures[0]
+
+    # flops moved -> the program itself changed
+    assert "program flops changed" in fail_with({"flops": 150.0})
+    # flops unchanged -> the machine/env drifted
+    assert "machine/env drift" in fail_with({"flops": 100.0})
+    assert "machine/env drift" in fail_with({"flops": 101.0})  # in rtol
+    # no telemetry -> the absence is stated, still one diagnosis line
+    assert "no pinned flops extra" in fail_with(None)
+
+
+# ---------------------------------------------------------------------------
+# Review-round regressions (r12).
+
+
+def test_compile_failure_still_records_ledger_row(tiny_params, tiny_cfg):
+    """A REAL compile failure propagates to the breaker, but the cached
+    program must still get a (empty) ledger row — a server healthily
+    degraded one rung down must not false-fail the completeness gate
+    over the rung that refused to compile."""
+    from raft_stereo_tpu.serve.session import _Program
+    sess = make_session(tiny_params, tiny_cfg)
+
+    class BoomJit:
+        def lower(self, *a, **k):
+            raise RuntimeError("RESOURCE_EXHAUSTED: out of memory "
+                               "allocating on device")
+
+    key = sess.cache_key("full", 64, 64, 4)
+    prog = _Program(key, BoomJit(), "full", {})
+    with pytest.raises(RuntimeError):
+        sess._aot_compile(prog, ())
+    row = sess.ledger.row(key)
+    assert row is not None
+    assert row.flops is None and row.peak_hbm_bytes is None
+
+
+def test_flight_record_on_deadline_expired_in_queue(tmp_path, tiny_params,
+                                                    tiny_cfg, pair):
+    """A queue-expired rejection is a breach (its queue_wait timeline is
+    exactly what an operator debugging backlog needs) — not only the
+    served-but-late case."""
+    flight = FlightRecorder(out_dir=str(tmp_path))
+    sess = make_session(tiny_params, tiny_cfg, flight=flight)
+    with StereoService(sess, ServiceConfig(max_queue=4)) as svc:
+        resp = svc.submit({"id": "d", "left": pair[0], "right": pair[1],
+                           "deadline_ms": 0.0}).result(timeout=120)
+    assert resp["status"] == "rejected"
+    assert resp["code"] == "deadline_exceeded_in_queue"
+    paths = flight.records()
+    assert len(paths) == 1
+    doc = json.loads(open(paths[0]).read())
+    assert doc["reasons"] == ["deadline_missed"]
+    assert any(s["kind"] == "queue_wait" for s in doc["trace"]["spans"])
+
+
+def test_ledger_report_cli_malformed_rows_rc2(tmp_path):
+    """Element-level corruption (a rows entry that is not an id-carrying
+    dict) is exit 2 — malformed, never a misclassified completeness
+    failure with a traceback."""
+    path = tmp_path / "LEDGER.json"
+    path.write_text(json.dumps(
+        {"schema": 1, "rows": [None], "cache": [], "missing": []}))
+    res = _ledger_cli(["report", str(path)])
+    assert res.returncode == 2, res.stdout + res.stderr
+    assert "malformed ledger row" in res.stderr
