@@ -47,11 +47,26 @@ ENV_KNOBS: Tuple[str, ...] = (
 # Registered here so the flag matrix has one home and a future reviewer
 # asking "does this knob need to be in the fingerprint?" finds the answer
 # where the fingerprint is defined.
+#
+# The graftguard supervision knobs (DESIGN.md r13) follow the same rule:
+# each steers HOST-side supervision policy — when a watchdog fires, how
+# many times a request may re-admit, how long a drain waits — read once
+# at service construction (serve/supervise.py resolve_* helpers), and no
+# compiled program's bytes depend on any of them.  Folding them into the
+# fingerprint would recompile the whole cache because an operator tuned
+# a timeout.
 SERVE_ENV_KNOBS: Tuple[str, ...] = (
     "RAFT_BATCH_BUCKETS",   # batch-bucket ladder, e.g. "1,2,4,8"
                             # (serve/session.py, resolved at construction)
     "RAFT_SCHED_TICK_MS",   # scheduler idle poll, ms (serve/service.py,
                             # read at service start)
+    "RAFT_WATCHDOG_MS",     # hang-watchdog deadline floor, ms; 0 = off
+                            # (serve/supervise.py, read at service
+                            # construction)
+    "RAFT_RETRY_BUDGET",    # bounded per-request re-admissions for
+                            # transient failures (serve/supervise.py)
+    "RAFT_DRAIN_GRACE_MS",  # graceful-drain hard deadline, ms
+                            # (serve/supervise.py)
 )
 
 # Host-pipeline env knobs: they steer HOST code (the data loader's native
@@ -82,6 +97,9 @@ HOST_ENV_KNOBS: Tuple[str, ...] = (
     "RAFT_LEDGER",          # device-ledger dump target the serve bench
                             # writes for the gate's report step
                             # (obs/ledger.py dump_path(), read per call)
+    "RAFT_CHAOS_SPEC",      # chaos-soak overrides (JSON: n/seed/fault
+                            # mix) for scratch/chaos_serve.py — drives a
+                            # test harness, never a compiled program
 )
 
 

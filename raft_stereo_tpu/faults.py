@@ -157,6 +157,14 @@ class InjectedKernelError(RuntimeError):
         super().__init__(msg)
 
 
+class InjectedWorkerCrash(RuntimeError):
+    """Stands in for a host-side bug that kills a serving worker thread
+    (the scheduler tick loop, the uploader).  Deliberately NOT a kernel
+    failure: the circuit breaker must never see it — thread death is the
+    supervision layer's territory (serve/supervise.py watchdogs), not a
+    fallback-ladder rung."""
+
+
 @dataclasses.dataclass(frozen=True)
 class ServeFaultPlan:
     """Declarative fault schedule for one :class:`~raft_stereo_tpu.serve.
@@ -187,6 +195,41 @@ class ServeFaultPlan:
     poison_outputs: Tuple[int, ...] = ()
 
 
+@dataclasses.dataclass(frozen=True)
+class ChaosPlan(ServeFaultPlan):
+    """Supervision-layer chaos schedule (serve/supervise.py + the
+    ``scratch/chaos_serve.py`` soak): extends :class:`ServeFaultPlan`
+    with the fault classes only a watchdog can recover from.  Same
+    stance as every other plan here — deterministic ordinals, no
+    randomness, no env side channels — so a chaos storm replays
+    identically on every run.
+
+    hang_invokes: device-invocation ordinal (0-based count of *invoke
+        entries* — a separate ordinal space from ``slow_forwards``'
+        post-execution count, though the two coincide whenever every
+        invoke completes) -> fake seconds the hang appears to take.  The
+        invocation first advances the session clock by that many seconds
+        (so a FakeClock watchdog sees it overdue immediately), then
+        parks the invoking thread on a real condition until
+        :meth:`ServeFaults.release_hangs` (the generation bounce calls
+        it) or the ``hang_cap_s`` real-time safety cap.
+    crash_uploads: upload ordinals (0-based count of rows the uploader
+        thread picks up) whose processing kills the uploader thread —
+        the injected form of the mid-run uploader crash that used to
+        strand its joiners' Futures forever.
+    crash_ticks: scheduler work-tick ordinals (0-based count of ticks
+        that did work) AFTER which the tick-loop thread crashes.
+    hang_cap_s: real-seconds safety cap on any injected hang, so a test
+        that never bounces cannot deadlock the suite.
+    """
+
+    hang_invokes: Mapping[int, float] = dataclasses.field(
+        default_factory=dict)
+    crash_uploads: Tuple[int, ...] = ()
+    crash_ticks: Tuple[int, ...] = ()
+    hang_cap_s: float = 30.0
+
+
 class ServeFaults:
     """Lock-protected ordinal counters binding a :class:`ServeFaultPlan`
     to one session (mirrors :class:`FaultyDataset` for the loader)."""
@@ -196,7 +239,17 @@ class ServeFaults:
         self.clock = clock
         self.builds = 0
         self.forwards = 0
+        self.invokes = 0
+        self.uploads = 0
+        self.ticks = 0
         self._lock = threading.Lock()
+        # Injected hangs park on this condition until release_hangs()
+        # (the watchdog bounce) bumps the epoch, or the plan's real-time
+        # cap expires.  ``hangs_entered`` lets tests wait until the
+        # victim thread is provably parked before advancing the clock.
+        self._hang_cv = threading.Condition()
+        self.hangs_entered = 0
+        self._hang_epoch = 0
 
     def on_build(self) -> int:
         """Fire at each program-compile attempt; raises the injected
@@ -231,6 +284,83 @@ class ServeFaults:
 
     def poisoned(self, ordinal: int) -> bool:
         return self.plan is not None and ordinal in self.plan.poison_outputs
+
+    # -- supervision-layer injectors (ChaosPlan; plain ServeFaultPlans
+    # have none of these fields, so every hook is a counted no-op) ------
+
+    def on_invoke(self) -> int:
+        """Fire at each device-invocation ENTRY (before the program
+        runs, inside the session's invocation watch window); parks the
+        calling thread on an injected hang for this ordinal, if any."""
+        with self._lock:
+            n = self.invokes
+            self.invokes = n + 1
+        hang = getattr(self.plan, "hang_invokes", None)
+        if not hang or n not in hang:
+            return n
+        # Capture the release epoch BEFORE the clock advance below: the
+        # advance is what makes this hang detectable, so a supervisor
+        # sweep (and its release_hangs) can land in the gap between the
+        # sleep and the park — an epoch read after that release would
+        # miss it and park the victim for the full real-time cap.
+        with self._hang_cv:
+            epoch = self._hang_epoch
+        # The hang's apparent duration lands on the session clock FIRST:
+        # a FakeClock watchdog sees the invocation overdue the moment the
+        # victim parks, with zero real sleeping in the deadline math.
+        if self.clock is not None and hang[n]:
+            self.clock.sleep(hang[n])
+        import time
+        cap = time.monotonic() + getattr(self.plan, "hang_cap_s", 30.0)
+        with self._hang_cv:
+            self.hangs_entered += 1
+            self._hang_cv.notify_all()
+            while self._hang_epoch == epoch and time.monotonic() < cap:
+                self._hang_cv.wait(0.05)
+        return n
+
+    def release_hangs(self) -> None:
+        """Unpark every currently-hung invocation (the generation bounce
+        calls this so an abandoned victim thread can run to its no-op
+        completion instead of leaking until the real-time cap)."""
+        with self._hang_cv:
+            self._hang_epoch += 1
+            self._hang_cv.notify_all()
+
+    def wait_hang_entered(self, n: int = 1, timeout: float = 30.0) -> bool:
+        """Block (real time) until at least ``n`` injected hangs have
+        parked their victims — the test-side rendezvous."""
+        import time
+        deadline = time.monotonic() + timeout
+        with self._hang_cv:
+            while self.hangs_entered < n:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._hang_cv.wait(min(0.05, remaining))
+        return True
+
+    def on_upload(self) -> int:
+        """Fire as the uploader thread picks up each row; raises the
+        injected thread-killing crash for this ordinal, if any."""
+        with self._lock:
+            n = self.uploads
+            self.uploads = n + 1
+        if n in getattr(self.plan, "crash_uploads", ()):
+            raise InjectedWorkerCrash(
+                f"injected uploader crash at upload {n}")
+        return n
+
+    def on_tick(self) -> int:
+        """Fire after each scheduler work-tick; raises the injected
+        tick-loop crash for this ordinal, if any."""
+        with self._lock:
+            n = self.ticks
+            self.ticks = n + 1
+        if n in getattr(self.plan, "crash_ticks", ()):
+            raise InjectedWorkerCrash(
+                f"injected tick-loop crash after work tick {n}")
+        return n
 
 
 def poison_disparity(arr: np.ndarray) -> np.ndarray:

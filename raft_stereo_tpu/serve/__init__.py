@@ -15,7 +15,11 @@ Layers, bottom up:
                   running device batch at tick boundaries, exit at
                   segment boundaries (``SessionConfig.max_batch > 1``);
 - ``service``   — bounded queue, backpressure, per-request deadlines,
-                  /healthz status.
+                  /healthz status;
+- ``supervise`` — graftguard: hang watchdogs over every device
+                  invocation, tick-loop/uploader liveness, scheduler
+                  generation bounces with bounded per-request retries,
+                  and graceful drain (SIGTERM) semantics.
 
 Everything is CPU-testable with deterministic injected faults
 (``raft_stereo_tpu.faults.ServeFaultPlan``).
@@ -32,6 +36,11 @@ from raft_stereo_tpu.serve.scheduler import (  # noqa: F401
 from raft_stereo_tpu.serve.service import (  # noqa: F401
     ServiceConfig,
     StereoService,
+)
+from raft_stereo_tpu.serve.supervise import (  # noqa: F401
+    InvocationWatch,
+    Supervisor,
+    WatchdogTrip,
 )
 from raft_stereo_tpu.serve.session import (  # noqa: F401
     DeadlineExceeded,

@@ -103,6 +103,12 @@ class _Bucket:
         # composition changes, not on every segment.
         self.carry = None
         self.pending: "collections.deque[_Row]" = collections.deque()
+        # The join group currently mid-prepare: rows popped from
+        # ``pending`` but not yet merged into ``rows``. Without this,
+        # a hung or terminally-failing batched prepare strands its
+        # joiners in a local variable no harvest or bucket-failure path
+        # can see — their Futures would never resolve.
+        self.joining: List[_Row] = []
 
     @property
     def carry_width(self) -> int:
@@ -121,17 +127,51 @@ class _Uploader:
     ``device_prefetch`` pattern applied to serving). Each upload lands in
     the row's trace as a CONCURRENT span — visible in the timeline,
     excluded from the tiled latency partition (it overlaps a running
-    segment by design)."""
+    segment by design).
 
-    def __init__(self, clock):
+    Crash-proofing (graftguard, DESIGN.md r13): a per-row transfer
+    failure was always surfaced on that row, but a crash in the loop
+    itself (trace plumbing, the injected ``ChaosPlan.crash_uploads``
+    fault, any future bug outside the per-row try) used to kill the
+    thread silently and leave every joiner's ``uploaded`` event — and
+    therefore its Future — stranded forever.  Now a thread-killing crash
+    records itself in ``dead``, resolves the current row AND everything
+    still queued with that error (the scheduler turns it into a
+    structured ``upload_failed``), and later ``push`` calls short-
+    circuit the same way.  The watchdog bounces the generation onto a
+    fresh uploader; this class only guarantees nothing is ever stranded.
+    ``dead``/``busy_since`` are plain attributes written by one thread
+    and read by the supervisor — monotonic one-way flags, no lock
+    needed."""
+
+    def __init__(self, clock, faults=None):
         self._clock = clock
+        self._faults = faults
+        self.dead: Optional[BaseException] = None
+        self.busy_since: Optional[float] = None
         self._q: "queue.Queue[Optional[_Row]]" = queue.Queue()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="stereo-uploader")
         self._thread.start()
 
+    @property
+    def alive(self) -> bool:
+        return self._thread.is_alive()
+
+    def _fail_row(self, row: _Row, exc: BaseException) -> None:
+        row.upload_error = exc
+        row.uploaded.set()
+
     def push(self, row: _Row) -> None:
+        if self.dead is not None:
+            self._fail_row(row, self.dead)
+            return
         self._q.put(row)
+        # Death raced the put: the dying loop's queue drain may already
+        # have finished, so re-check — an unresolved ``uploaded`` event
+        # strands the joiner's Future forever.
+        if self.dead is not None and not row.uploaded.is_set():
+            self._fail_row(row, self.dead)
 
     def stop(self) -> None:
         self._q.put(None)
@@ -142,16 +182,36 @@ class _Uploader:
             row = self._q.get()
             if row is None:
                 return
-            t0 = self._clock.now()
             try:
-                lp, rp = row.padder.pad_np(row.request["left"],
-                                           row.request["right"])
-                row.dev_pair = (jax.device_put(lp), jax.device_put(rp))
-            except Exception as e:  # noqa: BLE001 — surfaced per-row
-                row.upload_error = e
-            row.trace.add_span("upload", t0, self._clock.now(),
-                               concurrent=True)
-            row.uploaded.set()
+                self.busy_since = self._clock.now()
+                if self._faults is not None:
+                    self._faults.on_upload()
+                t0 = self._clock.now()
+                try:
+                    lp, rp = row.padder.pad_np(row.request["left"],
+                                               row.request["right"])
+                    row.dev_pair = (jax.device_put(lp), jax.device_put(rp))
+                except Exception as e:  # noqa: BLE001 — surfaced per-row
+                    row.upload_error = e
+                row.trace.add_span("upload", t0, self._clock.now(),
+                                   concurrent=True)
+                row.uploaded.set()
+                self.busy_since = None
+            except BaseException as e:  # noqa: BLE001 — thread-killing crash
+                logger.exception(
+                    "uploader thread died — current and queued joiners "
+                    "fail upload_failed; the watchdog bounces the "
+                    "generation onto a fresh uploader")
+                self.dead = e
+                self._fail_row(row, e)
+                while True:
+                    try:
+                        later = self._q.get_nowait()
+                    except queue.Empty:
+                        break
+                    if later is not None:
+                        self._fail_row(later, e)
+                return
 
 
 class BatchScheduler:
@@ -164,13 +224,25 @@ class BatchScheduler:
     """
 
     def __init__(self, session: InferenceSession, *,
-                 resolve: Optional[Callable[[Dict, Dict], None]] = None):
+                 resolve: Optional[Callable[[Dict, Dict], None]] = None,
+                 retry: Optional[Callable[[Dict, Dict], bool]] = None):
         if session.cfg.max_batch < 2:
             raise ValueError("BatchScheduler needs SessionConfig.max_batch "
                              ">= 2; use the sequential worker path at 1")
         self.session = session
         self.resolve = resolve or self._default_resolve
-        self.uploader = _Uploader(session.clock)
+        # Supervision hooks (serve/supervise.py): ``retry`` is consulted
+        # before a failed response is finalized — True means the service
+        # re-admitted the request under its retry budget and this
+        # scheduler must neither finish the trace nor resolve the
+        # Future.  ``defunct`` is flipped (once, by the service, before
+        # harvest) when a generation bounce retires this scheduler: a
+        # zombie thread waking from a hung device call then discards its
+        # results instead of double-resolving rows the new generation
+        # re-admitted.
+        self.retry = retry
+        self.defunct = False
+        self.uploader = _Uploader(session.clock, faults=session.faults)
         self._buckets: Dict[Tuple[int, int], _Bucket] = {}
         self._rr: List[Tuple[int, int]] = []   # round-robin bucket order
         self._rr_next = 0
@@ -279,26 +351,44 @@ class BatchScheduler:
         ph, pw = bucket.key
 
         # 1. Joins: admit uploaded joiners (FIFO) up to capacity; one
-        # batched prepare builds their carries.
+        # batched prepare builds their carries. The group is published
+        # on ``bucket.joining`` (the same list object — appends are
+        # visible) for the whole window between leaving ``pending`` and
+        # merging into ``rows``: a hang/crash inside the batched prepare
+        # must leave these rows harvestable, never stranded.
         joiners: List[_Row] = []
+        bucket.joining = joiners
         capacity = session.cfg.max_batch - len(bucket.rows)
         while capacity > 0 and bucket.pending and \
                 bucket.pending[0].uploaded.is_set():
             row = bucket.pending.popleft()
+            # Published on ``joining`` BEFORE any respond/admit decision:
+            # a generation bounce landing while this row is only in a
+            # local (its ``_respond`` below discards behind ``defunct``)
+            # must still find it harvestable, never stranded.
+            joiners.append(row)
             if row.upload_error is not None:
                 self.session.count_request(ok=False)
+                # Structured + transient: the retry budget re-admits it
+                # (a bounced generation brings a fresh uploader).
                 self._respond(row, _error(
-                    "internal", f"upload failed: {row.upload_error}"))
+                    "upload_failed",
+                    f"host->device upload failed: {row.upload_error}"))
+                if self.defunct:
+                    return  # harvest() owns the joining rows now
+                joiners.pop()  # resolved or re-admitted: leave the group
                 continue
             now = clock.now()
             if row.deadline is not None and now >= row.deadline:
                 self._respond(row, _reject(
                     "deadline_exceeded_in_queue",
                     "deadline expired before the request joined a batch"))
+                if self.defunct:
+                    return  # harvest() owns the joining rows now
+                joiners.pop()  # resolved: leave the join group
                 continue
             # Queue wait ends here: admission-to-join is the span.
             row.trace.mark("queue_wait")
-            joiners.append(row)
             capacity -= 1
         if joiners:
             bb = session.batch_bucket(len(joiners))
@@ -311,6 +401,9 @@ class BatchScheduler:
             p0 = clock.now()
             (state_j,) = self._device_call("prepare", ph, pw, 0, bb, lb, rb,
                                            traces=[r.trace for r in joiners])
+            if self.defunct:
+                return  # generation retired mid-prepare: harvest() took
+                #         the joining rows; this result is discarded.
             p1 = clock.now()
             # The program id joins this span to its ledger row (flight
             # records collect the rows of every program a request rode).
@@ -330,8 +423,16 @@ class BatchScheduler:
                 bucket.carry = stack_refinement_states([live, state_j])
             bucket.rows.extend(joiners)
             self._m_joins.inc(len(joiners))
+        bucket.joining = []
 
-        n = len(bucket.rows)
+        # Local binding for the rest of the tick: a concurrent generation
+        # bounce REBINDS bucket.rows/carry (harvest), so re-reading the
+        # attribute mid-tick would index a list someone else emptied. The
+        # snapshot keeps this tick's view consistent; every result lands
+        # behind a ``defunct`` check, so a retired tick discards instead
+        # of racing the re-admitted rows.
+        rows = bucket.rows
+        n = len(rows)
         if n == 0:
             return
 
@@ -347,11 +448,13 @@ class BatchScheduler:
         a0 = clock.now()
         state, _rowsum = self._device_call(
             "advance", ph, pw, m_iters, bb, bucket.carry,
-            traces=[r.trace for r in bucket.rows])
+            traces=[r.trace for r in rows])
+        if self.defunct:
+            return  # retired mid-advance: harvest() owns these rows
         a1 = clock.now()
         bucket.carry = state
         adv_id = ledger_id(adv_key)
-        for row in bucket.rows:
+        for row in rows:
             row.iters_done += m_iters
             row.trace.add_span("advance", a0, a1, iters=m_iters,
                                occupancy=n, batch=bb, program=adv_id)
@@ -367,7 +470,7 @@ class BatchScheduler:
         now = clock.now()
         est = session.estimate(adv_key)
         exits: List[int] = []
-        for i, row in enumerate(bucket.rows):
+        for i, row in enumerate(rows):
             if row.iters_done >= session.cfg.valid_iters:
                 exits.append(i)
             elif row.deadline is not None and (
@@ -387,19 +490,23 @@ class BatchScheduler:
         e0 = clock.now()
         (flow_up,) = self._device_call(
             "epilogue", ph, pw, 0, eb, ex_state,
-            traces=[bucket.rows[i].trace for i in exits])
+            traces=[rows[i].trace for i in exits])
+        if self.defunct:
+            return  # retired mid-epilogue: harvest() owns these rows
         e1 = clock.now()
         epi_id = session.ledger_key_id("epilogue", ph, pw, 0, b=eb)
         for i in exits:
-            bucket.rows[i].trace.add_span("epilogue", e0, e1,
-                                          batch=len(exits),
-                                          program=epi_id)
+            rows[i].trace.add_span("epilogue", e0, e1,
+                                   batch=len(exits),
+                                   program=epi_id)
         now = clock.now()
         for j, i in enumerate(exits):
-            self._finish(bucket.rows[i], flow_up[j:j + 1], now)
+            self._finish(rows[i], flow_up[j:j + 1], now)
         self._m_exits.inc(len(exits))
+        if self.defunct:
+            return  # never write stale rows back over a harvested bucket
         survivors = [i for i in range(n) if i not in set(exits)]
-        bucket.rows = [bucket.rows[i] for i in survivors]
+        bucket.rows = [rows[i] for i in survivors]
         bucket.carry = (take_refinement_rows(bucket.carry, survivors)
                         if survivors else None)
 
@@ -432,13 +539,26 @@ class BatchScheduler:
     # -- responses --------------------------------------------------------
 
     def _respond(self, row: _Row, resp: Dict) -> None:
+        if self.defunct:
+            # A retired generation (bounce) never resolves: the new
+            # generation owns these requests now — resolving here would
+            # race the re-admitted run for the same Future.
+            return
         if row.request.get("id") is not None:
             resp.setdefault("id", row.request["id"])
+        if resp["status"] != "ok" and self.retry is not None and \
+                self.retry(row.request, resp):
+            # Re-admitted under the retry budget: the trace stays open
+            # (the retry attempt appends to the same timeline) and the
+            # Future resolves with the retried attempt's response.
+            return
         row.trace.finish(status=resp["status"], code=resp.get("code"),
                          quality=resp.get("quality"))
         self.resolve(row.request, resp)
 
     def _finish(self, row: _Row, flow_padded: np.ndarray, now: float) -> None:
+        if self.defunct:
+            return  # retired generation: don't even count the attempt
         session = self.session
         with row.trace.span("unpad"):
             flow = row.padder.unpad_np(flow_padded)[0, ..., 0]
@@ -467,11 +587,28 @@ class BatchScheduler:
                                 and now > row.deadline),
         })
 
+    @staticmethod
+    def _bucket_rows(bucket: _Bucket) -> List[_Row]:
+        """Every row the bucket currently owns — active, mid-prepare
+        (``joining``), and still-pending — deduped by identity (a row is
+        in both ``rows`` and ``joining`` for the instants between the
+        join merge and the ``joining`` reset)."""
+        seen = set()
+        out: List[_Row] = []
+        for row in (list(bucket.rows) + list(bucket.joining)
+                    + list(bucket.pending)):
+            if id(row) not in seen:
+                seen.add(id(row))
+                out.append(row)
+        return out
+
     def _fail_bucket(self, bucket: _Bucket, exc: Exception) -> None:
         """Terminal tick failure: every request in the bucket gets a
         structured error (never an abandoned Future), the bucket resets."""
+        if self.defunct:
+            return  # harvest() owns these rows; a zombie's failure is moot
         code = exc.code if isinstance(exc, SessionError) else "internal"
-        for row in list(bucket.rows) + list(bucket.pending):
+        for row in self._bucket_rows(bucket):
             # Mirror the sequential path's accounting (infer() increments
             # requests_failed on every exception): /healthz session
             # counters stay one truth across serving modes.
@@ -479,6 +616,7 @@ class BatchScheduler:
             self._respond(row, _error(
                 code, f"batched tick failed: {exc}"))
         bucket.rows = []
+        bucket.joining = []
         bucket.carry = None
         bucket.pending.clear()
 
@@ -500,14 +638,50 @@ class BatchScheduler:
         """Reject everything still waiting or mid-flight (hard shutdown)."""
         self.drain_pending(code, message)
         for bucket in self._bucket_list():
-            for row in bucket.rows:
+            for row in list(bucket.rows) + list(bucket.joining):
                 self._respond(row, _reject(code, message))
             bucket.rows = []
+            bucket.joining = []
             bucket.carry = None
         self.shutdown()
 
     def shutdown(self) -> None:
         self.uploader.stop()
+
+    # -- supervision (serve/supervise.py) ----------------------------------
+
+    def inflight_requests(self) -> List[Dict]:
+        """Request dicts of every row currently riding this scheduler
+        (active + pending joiners), read-only — the drain path stamps
+        decision events on their timelines."""
+        return [row.request for bucket in self._bucket_list()
+                for row in self._bucket_rows(bucket)]
+
+    def harvest(self) -> List[Dict]:
+        """Generation bounce: strip every admitted request (active rows
+        + pending joiners) out of the batch state and return their
+        request dicts for re-admission — original host inputs are still
+        held on each dict, so nothing is silently dropped.
+
+        Call ONLY after ``defunct`` is set and this generation's stop
+        event fired: a zombie thread waking from a hung device call
+        checks ``defunct`` behind every device call (discarding its
+        results), its loop exits immediately, and its ``_respond``
+        discards instead of double-resolving.  The ``joining`` group —
+        rows mid-batched-prepare, already popped from ``pending`` — is
+        harvested too: a hung prepare must strand nothing.  Device-side
+        carries are abandoned with the generation; re-admitted rows
+        re-upload from host."""
+        out: List[Dict] = []
+        for bucket in self._bucket_list():
+            rows = self._bucket_rows(bucket)
+            bucket.rows = []
+            bucket.joining = []
+            bucket.pending.clear()
+            bucket.carry = None
+            out.extend(row.request for row in rows)
+        self.shutdown()
+        return out
 
     # -- reporting --------------------------------------------------------
 

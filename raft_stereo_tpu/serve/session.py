@@ -55,6 +55,7 @@ from raft_stereo_tpu.obs.tracing import NULL_TRACE, Tracer
 from raft_stereo_tpu.ops.padder import InputPadder
 from raft_stereo_tpu.serve.guard import (KernelCircuitBreaker, CANARY_ATOL,
                                          CANARY_RTOL, is_kernel_failure)
+from raft_stereo_tpu.serve.supervise import InvocationWatch
 from raft_stereo_tpu.serve.validate import AdmissionConfig, validate_pair
 
 logger = logging.getLogger(__name__)
@@ -428,6 +429,11 @@ class InferenceSession:
         self.breaker = breaker or KernelCircuitBreaker()
         self.breaker.bind_registry(self.registry)
         self.faults = ServeFaults(fault_plan, clock=self.clock)
+        # graftguard (serve/supervise.py): every device invocation is
+        # bracketed in this watch so a supervisor can classify a hung
+        # call (age > max(EMA x factor, floor)) without the session
+        # knowing any watchdog policy.
+        self.watch = InvocationWatch(self.clock)
         self._cache: "OrderedDict[Tuple, _Program]" = OrderedDict()
         self._cache_lock = threading.Lock()
         self._key_locks: Dict[Tuple, threading.Lock] = {}
@@ -689,7 +695,17 @@ class InferenceSession:
         was_warm = prog.warmed
         t0 = self.clock.now()
         t_disp = t0
+        # Supervision bracket: the invocation is registered for the
+        # watchdog's whole device window (including the injected-hang
+        # hook below, which models a hung device call parked INSIDE the
+        # bracket).  Post-invocation bookkeeping (metrics, injected slow
+        # forwards) happens after end() — a merely slow forward can
+        # never read as a hang.
+        token = self.watch.begin(prog.ledger_id, prog.kind,
+                                 warming=not was_warm,
+                                 est=self.estimate(prog.key))
         try:
+            self.faults.on_invoke()
             if not prog.warmed:
                 with prog.lock:
                     with _TRACE_LOCK, _env_overrides(prog.env):
@@ -712,6 +728,8 @@ class InferenceSession:
             if not hasattr(e, "_raft_phase"):
                 setattr(e, "_raft_phase", "runtime_failure")
             raise
+        finally:
+            self.watch.end(token)
         ordinal = self.faults.on_forward()
         t_end = self.clock.now()  # includes any injected device time
         self.registry.counter(

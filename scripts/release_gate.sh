@@ -60,9 +60,26 @@ bash scripts/run_tier1.sh || { echo "FAIL: tier-1"; fail=1; }
 # (tests/test_serve.py::test_clean_path_zero_trips).
 step "serving fault storm (injected compile failures / deadline overruns / bad inputs)"
 env JAX_PLATFORMS=cpu python -m pytest tests/test_serve.py \
-    tests/test_batch_serve.py -q -m serve \
+    tests/test_batch_serve.py tests/test_supervise.py -q -m serve \
     -p no:cacheprovider -p no:xdist -p no:randomly \
     || { echo "FAIL: serving fault storm"; fail=1; }
+
+# Chaos soak (ISSUE 9 acceptance, DESIGN.md r13): 200 seeded requests
+# through the real batched StereoService under a composite fault storm
+# (device hangs, a tick-loop crash, an uploader crash, a compile failure,
+# poisoned outputs, slow forwards on FakeClock) with the watchdog pumped.
+# Asserts IN-PROCESS: 100% structured resolution inside a 60 s real-time
+# bound, zero abandoned Futures/deadlocks, monotone breaker trips,
+# counters reconciling with outcomes, and a flight record behind every
+# generation bounce. CPU always (fixed seed), one JSON line into the
+# trajectory artifact; the soak artifact is echoed on failure.
+step "chaos soak (seeded fault storm vs supervision invariants)"
+if env JAX_PLATFORMS=cpu python scratch/chaos_serve.py > chaos_soak.json; then
+    cat chaos_soak.json
+else
+    echo "--- chaos_soak.json ---"; cat chaos_soak.json
+    echo "FAIL: chaos soak"; fail=1
+fi
 
 # Observability battery (ISSUE 7 + 8 acceptance): FakeClock span
 # timelines that reconcile with reported latency, the /metrics golden,

@@ -31,6 +31,7 @@ import argparse
 import glob
 import json
 import logging
+import threading
 from pathlib import Path
 
 
@@ -101,6 +102,26 @@ def build_parser() -> argparse.ArgumentParser:
                         "this (or any breaker trip / missed deadline / "
                         "non-finite output) persists a bounded flight "
                         "record to RAFT_FLIGHT_DIR")
+    # graftguard: supervision + drain (DESIGN.md r13). The CLI defaults
+    # the watchdog ON (the library default is off so test rigs with fake
+    # clocks never race a real-time monitor).
+    parser.add_argument('--watchdog_ms', type=float, default=10_000.0,
+                        help="hang-watchdog deadline floor: a device "
+                        "invocation older than max(EMA*4, this) bounces "
+                        "the scheduler generation and re-admits its rows "
+                        "(0 disables; default 10s)")
+    parser.add_argument('--retry_budget', type=int, default=None,
+                        help="bounded re-admissions per request for "
+                        "transient failures (uploader death, generation "
+                        "bounce, a first non-finite output); responses "
+                        "carry 'retries: k' (default RAFT_RETRY_BUDGET "
+                        "or 2)")
+    parser.add_argument('--drain_grace_ms', type=float, default=None,
+                        help="SIGTERM/SIGINT graceful-drain hard "
+                        "deadline: admitted requests run to their "
+                        "segment-boundary exits within this window, "
+                        "then the rest resolve service_stopped (default "
+                        "RAFT_DRAIN_GRACE_MS or 10s)")
     add_model_args(parser)
     return parser
 
@@ -153,7 +174,35 @@ def serve(args) -> int:
             admission=AdmissionConfig(max_pixels=args.max_pixels)))
     service = StereoService(session, ServiceConfig(
         max_queue=args.max_queue, workers=args.workers,
-        tick_ms=args.tick_ms, slo_ms=args.slo_ms))
+        tick_ms=args.tick_ms, slo_ms=args.slo_ms,
+        watchdog_ms=args.watchdog_ms, retry_budget=args.retry_budget,
+        drain_grace_ms=args.drain_grace_ms))
+
+    # Graceful drain on SIGTERM/SIGINT (ROADMAP open item 4): the handler
+    # only sets a flag (async-signal-safe); the submit loop below flips
+    # the service into draining at the next response boundary — admitted
+    # requests run to their segment-boundary exits with honest labels,
+    # late submits are rejected ``service_draining``, telemetry flushes,
+    # and a clean preemption exits 0. A SECOND signal restores the
+    # default disposition and redelivers itself — the operator's
+    # escalation path when the graceful drain is wedged.
+    import os
+    import signal
+    stop_requested = threading.Event()
+
+    def _request_drain(signum, frame):  # noqa: ARG001 — signal signature
+        if stop_requested.is_set():
+            signal.signal(signum, signal.SIG_DFL)
+            os.kill(os.getpid(), signum)
+            return
+        stop_requested.set()
+
+    prev_handlers = {}
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            prev_handlers[sig] = signal.signal(sig, _request_drain)
+        except ValueError:  # non-main thread (embedded use): skip
+            pass
 
     left_images = sorted(glob.glob(args.left_imgs, recursive=True))
     right_images = sorted(glob.glob(args.right_imgs, recursive=True))
@@ -167,16 +216,65 @@ def serve(args) -> int:
     if out_dir is not None:
         out_dir.mkdir(parents=True, exist_ok=True)
 
+    import time
+    from concurrent.futures import TimeoutError as FuturesTimeout
+
+    from raft_stereo_tpu.serve.supervise import resolve_drain_grace_ms
+
+    grace_s = resolve_drain_grace_ms(args.drain_grace_ms) / 1e3
     failures = 0
     seq = 0
+    draining = False
+    # Bounds the consume loop once a drain begins: grace expiry force-
+    # stops the service (resolving everything resolvable), and a Future
+    # that survives even that (a wedged device call with supervision
+    # off) is abandoned honestly rather than hanging the exit path.
+    drain_track = {"deadline": None, "stopped": False}
 
-    def drain(fut) -> None:
+    def begin_drain_once() -> None:
+        nonlocal draining
+        if not draining:
+            draining = True
+            print(json.dumps({"event": "draining",
+                              "reason": "signal received"}))
+            service.begin_drain()
+
+    def consume(fut) -> None:
         nonlocal failures, seq
-        resp = fut.result()
+        # Short-poll instead of a blocking result(): the signal handler
+        # only sets a flag, so the drain flip must happen here, on the
+        # submit loop's thread, within one poll interval of the signal.
+        while True:
+            try:
+                resp = fut.result(timeout=0.2)
+                break
+            except FuturesTimeout:
+                if not stop_requested.is_set():
+                    continue
+                begin_drain_once()
+                now = time.monotonic()
+                if drain_track["deadline"] is None:
+                    drain_track["deadline"] = now + grace_s
+                elif not drain_track["stopped"] and \
+                        now >= drain_track["deadline"]:
+                    drain_track["stopped"] = True
+                    service.stop()  # force-resolve the still-resolvable
+                elif drain_track["stopped"] and \
+                        now >= drain_track["deadline"] + 5.0:
+                    failures += 1
+                    print(json.dumps({
+                        "status": "error", "code": "abandoned_at_drain",
+                        "message": "Future unresolved past the drain "
+                                   "hard deadline (wedged device call "
+                                   "with supervision off?)"}))
+                    return
         line = {k: v for k, v in resp.items() if k != "disparity"}
         print(json.dumps(line, default=str))
         if resp["status"] != "ok":
-            failures += 1
+            # Draining rejections are the *intended* shutdown contract,
+            # not serving failures — they must not flip the exit code.
+            if resp.get("code") != "service_draining":
+                failures += 1
         elif out_dir is not None:
             # Sequence-prefixed: Middlebury-style globs (*/im0.png) share
             # one stem across every scene, which would silently overwrite.
@@ -196,7 +294,8 @@ def serve(args) -> int:
         1, concurrency if args.deadline_ms is not None
         else max(args.max_queue, args.max_batch))
 
-    with service:
+    service.start()
+    try:
         # Drain as we submit: this batch driver respects the service's
         # backpressure by capping its own in-flight requests below the
         # queue bound instead of firing the whole glob at a bounded queue
@@ -206,8 +305,20 @@ def serve(args) -> int:
         from collections import deque
         pending = deque()
         for f1, f2 in zip(left_images, right_images):
+            if stop_requested.is_set():
+                # Submit through the drain WITHOUT paying the decode:
+                # the flip below precedes the submit, so the rejection
+                # is guaranteed — the printed service_draining line
+                # still names each file that was NOT served (the
+                # wire-level proof), at stub cost instead of a full
+                # image read per doomed request.
+                begin_drain_once()
+                stub = np.zeros((1, 32, 32, 3), dtype=np.float32)
+                pending.append(service.submit(
+                    {"id": f1, "left": stub, "right": stub}))
+                continue
             while len(pending) >= inflight_cap:
-                drain(pending.popleft())
+                consume(pending.popleft())
             request = {
                 "id": f1,
                 "left": read_image_rgb(f1).astype(np.float32)[None],
@@ -217,7 +328,19 @@ def serve(args) -> int:
                 request["deadline_ms"] = args.deadline_ms
             pending.append(service.submit(request))
         while pending:
-            drain(pending.popleft())
+            consume(pending.popleft())
+    finally:
+        for sig, handler in prev_handlers.items():
+            signal.signal(sig, handler)
+        if stop_requested.is_set():
+            # A drain whose hard deadline already force-stopped work is
+            # NOT clean, even though drain() on the now-stopped service
+            # quiesces instantly — an orchestrator must not read a
+            # timed-out drain as graceful.
+            clean = service.drain() and not drain_track["stopped"]
+            print(json.dumps({"event": "drained", "clean": clean}))
+        else:
+            service.stop()
 
     status = service.status()
     print(json.dumps(status, indent=2, default=str))
@@ -230,8 +353,15 @@ def serve(args) -> int:
         from raft_stereo_tpu.obs.ledger import save_doc
         save_doc(session.ledger_doc(), args.ledger_out)
     if failures:
+        # Real failures flip the exit code even when a drain signal
+        # arrived — an orchestrator must not read a preempted run with
+        # genuinely failed requests as clean. Draining rejections are
+        # the intended shutdown contract and never count.
         print(f"{failures}/{len(left_images)} requests failed")
-    return 1 if failures else 0
+        return 1
+    # Flight records flushed per-response, final metrics/status written
+    # above — a clean run (drained-on-signal included) is exit 0.
+    return 0
 
 
 def main(argv=None) -> int:

@@ -336,6 +336,21 @@ def test_gl002_real_tree_flight_knob_registered():
     assert hits[0].path.endswith("obs/flight.py")
 
 
+def test_gl002_real_tree_watchdog_knob_registered():
+    # RAFT_WATCHDOG_MS (serve/supervise.py resolve_watchdog_ms) is
+    # covered by SERVE_ENV_KNOBS; drop it and GL002 must fire at the
+    # read site — the r13 supervision knobs cannot silently drift out of
+    # the registry (the drop leaves RAFT_RETRY_BUDGET /
+    # RAFT_DRAIN_GRACE_MS covered so the hit is unambiguous).
+    files = collect_files([str(PACKAGE)], base=str(REPO))
+    reduced = tuple(k for k in knobs.SERVE_ENV_KNOBS + knobs.HOST_ENV_KNOBS
+                    if k != "RAFT_WATCHDOG_MS")
+    rep = run_checkers(Project(files, serve_knobs=reduced))
+    hits = [f for f in rep.findings if f.code == "GL002"]
+    assert hits and "RAFT_WATCHDOG_MS" in hits[0].message
+    assert hits[0].path.endswith("serve/supervise.py")
+
+
 def test_gl002_real_tree_dropped_knob_fails():
     # Acceptance fixture: drop RAFT_CORR_TILE from the registry while its
     # read still exists in corr/pallas_reg.py -> GL002 must fire.

@@ -1,0 +1,438 @@
+"""graftguard battery (DESIGN.md r13): hang watchdogs, generation
+bounces, bounded per-request retries, uploader crash-proofing, drain
+semantics, and the exactly-once resolution contract under stop/tick
+races.
+
+Everything runs on CPU with the tiny model config.  All *deadline math*
+runs on FakeClock (an injected 50 s hang costs zero wall time); the only
+real-time waiting is bounded thread rendezvous (waiting for an injected
+crash to actually kill its thread), same as the rest of the serving
+battery.  No Supervisor monitor thread runs anywhere here: every test
+drives ``Supervisor.check_now()`` synchronously, so detection ordering
+is deterministic.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from raft_stereo_tpu.config import RAFTStereoConfig
+from raft_stereo_tpu.faults import ChaosPlan, FakeClock
+from raft_stereo_tpu.models import init_raft_stereo
+from raft_stereo_tpu.obs.flight import FlightRecorder
+from raft_stereo_tpu.serve import (InferenceSession, ServiceConfig,
+                                   SessionConfig, StereoService, Supervisor)
+from raft_stereo_tpu.serve.supervise import (DEFAULT_DRAIN_GRACE_MS,
+                                             DEFAULT_RETRY_BUDGET,
+                                             InFlight, InvocationWatch,
+                                             WATCHDOG_FACTOR,
+                                             WATCHDOG_WARM_FACTOR,
+                                             resolve_drain_grace_ms,
+                                             resolve_retry_budget,
+                                             resolve_watchdog_ms)
+
+pytestmark = pytest.mark.serve
+
+TINY = dict(n_gru_layers=1, hidden_dims=(32, 32, 32),
+            corr_levels=2, corr_radius=2)
+H, W = 40, 60  # not multiples of 32: every request really is padded
+
+
+@pytest.fixture(scope="module")
+def tiny_cfg():
+    return RAFTStereoConfig(**TINY)
+
+
+@pytest.fixture(scope="module")
+def tiny_params(tiny_cfg):
+    return init_raft_stereo(jax.random.PRNGKey(0), tiny_cfg)
+
+
+@pytest.fixture(scope="module")
+def pairs():
+    rng = np.random.default_rng(7)
+    return [(rng.uniform(0, 255, (H, W, 3)).astype(np.float32)[None],
+             rng.uniform(0, 255, (H, W, 3)).astype(np.float32)[None])
+            for _ in range(4)]
+
+
+def make_service(params, cfg, *, plan=None, flight=None, retry_budget=2,
+                 watchdog_ms=5000.0, max_queue=16):
+    """Batched service with supervision config but NO monitor thread:
+    tests drive ``check_now`` by hand for deterministic ordering."""
+    session = InferenceSession(
+        params, cfg,
+        SessionConfig(valid_iters=4, segments=2, max_batch=4,
+                      canary=False),
+        fault_plan=plan, clock=FakeClock(), flight=flight)
+    svc = StereoService(session, ServiceConfig(
+        max_queue=max_queue, watchdog_ms=watchdog_ms,
+        retry_budget=retry_budget, supervise=False)).start()
+    return session, svc
+
+
+def wait_real(predicate, timeout=30.0, what="condition"):
+    """Bounded real-time rendezvous with an injected thread death (the
+    deadline MATH stays on FakeClock; this only waits for the OS to run
+    the victim thread)."""
+    deadline = time.monotonic() + timeout
+    while not predicate():
+        assert time.monotonic() < deadline, f"timed out waiting: {what}"
+        time.sleep(0.002)
+
+
+def submit(svc, pairs, rid, **kw):
+    left, right = pairs[rid % len(pairs)]
+    return svc.submit({"id": rid, "left": left, "right": right, **kw})
+
+
+# ---------------------------------------------------------------------------
+# Watchdog deadline math (pure, FakeClock-free).
+
+
+def test_watchdog_deadline_math():
+    """Steady = max(EMA x factor, floor); EMA-less steady = floor alone;
+    warming (compile-inclusive) = floor x warm grace, never the EMA rule."""
+    def inv(warming, est):
+        return InFlight(token=0, program="p", kind="advance",
+                        warming=warming, est=est, t0=0.0)
+    floor = 2.0
+    assert InvocationWatch.allowed_s(inv(False, None), floor) == floor
+    assert InvocationWatch.allowed_s(inv(False, 10.0), floor) == \
+        10.0 * WATCHDOG_FACTOR
+    assert InvocationWatch.allowed_s(inv(False, 0.1), floor) == floor
+    assert InvocationWatch.allowed_s(inv(True, 0.1), floor) == \
+        floor * WATCHDOG_WARM_FACTOR
+
+
+def test_invocation_watch_overdue_on_fake_clock():
+    clk = FakeClock()
+    watch = InvocationWatch(clk)
+    token = watch.begin("prog", "advance", warming=False, est=None)
+    assert watch.count == 1
+    assert watch.overdue(clk.now(), 5.0) == []
+    clk.sleep(50.0)
+    rows = watch.overdue(clk.now(), 5.0)
+    assert len(rows) == 1
+    inv, age, allowed = rows[0]
+    assert inv.kind == "advance" and age == 50.0 and allowed == 5.0
+    watch.end(token)
+    assert watch.count == 0 and watch.overdue(clk.now(), 5.0) == []
+
+
+def test_supervision_knobs_resolve_env(monkeypatch):
+    """Explicit config > env knob > default — the SERVE_ENV_KNOBS
+    contract for all three supervision knobs."""
+    for name in ("RAFT_WATCHDOG_MS", "RAFT_RETRY_BUDGET",
+                 "RAFT_DRAIN_GRACE_MS"):
+        monkeypatch.delenv(name, raising=False)
+    assert resolve_watchdog_ms() == 0.0          # library default: off
+    assert resolve_retry_budget() == DEFAULT_RETRY_BUDGET
+    assert resolve_drain_grace_ms() == DEFAULT_DRAIN_GRACE_MS
+    monkeypatch.setenv("RAFT_WATCHDOG_MS", "1234")
+    monkeypatch.setenv("RAFT_RETRY_BUDGET", "7")
+    monkeypatch.setenv("RAFT_DRAIN_GRACE_MS", "2500")
+    assert resolve_watchdog_ms() == 1234.0
+    assert resolve_retry_budget() == 7
+    assert resolve_drain_grace_ms() == 2500.0
+    assert resolve_watchdog_ms(10.0) == 10.0     # explicit beats env
+    assert resolve_retry_budget(0) == 0
+    assert resolve_drain_grace_ms(1.0) == 1.0
+
+
+# ---------------------------------------------------------------------------
+# Satellite bugfix pin: a mid-run uploader crash must never strand its
+# joiners' Futures — structured ``upload_failed``, retries recorded, and
+# the watchdog bounce restores service on a fresh uploader.
+
+
+def test_uploader_crash_is_structured_upload_failed(tiny_params, tiny_cfg,
+                                                    pairs):
+    session, svc = make_service(tiny_params, tiny_cfg,
+                                plan=ChaosPlan(crash_uploads=(0,)),
+                                retry_budget=0)
+    try:
+        r = submit(svc, pairs, 0).result(timeout=60)
+        assert r["status"] == "error" and r["code"] == "upload_failed"
+        hb = svc.supervision_status()["heartbeats"]
+        assert hb["uploader_dead"] is not None
+        # The watchdog heals it: uploader_dead trip -> generation bounce
+        # -> fresh uploader -> the next request serves clean.
+        sup = Supervisor(svc, watchdog_s=5.0)
+        trips = sup.check_now()
+        assert [t.kind for t in trips] == ["uploader_dead"]
+        r2 = submit(svc, pairs, 1).result(timeout=60)
+        assert r2["status"] == "ok" and r2["quality"] == "full"
+        st = svc.supervision_status()
+        assert st["generation"] == 2
+        assert st["restarts"] == {"uploader_dead": 1}
+    finally:
+        svc.stop()
+
+
+def test_uploader_crash_burns_bounded_retries(tiny_params, tiny_cfg, pairs):
+    """Without a bounce, every re-admission meets the same dead uploader:
+    the budget bounds the loop and the final response records it
+    (``retries: k`` — the response contract)."""
+    session, svc = make_service(tiny_params, tiny_cfg,
+                                plan=ChaosPlan(crash_uploads=(0,)),
+                                retry_budget=3)
+    try:
+        r = submit(svc, pairs, 0).result(timeout=60)
+        assert r["status"] == "error" and r["code"] == "upload_failed"
+        assert r["retries"] == 3
+        assert int(session.registry.value(
+            "raft_request_retries_total")) == 3
+    finally:
+        svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# Acceptance pin: injected device hang provably recovers — watchdog
+# fires, the generation bounces, the request retries inside its budget
+# (success) or fails ``device_hang`` (budget exhausted).  FakeClock: the
+# 50 s hang costs zero wall time in the deadline math.
+
+
+def hang_service(tiny_params, tiny_cfg, *, retry_budget):
+    # Invoke ordinals with one warm request ahead: warm rides
+    # prepare(0) advance(1) advance(2) epilogue(3); the victim's steady
+    # advance is ordinal 5 — a STEADY hang, governed by the floor, not
+    # the warm grace.
+    plan = ChaosPlan(hang_invokes={5: 50.0}, hang_cap_s=20.0)
+    return make_service(tiny_params, tiny_cfg, plan=plan,
+                        retry_budget=retry_budget)
+
+
+def test_device_hang_recovers_within_budget(tiny_params, tiny_cfg, pairs):
+    session, svc = hang_service(tiny_params, tiny_cfg, retry_budget=2)
+    try:
+        warm = submit(svc, pairs, 0).result(timeout=120)
+        assert warm["status"] == "ok"
+        fut = submit(svc, pairs, 1)
+        assert session.faults.wait_hang_entered(1, timeout=30)
+        sup = Supervisor(svc, watchdog_s=5.0)
+        trips = sup.check_now()
+        assert [t.kind for t in trips] == ["device_hang"]
+        r = fut.result(timeout=60)
+        assert r["status"] == "ok" and r["quality"] == "full"
+        assert r["retries"] == 1   # the bounce re-admission, recorded
+        st = svc.supervision_status()
+        assert st["generation"] == 2
+        assert st["restarts"] == {"device_hang": 1}
+        assert st["watchdog_trips"] == {"device_hang": 1}
+        # /healthz carries the supervision block end to end.
+        assert svc.status()["supervision"]["generation"] == 2
+    finally:
+        svc.stop()
+
+
+def test_device_hang_budget_exhausted_fails_device_hang(tiny_params,
+                                                        tiny_cfg, pairs):
+    session, svc = hang_service(tiny_params, tiny_cfg, retry_budget=0)
+    try:
+        assert submit(svc, pairs, 0).result(timeout=120)["status"] == "ok"
+        fut = submit(svc, pairs, 1)
+        assert session.faults.wait_hang_entered(1, timeout=30)
+        Supervisor(svc, watchdog_s=5.0).check_now()
+        r = fut.result(timeout=60)
+        assert r["status"] == "error" and r["code"] == "device_hang"
+        assert "retries" not in r   # budget 0: no re-admission happened
+    finally:
+        svc.stop()
+
+
+def test_real_hang_trips_once_not_every_sweep(tiny_params, tiny_cfg):
+    """A REAL device hang never calls watch.end(): without trip memory
+    every sweep would re-detect it and bounce each fresh, healthy
+    generation in a poll-period storm. One hang = one bounce."""
+    session, svc = make_service(tiny_params, tiny_cfg)
+    try:
+        token = session.watch.begin("prog", "advance", warming=False,
+                                    est=None)
+        session.clock.sleep(60.0)
+        sup = Supervisor(svc, watchdog_s=5.0)
+        assert [t.kind for t in sup.check_now()] == ["device_hang"]
+        assert sup.check_now() == []          # same wedged invocation
+        assert sup.check_now() == []
+        st = svc.supervision_status()
+        assert st["generation"] == 2          # exactly ONE bounce
+        assert st["restarts"] == {"device_hang": 1}
+        # The invocation ending clears the memory: a NEW hang trips.
+        session.watch.end(token)
+        session.watch.begin("prog", "advance", warming=False, est=None)
+        session.clock.sleep(60.0)
+        assert [t.kind for t in sup.check_now()] == ["device_hang"]
+        assert svc.supervision_status()["generation"] == 3
+    finally:
+        svc.stop()
+
+
+def test_wedged_uploader_trips_stalled(tiny_params, tiny_cfg):
+    """An uploader wedged mid-transfer (alive, not dead) is otherwise
+    invisible — the tick loop keeps beating while nothing uploads; the
+    busy_since age detector bounces onto a fresh uploader."""
+    session, svc = make_service(tiny_params, tiny_cfg)
+    try:
+        svc._scheduler.uploader.busy_since = session.clock.now()
+        session.clock.sleep(60.0)   # > floor(5) x stall_factor(4)
+        sup = Supervisor(svc, watchdog_s=5.0)
+        assert [t.kind for t in sup.check_now()] == ["uploader_stalled"]
+        st = svc.supervision_status()
+        assert st["generation"] == 2
+        assert st["restarts"] == {"uploader_stalled": 1}
+        assert sup.check_now() == []   # fresh uploader: not busy
+    finally:
+        svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# Acceptance pin: injected tick-loop crash provably recovers — the loop
+# wrapper records the death on the heartbeat, the watchdog bounces the
+# generation, the stranded mid-batch row re-admits and serves.
+
+
+def test_tick_crash_recovers(tiny_params, tiny_cfg, pairs):
+    # Work ticks are deterministic (idle polls don't count): request 0
+    # consumes ticks 0-1; the crash after tick 2 kills the loop with
+    # request 1 mid-batch (joined + one segment advanced).
+    session, svc = make_service(tiny_params, tiny_cfg,
+                                plan=ChaosPlan(crash_ticks=(2,)),
+                                retry_budget=2)
+    try:
+        assert submit(svc, pairs, 0).result(timeout=120)["status"] == "ok"
+        fut = submit(svc, pairs, 1)
+        wait_real(lambda: svc._heartbeat.died is not None,
+                  what="injected tick crash to kill the loop thread")
+        sup = Supervisor(svc, watchdog_s=5.0)
+        trips = sup.check_now()
+        assert [t.kind for t in trips] == ["tick_crashed"]
+        r = fut.result(timeout=60)
+        assert r["status"] == "ok" and r["quality"] == "full"
+        assert r["retries"] == 1
+        st = svc.supervision_status()
+        assert st["generation"] == 2
+        assert st["restarts"] == {"tick_crashed": 1}
+    finally:
+        svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# Flight-recorder sequence numbering survives a generation bounce: the
+# recorder is session-owned (one per lineage, not per generation), so
+# bounce records and post-bounce breach records share one monotone
+# sequence — eviction order stays oldest-first through a restart storm.
+
+
+def test_flight_seq_survives_generation_bounce(tiny_params, tiny_cfg,
+                                               pairs, tmp_path):
+    flight = FlightRecorder(str(tmp_path), limit=16)
+    session, svc = make_service(tiny_params, tiny_cfg, flight=flight)
+    try:
+        assert svc.bounce()
+        assert submit(svc, pairs, 0).result(timeout=120)["status"] == "ok"
+        assert svc.bounce()
+        session.flight.record({"post": True}, trace_id="after")
+        paths = flight.records()
+        seqs = [int(p.split("flight-")[1][:6]) for p in paths]
+        assert seqs == [0, 1, 2]       # monotone across both bounces
+        assert "bounce-g2" in paths[0] and "bounce-g3" in paths[1]
+        import json
+        doc = json.loads(open(paths[0]).read())
+        assert doc["reasons"] == ["watchdog:manual"]
+        assert doc["generation"] == {"from": 1, "to": 2}
+        st = svc.supervision_status()
+        assert st["generation"] == 3 and st["restarts"] == {"manual": 2}
+    finally:
+        svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# Exactly-once resolution: stop() racing an in-flight batched tick must
+# resolve every admitted row exactly once — no abandoned Future, and the
+# outcome counters reconcile (a double resolve would double-count; the
+# request-claim guard in the service pins this).
+
+
+def test_stop_racing_tick_resolves_exactly_once(tiny_params, tiny_cfg,
+                                                pairs):
+    session = InferenceSession(
+        tiny_params, tiny_cfg,
+        SessionConfig(valid_iters=4, segments=2, max_batch=4,
+                      canary=False),
+        clock=FakeClock())
+    reg = session.registry
+
+    def outcome_total():
+        return sum(int(v) for labels, v in
+                   reg.series("raft_requests_total")
+                   if labels["outcome"] != "degraded")
+
+    svc = StereoService(session, ServiceConfig(max_queue=16,
+                                               supervise=False))
+    for round_no in range(3):   # three interleavings of stop vs tick
+        before = outcome_total()
+        svc.start()
+        futs = [submit(svc, pairs, i) for i in range(6)]
+        if round_no == 1:
+            # Let the scheduler provably reach mid-flight before racing.
+            deadline = time.monotonic() + 30
+            while svc._scheduler.active_rows == 0 and \
+                    time.monotonic() < deadline:
+                time.sleep(0.001)
+        svc.stop()
+        responses = [f.result(timeout=60) for f in futs]
+        for r in responses:
+            assert r["status"] in ("ok", "rejected"), r
+            if r["status"] == "rejected":
+                assert r["code"] in ("service_stopped", "not_running")
+        assert outcome_total() - before == len(futs), (
+            "outcome counters disagree with resolved Futures — a row was "
+            "double-resolved or dropped")
+
+
+def test_queue_depth_gauge_registered(tiny_params, tiny_cfg, pairs):
+    session, svc = make_service(tiny_params, tiny_cfg)
+    try:
+        assert submit(svc, pairs, 0).result(timeout=120)["status"] == "ok"
+        assert "raft_queue_depth" in svc.metrics_text()
+        assert int(session.registry.value("raft_queue_depth")) == 0
+    finally:
+        svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# Drain contract (library level; the CLI signal path rides these).
+
+
+def test_drain_rejects_new_and_finishes_admitted(tiny_params, tiny_cfg,
+                                                 pairs):
+    session, svc = make_service(tiny_params, tiny_cfg)
+    try:
+        fut = submit(svc, pairs, 0)
+        svc.begin_drain()
+        late = submit(svc, pairs, 1).result(timeout=10)
+        assert late["status"] == "rejected"
+        assert late["code"] == "service_draining"
+        # Admitted work runs to its exit with an honest label.
+        r = fut.result(timeout=120)
+        assert r["status"] == "ok" and r["quality"] == "full"
+        assert svc.supervision_status()["draining"]
+        assert svc.drain(grace_s=30.0)   # quiesces clean -> True
+    finally:
+        svc.stop()
+
+
+def test_drain_is_idempotent_and_counts(tiny_params, tiny_cfg, pairs):
+    session, svc = make_service(tiny_params, tiny_cfg)
+    svc.begin_drain()
+    svc.begin_drain()
+    r = submit(svc, pairs, 0).result(timeout=10)
+    assert r["code"] == "service_draining"
+    counts = {labels["outcome"]: int(v) for labels, v in
+              session.registry.series("raft_requests_total")}
+    assert counts.get("rejected:service_draining") == 1
+    svc.stop()
