@@ -9,14 +9,17 @@ missing from the registry is the stale-program class the session can only
 runtime-check for ladder rungs: two requests under different switch
 values would silently share one compiled program.
 
-The scan also covers ``serve/`` and ``native/`` (widened in r10) and
-``obs/`` (r11): a ``RAFT_*`` read there is host/serving behavior rather
-than program shape, so it may live in ANY registry (``ENV_KNOBS``,
-``SERVE_ENV_KNOBS`` or ``HOST_ENV_KNOBS``) — but it must live somewhere.
+The scan also covers ``serve/`` and ``native/`` (widened in r10),
+``obs/`` (r11) and ``data/`` (r14): a ``RAFT_*`` read there is
+host/serving behavior rather than program shape, so it may live in ANY
+registry (``ENV_KNOBS``, ``SERVE_ENV_KNOBS`` or ``HOST_ENV_KNOBS``) —
+but it must live somewhere.
 Before the widening, a new env read in serve/ (e.g. ``RAFT_NATIVE``-style
 pipeline switches) was simply invisible to lint and the flag matrix
 drifted; the r11 telemetry knobs (``RAFT_TRACE``/``RAFT_PROFILE_DIR``/
-``RAFT_TRAJECTORY``) are covered from birth.
+``RAFT_TRAJECTORY``) are covered from birth, and so is the r14
+decode-bomb cap (``RAFT_DECODE_MAX_PIXELS``, read in
+``data/frame_utils.py``).
 """
 
 from __future__ import annotations
@@ -33,8 +36,9 @@ FORWARD_DIRS = ("models", "ops", "corr")
 
 #: Path segments whose RAFT_* reads are host/serving behavior: they must
 #: appear in SOME registry (ENV_KNOBS counts too — a forward knob read
-#: from serve/ is legal) so the flag matrix has one home.
-HOST_DIRS = ("serve", "native", "obs")
+#: from serve/ is legal) so the flag matrix has one home. ``data`` joined
+#: in r14 (the ingress decode-bomb cap lives in data/frame_utils.py).
+HOST_DIRS = ("serve", "native", "obs", "data")
 
 
 def is_forward_module(relpath: str) -> bool:
@@ -50,7 +54,7 @@ class KnobRegistryChecker(Checker):
     name = "knob-registry"
     description = ("RAFT_* env read missing from the knob registries — "
                    "ENV_KNOBS for forward modules (models/ops/corr), any "
-                   "registry for host modules (serve/native)")
+                   "registry for host modules (serve/native/obs/data)")
 
     def check_file(self, project: Project, sf: SourceFile
                    ) -> Iterator[Finding]:

@@ -67,6 +67,26 @@ SERVE_ENV_KNOBS: Tuple[str, ...] = (
                             # transient failures (serve/supervise.py)
     "RAFT_DRAIN_GRACE_MS",  # graceful-drain hard deadline, ms
                             # (serve/supervise.py)
+    # graftwire HTTP ingress knobs (DESIGN.md r14) — same rule again:
+    # each steers the WIRE side of serving (where the listener binds,
+    # how many body bytes one request may declare, how long a socket
+    # read may stall, how fast one tenant may submit), resolved once at
+    # frontend construction (serve/http.py resolve_* helpers with
+    # named-ValueError parsing), and no compiled program's bytes depend
+    # on any of them — fingerprinting them would recompile the cache
+    # because an operator moved a port.
+    "RAFT_HTTP_PORT",          # listen port (serve/http.py, frontend
+                               # construction; 0 = ephemeral)
+    "RAFT_HTTP_BODY_MAX",      # hard content-length cap, bytes —
+                               # oversize declarations are 413 BEFORE any
+                               # body byte buffers (serve/http.py)
+    "RAFT_HTTP_READ_TIMEOUT_MS",  # per-read socket timeout, ms; the
+                               # whole body must land within
+                               # BODY_DEADLINE_FACTOR of these
+                               # (serve/http.py)
+    "RAFT_TENANT_RATE",        # per-tenant token-bucket admission quota,
+                               # "rate[:burst]" requests/s; unset =
+                               # unlimited (serve/http.py)
 )
 
 # Host-pipeline env knobs: they steer HOST code (the data loader's native
@@ -100,6 +120,14 @@ HOST_ENV_KNOBS: Tuple[str, ...] = (
     "RAFT_CHAOS_SPEC",      # chaos-soak overrides (JSON: n/seed/fault
                             # mix) for scratch/chaos_serve.py — drives a
                             # test harness, never a compiled program
+    "RAFT_DECODE_MAX_PIXELS",  # decompression-bomb guard: cap on an
+                            # image's HEADER-DECLARED pixel count,
+                            # checked before any full decode
+                            # (data/frame_utils.py read_image_rgb + the
+                            # serve/wire.py ingress decode). Host decode
+                            # policy only — admitted arrays are already
+                            # bounded by AdmissionConfig.max_pixels, so
+                            # no compiled program's shape depends on it
 )
 
 

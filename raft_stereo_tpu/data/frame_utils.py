@@ -35,6 +35,65 @@ FLO_MAGIC = 202021.25
 
 
 # ---------------------------------------------------------------------------
+# Decompression-bomb guard (graftwire, DESIGN.md r14)
+# ---------------------------------------------------------------------------
+
+#: Default cap on header-declared decoded pixel count (~33.5 MP): four
+#: times the serving admission cap (8 MP), so a legitimately oversized
+#: frame is still rejected by admission with its own ``too_large`` code,
+#: while a crafted 100 MP PNG header (whose RGB decode would allocate
+#: ~300 MB from a few hundred file bytes) never reaches the decoder at
+#: all. Override with ``RAFT_DECODE_MAX_PIXELS`` (registered in
+#: analysis/knobs.py HOST_ENV_KNOBS) or per call.
+DEFAULT_DECODE_MAX_PIXELS = 32 << 20
+
+
+class ImageTooLarge(ValueError):
+    """Header-declared pixel count exceeds the decode cap.
+
+    Raised BEFORE any full decode happens — PIL parses image headers
+    lazily, so the only bytes touched are the header. ``code`` is the
+    stable serving rejection code (the HTTP ingress maps it to 413).
+    """
+
+    code = "image_too_large"
+
+
+def resolve_decode_max_pixels(value: Optional[int] = None) -> int:
+    """Effective decode pixel cap: explicit value wins, else
+    ``RAFT_DECODE_MAX_PIXELS``, else the default. A malformed env value
+    raises a ValueError NAMING the variable (the SLURM_CPUS_PER_TASK
+    convention) instead of a bare ``int()`` traceback."""
+    if value is not None:
+        return int(value)
+    raw = os.environ.get("RAFT_DECODE_MAX_PIXELS", "").strip()
+    if not raw:
+        return DEFAULT_DECODE_MAX_PIXELS
+    try:
+        return int(raw)
+    except ValueError:
+        raise ValueError(
+            f"RAFT_DECODE_MAX_PIXELS must be an integer pixel count, "
+            f"got {raw!r}") from None
+
+
+def guard_decode_size(size, source: str = "image",
+                      max_pixels: Optional[int] = None) -> None:
+    """Reject a decode whose header declares more pixels than the cap.
+
+    ``size`` is PIL's ``(width, height)``. Called between the lazy header
+    parse and the array conversion that triggers the actual pixel decode
+    — the whole point is that a decompression bomb costs a header read,
+    never an allocation proportional to its declared area."""
+    w, h = int(size[0]), int(size[1])
+    cap = resolve_decode_max_pixels(max_pixels)
+    if w * h > cap:
+        raise ImageTooLarge(
+            f"{source}: header declares {w}x{h} = {w * h} px, above the "
+            f"decode cap of {cap} px (RAFT_DECODE_MAX_PIXELS)")
+
+
+# ---------------------------------------------------------------------------
 # PFM (portable float map)
 # ---------------------------------------------------------------------------
 
@@ -197,8 +256,24 @@ def read_gen(path, pil: bool = False):
 
 
 def read_image_rgb(path) -> np.ndarray:
-    """Read an image as (H, W, 3) uint8, tiling grayscale to 3 channels."""
-    img = np.asarray(read_gen(path)).astype(np.uint8)
+    """Read an image as (H, W, 3) uint8, tiling grayscale to 3 channels.
+
+    Guarded against decompression bombs: PIL's ``open`` only parses the
+    header, so the declared pixel count is checked against
+    ``RAFT_DECODE_MAX_PIXELS`` *before* ``np.asarray`` triggers the full
+    decode (:class:`ImageTooLarge` on violation — same stable
+    ``image_too_large`` code the HTTP ingress serves as 413). PIL's own
+    bomb tripwire (``MAX_IMAGE_PIXELS``, which fires inside ``open`` for
+    declarations ~5x above our default cap) is folded into the SAME
+    stable code — which guard fires first is a threshold detail, not two
+    error contracts."""
+    try:
+        img = read_gen(path)
+    except Image.DecompressionBombError as e:
+        raise ImageTooLarge(f"{path}: {e}") from e
+    if isinstance(img, Image.Image):
+        guard_decode_size(img.size, source=str(path))
+    img = np.asarray(img).astype(np.uint8)
     if img.ndim == 2:
         return np.tile(img[..., None], (1, 1, 3))
     return img[..., :3]

@@ -363,6 +363,101 @@ class ServeFaults:
         return n
 
 
+# ---------------------------------------------------------------------------
+# Wire-level injectors (graftwire, serve/http.py + scratch/chaos_serve.py
+# --wire). Unlike every plan above, these describe CLIENT behavior: the
+# hostile things a network peer does to an ingress — truncating an upload,
+# stalling a socket at a chosen byte, flooding headers, disconnecting
+# mid-request, sending garbage or a decompression bomb. The storm driver
+# plays them over real loopback sockets; the server side is entirely
+# unmodified production code, which is the point.
+
+
+#: Every hostile client behavior the wire storm can inject, with the
+#: structured code (or connection outcome) the ingress must answer.
+WIRE_FAULT_KINDS: Tuple[str, ...] = (
+    "ok",                        # well-formed request -> 200
+    "truncated_body",            # short body + half-close -> 400
+    "stalled_body",              # stop sending mid-body -> 408
+    "garbage_image",             # undecodable part bytes -> 400
+    "bomb_image",                # crafted huge-header PNG -> 413
+    "header_flood",              # >100 headers -> 431
+    "disconnect_mid_request",    # close without reading the response
+    "oversize_content_length",   # declared length > cap -> 413
+    "empty_body",                # Content-Length: 0 -> 400
+    "bad_multipart",             # boundary-less multipart -> 400
+    "wrong_route",               # POST /v1/nope -> 404
+    "bad_method",                # DELETE /v1/stereo -> 405
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class WireChaosPlan:
+    """Deterministic client-side fault schedule for the network storm.
+
+    faults: request ordinal -> fault kind (one of
+        :data:`WIRE_FAULT_KINDS`); ordinals absent from the map are
+        well-formed requests. Same stance as every plan here: explicit
+        values keyed on deterministic ordinals, so a storm replays
+        identically on every run.
+    truncate_frac / stall_frac: the deterministic BYTE ordinal (as a
+        fraction of the encoded body) at which a truncating client stops
+        sending / a stalling client goes silent.
+    stall_hold_s: how long a stalled client keeps its socket open
+        waiting for the server's verdict (must exceed the ingress
+        per-read timeout for the fault to be non-vacuous).
+    flood_headers: header count for the flood fault (the stdlib parser
+        rejects past 100).
+    """
+
+    faults: Mapping[int, str] = dataclasses.field(default_factory=dict)
+    truncate_frac: float = 0.5
+    stall_frac: float = 0.25
+    stall_hold_s: float = 5.0
+    flood_headers: int = 150
+
+    @staticmethod
+    def seeded(seed: int, n: int, hostile_frac: float = 0.5,
+               kinds: Optional[Tuple[str, ...]] = None) -> "WireChaosPlan":
+        """A reproducible storm: ``hostile_frac`` of ``n`` ordinals get a
+        fault kind drawn round-robin-shuffled from ``kinds`` (default:
+        every kind except ``ok``), the rest stay well-formed."""
+        kinds = tuple(kinds if kinds is not None else
+                      [k for k in WIRE_FAULT_KINDS if k != "ok"])
+        rng = np.random.default_rng(seed)
+        n_hostile = int(n * hostile_frac)
+        ordinals = rng.choice(n, size=n_hostile, replace=False)
+        # Every kind appears before any repeats (shuffled blocks), so a
+        # small storm still exercises the full fault surface.
+        assignment = []
+        while len(assignment) < n_hostile:
+            block = list(kinds)
+            rng.shuffle(block)
+            assignment.extend(block)
+        faults = {int(o): assignment[i]
+                  for i, o in enumerate(sorted(int(x) for x in ordinals))}
+        return WireChaosPlan(faults=faults)
+
+
+def bomb_png(width: int, height: int) -> bytes:
+    """A syntactically valid PNG whose IHDR declares ``width x height``
+    pixels backed by almost no data — the crafted decompression bomb the
+    ingress guard must reject from the HEADER alone (a real decode of a
+    100 MP declaration would allocate ~300 MB from these few hundred
+    bytes)."""
+    import struct
+    import zlib
+
+    def chunk(typ: bytes, data: bytes) -> bytes:
+        return (struct.pack(">I", len(data)) + typ + data
+                + struct.pack(">I", zlib.crc32(typ + data) & 0xFFFFFFFF))
+
+    ihdr = struct.pack(">IIBBBBB", width, height, 8, 2, 0, 0, 0)
+    idat = zlib.compress(b"\x00")
+    return (b"\x89PNG\r\n\x1a\n" + chunk(b"IHDR", ihdr)
+            + chunk(b"IDAT", idat) + chunk(b"IEND", b""))
+
+
 def poison_disparity(arr: np.ndarray) -> np.ndarray:
     """NaN-corrupt a disparity field (injected silently-wrong kernel).
     Poisons the CENTER pixel — corner pixels sit in the bucket padding and

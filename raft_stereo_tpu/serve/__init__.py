@@ -19,7 +19,15 @@ Layers, bottom up:
 - ``supervise`` — graftguard: hang watchdogs over every device
                   invocation, tick-loop/uploader liveness, scheduler
                   generation bounces with bounded per-request retries,
-                  and graceful drain (SIGTERM) semantics.
+                  and graceful drain (SIGTERM) semantics;
+- ``wire``      — graftwire codec: hostile-input request parsing
+                  (strict multipart / raw-pair framing), bomb-guarded
+                  image decode, response-contract serialization and the
+                  honest HTTP status mapping;
+- ``http``      — graftwire frontend: stdlib HTTP/1.1 listener with
+                  per-read socket timeouts, a hard content-length cap,
+                  decode offload, per-tenant token-bucket quotas and
+                  real /healthz + /metrics endpoints.
 
 Everything is CPU-testable with deterministic injected faults
 (``raft_stereo_tpu.faults.ServeFaultPlan``).
@@ -53,4 +61,8 @@ from raft_stereo_tpu.serve.session import (  # noqa: F401
 from raft_stereo_tpu.serve.validate import (  # noqa: F401
     AdmissionConfig,
     InputRejected,
+)
+from raft_stereo_tpu.serve.http import (  # noqa: F401
+    HttpConfig,
+    HttpFrontend,
 )

@@ -23,6 +23,13 @@ Env overrides (RAFT_SERVE_BENCH_*):
   MAX_BATCH      batched mode's ceiling    (default 8)
   CORR           corr implementation       (default reg_tpu on TPU, reg off)
   TINY=1         32-dim 1-GRU model at 64x96 (the CPU gate smoke)
+  LOOPBACK=1     also run the graftwire loopback-network mode (ROADMAP
+                 item 4): the SAME closed-loop workload served over real
+                 sockets through serve/http.py — requests/s vs the
+                 in-process number quantifies the whole wire overhead
+                 (HTTP parse, multipart, PNG decode in the offload pool,
+                 JSON+b64 response). Folded into the one JSON line as
+                 ``loopback_rps`` plus its own TRAJECTORY entry.
 """
 
 from __future__ import annotations
@@ -135,12 +142,89 @@ def main() -> None:
             out["ticks"] = b["ticks"]
         return out
 
+    def run_loopback(mb: int) -> dict:
+        """The batched workload again, but over REAL loopback sockets
+        through the graftwire frontend — closed-loop clients, request
+        bodies pre-encoded outside the timed region so the number
+        isolates what the SERVER pays for the wire: HTTP parse, strict
+        multipart, offloaded PNG decode, JSON+b64 response."""
+        import socket
+
+        from raft_stereo_tpu.serve import HttpConfig, HttpFrontend
+        from raft_stereo_tpu.serve import wire as wire_codec
+
+        session = InferenceSession(
+            params, cfg,
+            SessionConfig(valid_iters=iters, segments=segments,
+                          max_batch=mb,
+                          warmup_shapes=((h, w),),
+                          warmup_segmented=True))
+        service = StereoService(session, ServiceConfig(
+            max_queue=max(8, 2 * mb), workers=1))
+        bodies = []
+        for i in range(len(pairs)):
+            left, right = pairs[i % len(pairs)]
+            ct, body = wire_codec.build_multipart({
+                "left": wire_codec.encode_image_png(
+                    left.astype("uint8")),
+                "right": wire_codec.encode_image_png(
+                    right.astype("uint8"))})
+            head = (f"POST /v1/stereo HTTP/1.1\r\nHost: bench\r\n"
+                    f"Content-Type: {ct}\r\n"
+                    f"Content-Length: {len(body)}\r\n"
+                    f"Connection: close\r\n\r\n").encode("latin-1")
+            bodies.append(head + body)
+
+        def one(i: int) -> int:
+            with socket.create_connection(
+                    (fe.host, fe.port), timeout=3600) as s:
+                s.sendall(bodies[i % len(bodies)])
+                chunks = []
+                while True:
+                    b = s.recv(65536)
+                    if not b:
+                        break
+                    chunks.append(b)
+            raw = b"".join(chunks)
+            return int(raw.split(b" ", 2)[1])
+
+        from collections import deque
+        from concurrent.futures import ThreadPoolExecutor
+        inflight_cap = max(2 * mb, 8)
+        statuses = []
+        with service:
+            with HttpFrontend(service, HttpConfig(port=0)) as fe:
+                with ThreadPoolExecutor(
+                        max_workers=inflight_cap,
+                        thread_name_prefix="bench-client") as pool:
+                    pending: deque = deque()
+                    t0 = time.perf_counter()
+                    for i in range(n_requests):
+                        while len(pending) >= inflight_cap:
+                            statuses.append(
+                                pending.popleft().result(timeout=3600))
+                        pending.append(pool.submit(one, i))
+                    while pending:
+                        statuses.append(
+                            pending.popleft().result(timeout=3600))
+                    elapsed = time.perf_counter() - t0
+        bad = [s for s in statuses if s != 200]
+        if bad:
+            raise AssertionError(
+                f"loopback mode: {len(bad)} non-200 responses, "
+                f"first: {bad[0]}")
+        return {"rps": n_requests / elapsed, "elapsed_s": elapsed}
+
     # Sequential first (its warmup also proves the shape compiles), then
     # batched. Separate sessions: programs differ by batch bucket anyway,
     # and separate caches keep the two measurements independent.
     seq = run_mode(1)
     bat = run_mode(max_batch)
     speedup = bat["rps"] / seq["rps"] if seq["rps"] else None
+    loopback = None
+    if os.environ.get("RAFT_SERVE_BENCH_LOOPBACK", "0").strip().lower() \
+            not in ("0", "false", "no", "off", ""):
+        loopback = run_loopback(max_batch)
 
     doc = {
         "metric": (f"serve_requests_per_s_{h}x{w}_i{iters}_{corr}"
@@ -155,6 +239,11 @@ def main() -> None:
         "pad_waste": bat.get("pad_waste"),
         "backend": jax.default_backend(),
     }
+    if loopback is not None:
+        doc["loopback_rps"] = round(loopback["rps"], 4)
+        doc["wire_overhead_frac"] = (
+            round(1.0 - loopback["rps"] / bat["rps"], 4)
+            if bat["rps"] else None)
     print(json.dumps(doc))
 
     # Consolidated perf-trajectory artifact (DESIGN.md r11): serve
@@ -165,6 +254,14 @@ def main() -> None:
          backend=jax.default_backend(), source="scratch/bench_serve.py",
          extra={"sequential_rps": doc["sequential_rps"],
                 "speedup_vs_sequential": doc["speedup_vs_sequential"]})
+    if loopback is not None:
+        emit(doc["metric"].replace("serve_requests_per_s",
+                                   "serve_loopback_requests_per_s"),
+             loopback["rps"], "requests/s",
+             backend=jax.default_backend(),
+             source="scratch/bench_serve.py",
+             extra={"inprocess_rps": doc["value"],
+                    "wire_overhead_frac": doc["wire_overhead_frac"]})
 
 
 if __name__ == "__main__":

@@ -1,5 +1,8 @@
 """Seeded chaos soak for the supervised serving stack (graftguard,
 DESIGN.md r13) — the release-gate proof that self-healing actually heals.
+``--wire`` switches to the graftwire network storm (DESIGN.md r14): the
+same seeded-determinism stance, but the faults are HOSTILE CLIENTS over
+real loopback sockets and the server side is unmodified production code.
 
 Drives N seeded requests through the REAL ``StereoService`` (continuous
 batching, retry budget, watchdog supervision armed) under a composite
@@ -276,10 +279,370 @@ def main() -> int:
     return 0
 
 
-if __name__ == "__main__":
+# ---------------------------------------------------------------------------
+# Wire storm (graftwire, DESIGN.md r14): hostile clients over real loopback
+# sockets. The fault plan describes CLIENT behavior; the listener, codec,
+# decode pool and service underneath are unmodified production code.
+# ---------------------------------------------------------------------------
+
+#: Hard real-time bound on the wire storm (CPU, tiny model, fixed seed).
+WIRE_BOUND_S = 120.0
+
+#: Token-bucket burst for the dedicated "hog" tenant — small enough that
+#: the seeded storm provably overruns it (quota exactness is asserted).
+QUOTA_BURST = 4
+
+#: (status, code) every hostile kind must be answered with; None = the
+#: client disconnects without reading (server accounting still asserted).
+WIRE_EXPECT = {
+    "ok": (200, "ok"),
+    "truncated_body": (400, "truncated_body"),
+    "stalled_body": (408, "read_timeout"),
+    "garbage_image": (400, "bad_image"),
+    "bomb_image": (413, "image_too_large"),
+    "header_flood": (431, "too_many_headers"),
+    "oversize_content_length": (413, "body_too_large"),
+    "empty_body": (400, "empty_body"),
+    "bad_multipart": (400, "bad_multipart"),
+    "wrong_route": (404, "unknown_route"),
+    "bad_method": (405, "method_not_allowed"),
+    "disconnect_mid_request": None,
+}
+
+def _wire_exchange(addr, data: bytes, half_close: bool = False,
+                   read_response: bool = True, timeout: float = 30.0):
+    """One hostile client: raw bytes out, (status, code, headers) parsed
+    from whatever comes back before the server closes the connection —
+    or None when the client vanishes without reading."""
+    import socket
+
+    with socket.create_connection(addr, timeout=timeout) as s:
+        s.sendall(data)
+        if not read_response:
+            return None  # disconnect_mid_request: close without reading
+        if half_close:
+            s.shutdown(socket.SHUT_WR)
+        chunks = []
+        try:
+            while True:
+                b = s.recv(65536)
+                if not b:
+                    break
+                chunks.append(b)
+        except (socket.timeout, TimeoutError):
+            pass
+    raw = b"".join(chunks)
+    assert raw.startswith(b"HTTP/1."), f"non-HTTP response: {raw[:80]!r}"
+    head, _, body = raw.partition(b"\r\n\r\n")
+    status = int(head.split(b" ", 2)[1])
+    headers = {}
+    for line in head.split(b"\r\n")[1:]:
+        k, _, v = line.partition(b":")
+        headers[k.decode("latin-1").strip().lower()] = \
+            v.decode("latin-1").strip()
+    code = "ok" if status == 200 else \
+        json.loads(body.partition(b"\r\n\r\n")[0] or b"{}").get("code")
+    return status, code, headers
+
+
+def main_wire() -> int:
+    import signal
+    import socket
+    import threading
+    from collections import Counter
+    from concurrent.futures import ThreadPoolExecutor
+
+    import numpy as np
+
+    import jax
+
+    from raft_stereo_tpu.config import RAFTStereoConfig, with_eval_precision
+    from raft_stereo_tpu.faults import WireChaosPlan, bomb_png
+    from raft_stereo_tpu.models import init_raft_stereo
+    from raft_stereo_tpu.serve import (HttpConfig, HttpFrontend,
+                                       InferenceSession, ServiceConfig,
+                                       SessionConfig, StereoService)
+    from raft_stereo_tpu.serve import wire
+
+    n = int(os.environ.get("RAFT_CHAOS_N", "96"))
+    seed = int(os.environ.get("RAFT_CHAOS_SEED", "1234"))
+    plan = WireChaosPlan.seeded(seed, n, hostile_frac=0.5)
+    kind_of = {i: plan.faults.get(i, "ok") for i in range(n)}
+
+    cfg = with_eval_precision(RAFTStereoConfig(
+        n_gru_layers=1, hidden_dims=(32, 32, 32),
+        corr_levels=2, corr_radius=2))
+    params = init_raft_stereo(jax.random.PRNGKey(0), cfg)
+    session = InferenceSession(
+        params, cfg,
+        SessionConfig(valid_iters=4, segments=2, max_batch=4,
+                      batch_buckets=(1, 4), canary=False,
+                      warmup_shapes=((H, W),), warmup_segmented=True))
+    svc = StereoService(session, ServiceConfig(max_queue=16)).start()
+    fe = HttpFrontend(svc, HttpConfig(
+        port=0, read_timeout_ms=300.0,
+        tenant_rate=f"0.000001:{QUOTA_BURST}",
+        decode_workers=2)).start()
+    addr = (fe.host, fe.port)
+    reg = fe.registry
+
+    # Client-side request material: two encoded stereo pairs, reused.
+    rng = np.random.default_rng(seed)
+    png_pairs = []
+    for _ in range(2):
+        left = rng.uniform(0, 255, (H, W, 3)).astype(np.uint8)
+        right = rng.uniform(0, 255, (H, W, 3)).astype(np.uint8)
+        png_pairs.append((wire.encode_image_png(left),
+                          wire.encode_image_png(right)))
+
+    # Tenant assignment: every 3rd well-formed request shares the "hog"
+    # bucket (burst QUOTA_BURST, negligible refill -> quota rejections
+    # are EXACTLY max(0, n_hog - burst), order-free); every other request
+    # gets a unique tenant and can never be quota-limited, so the
+    # per-kind expectations above stay deterministic.
+    def tenant_for(i: int) -> str:
+        return "hog" if kind_of[i] == "ok" and i % 3 == 0 else f"c{i}"
+
+    n_hog = sum(1 for i in range(n)
+                if kind_of[i] == "ok" and i % 3 == 0)
+    expected_429 = max(0, n_hog - QUOTA_BURST)
+
+    def head_bytes(path: str, ct: str, length: int, tenant: str,
+                   method: str = "POST", extra=()) -> bytes:
+        lines = [f"{method} {path} HTTP/1.1", "Host: storm",
+                 f"Content-Type: {ct}", f"Content-Length: {length}",
+                 f"X-Raft-Tenant: {tenant}", "Connection: close"]
+        lines += [f"{k}: {v}" for k, v in extra]
+        return ("\r\n".join(lines) + "\r\n\r\n").encode("latin-1")
+
+    def play(i: int):
+        kind = kind_of[i]
+        tenant = tenant_for(i)
+        lpng, rpng = png_pairs[i % len(png_pairs)]
+        ct, body = wire.build_multipart(
+            {"left": lpng, "right": rpng, "id": f"w-{i}".encode()})
+        if kind in ("ok", "disconnect_mid_request"):
+            data = head_bytes("/v1/stereo", ct, len(body), tenant) + body
+            return _wire_exchange(
+                addr, data, read_response=(kind == "ok"))
+        if kind == "truncated_body":
+            cut = body[:int(len(body) * plan.truncate_frac)]
+            data = head_bytes("/v1/stereo", ct, len(body), tenant) + cut
+            return _wire_exchange(addr, data, half_close=True)
+        if kind == "stalled_body":
+            cut = body[:int(len(body) * plan.stall_frac)]
+            data = head_bytes("/v1/stereo", ct, len(body), tenant) + cut
+            # no half-close: the socket simply goes silent; the server's
+            # per-read timeout must evict us with a structured 408
+            # BEFORE the client gives up at stall_hold_s (the plan field
+            # that keeps this fault non-vacuous: hold > per-read timeout)
+            assert plan.stall_hold_s > fe.read_timeout_s, (
+                "stalled_body is vacuous: the client hangs up before "
+                "the server's per-read timeout can fire")
+            return _wire_exchange(addr, data, timeout=plan.stall_hold_s)
+        if kind == "garbage_image":
+            _, gbody = wire.build_multipart(
+                {"left": b"\x89PNG garbage", "right": b"more garbage",
+                 "id": f"w-{i}".encode()})
+            return _wire_exchange(
+                addr, head_bytes("/v1/stereo", ct, len(gbody), tenant)
+                + gbody)
+        if kind == "bomb_image":
+            bomb = bomb_png(20_000, 20_000)
+            _, bbody = wire.build_multipart(
+                {"left": bomb, "right": bomb, "id": f"w-{i}".encode()})
+            return _wire_exchange(
+                addr, head_bytes("/v1/stereo", ct, len(bbody), tenant)
+                + bbody)
+        if kind == "header_flood":
+            extra = [(f"X-Flood-{j}", "y")
+                     for j in range(plan.flood_headers)]
+            return _wire_exchange(
+                addr, head_bytes("/v1/stereo", ct, 0, tenant,
+                                 extra=extra))
+        if kind == "oversize_content_length":
+            return _wire_exchange(
+                addr, head_bytes("/v1/stereo", ct, fe.body_max + 1,
+                                 tenant))
+        if kind == "empty_body":
+            return _wire_exchange(
+                addr, head_bytes("/v1/stereo", ct, 0, tenant))
+        if kind == "bad_multipart":
+            cut = body[:-8]  # consistent length, framing cut short
+            return _wire_exchange(
+                addr, head_bytes("/v1/stereo", ct, len(cut), tenant)
+                + cut)
+        if kind == "wrong_route":
+            return _wire_exchange(
+                addr, head_bytes("/v1/nope", ct, len(body), tenant)
+                + body)
+        if kind == "bad_method":
+            return _wire_exchange(
+                addr, head_bytes("/v1/stereo", ct, len(body), tenant,
+                                 method="DELETE") + body)
+        raise AssertionError(f"unknown fault kind {kind!r}")
+
+    t0 = time.monotonic()
+    deadline = t0 + WIRE_BOUND_S
+    observed = {}
+    with ThreadPoolExecutor(max_workers=8,
+                            thread_name_prefix="storm-client") as pool:
+        futs = {i: pool.submit(play, i) for i in range(n)}
+        for i, f in futs.items():
+            observed[i] = f.result(timeout=max(1.0,
+                                               deadline - time.monotonic()))
+
+    def responses_total() -> int:
+        return sum(int(v) for _, v in
+                   reg.series("raft_http_responses_total"))
+
+    # Every request — read or abandoned — must produce exactly ONE
+    # accounting entry (abandoned ones finish asynchronously; bounded
+    # wait, not a sleep).
+    while responses_total() < n:
+        assert time.monotonic() < deadline, (
+            f"only {responses_total()}/{n} requests ever produced a "
+            f"response accounting entry — a socket or Future is stranded")
+        time.sleep(0.05)
+    elapsed_storm = time.monotonic() - t0
+
+    # -- invariant 1: every read response matches its kind exactly -------
+    n_429 = 0
+    for i, out in observed.items():
+        kind = kind_of[i]
+        expect = WIRE_EXPECT[kind]
+        if expect is None:
+            assert out is None
+            continue
+        status, code, headers = out
+        if kind == "ok" and tenant_for(i) == "hog" and status == 429:
+            n_429 += 1
+            assert code == "quota_exceeded" and "retry-after" in headers, \
+                (i, status, code, headers)
+            continue
+        assert (status, code) == expect, (i, kind, status, code)
+        if code in ("queue_full", "service_draining", "quota_exceeded",
+                    "read_timeout"):
+            assert "retry-after" in headers or code == "read_timeout"
+
+    # -- invariant 2: per-tenant quota rejections are EXACT --------------
+    assert n_429 == expected_429, (
+        f"hog tenant saw {n_429} quota rejections, bucket math says "
+        f"exactly {expected_429} (n_hog={n_hog}, burst={QUOTA_BURST})")
+    tenant_counts = {(labels["tenant"], labels["outcome"]): int(v)
+                     for labels, v in
+                     reg.series("raft_http_tenant_requests_total")}
+    assert tenant_counts.get(("hog", "quota_exceeded"), 0) == expected_429
+    assert tenant_counts.get(("hog", "admitted"), 0) == \
+        n_hog - expected_429
+
+    # -- invariant 3: counters reconcile exactly with wire outcomes ------
+    server = Counter()
+    for labels, v in reg.series("raft_http_responses_total"):
+        server[labels["code"]] += int(v)
+    client = Counter(code for out in observed.values() if out
+                     for code in [out[1]])
+    unread = sum(1 for out in observed.values() if out is None)
+    assert sum(server.values()) == n, (server, n)
+    for code in set(server) | set(client):
+        if code in ("ok", "client_disconnect"):
+            continue
+        assert server[code] == client[code], (code, server, client)
+    # An abandoned request lands as 'ok' (write beat the close into the
+    # dead socket's buffer) or 'client_disconnect' — exactly one of them.
+    assert server["ok"] + server["client_disconnect"] == \
+        client["ok"] + unread, (server, client, unread)
+
+    # -- invariant 4: zero acceptor/decoder deaths, zero stranded work ---
+    crashes = sum(int(v) for _, v in
+                  reg.series("raft_http_handler_crashes_total"))
+    assert crashes == 0, f"{crashes} handler crash(es) during the storm"
+    assert svc._outstanding == 0, (
+        f"{svc._outstanding} Future(s) still outstanding after the storm")
+    join_deadline = time.monotonic() + 10
+    while any("process_request_thread" in t.name
+              for t in threading.enumerate()):
+        assert time.monotonic() < join_deadline, (
+            "connection-handler threads still alive after the storm — "
+            "a socket is stranded: "
+            + str([t.name for t in threading.enumerate()]))
+        time.sleep(0.05)
+
+    # -- invariant 5: mid-storm SIGTERM drains clean ---------------------
+    stop_evt = threading.Event()
+    prev = signal.signal(signal.SIGTERM, lambda *_: stop_evt.set())
     try:
-        raise SystemExit(main())
+        # an admitted row must run to its segment-boundary exit through
+        # the drain — pin one in flight before the signal lands
+        lpng, rpng = png_pairs[0]
+        inflight = svc.submit({
+            "id": "drain-pinned",
+            "left": np.asarray(
+                np.random.default_rng(0).uniform(0, 255, (H, W, 3)),
+                np.float32)[None],
+            "right": np.asarray(
+                np.random.default_rng(1).uniform(0, 255, (H, W, 3)),
+                np.float32)[None]})
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert stop_evt.wait(5), "SIGTERM handler never fired"
+        svc.begin_drain()
+        late_codes = []
+        for j in range(4):
+            ct, body = wire.build_multipart(
+                {"left": lpng, "right": rpng, "id": f"late-{j}".encode()})
+            out = _wire_exchange(
+                addr, head_bytes("/v1/stereo", ct, len(body), f"late{j}")
+                + body)
+            status, code, headers = out
+            assert (status, code) == (503, "service_draining"), out
+            assert "retry-after" in headers
+            late_codes.append(code)
+        pinned = inflight.result(timeout=60)
+        assert pinned["status"] == "ok", pinned
+        assert svc.drain() is True, "drain failed to quiesce"
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+    fe.stop()
+    try:
+        socket.create_connection(addr, timeout=2).close()
+        raise AssertionError("listener still accepting after stop()")
+    except ConnectionRefusedError:
+        pass  # drained AND stopped accepting: the contract's final step
+
+    elapsed = time.monotonic() - t0
+    kind_counts = Counter(kind_of.values())
+    doc = {
+        "metric": "wire_chaos",
+        "pass": True,
+        "n": n,
+        "seed": seed,
+        "kinds": dict(sorted(kind_counts.items())),
+        "server_codes": dict(sorted(server.items())),
+        "quota": {"hog_requests": n_hog, "burst": QUOTA_BURST,
+                  "rejected": n_429},
+        "late_draining_503": len(late_codes),
+        "handler_crashes": crashes,
+        "elapsed_storm_s": round(elapsed_storm, 2),
+        "elapsed_real_s": round(elapsed, 2),
+        "backend": jax.default_backend(),
+    }
+    print(json.dumps(doc))
+
+    from raft_stereo_tpu.obs.trajectory import emit
+    emit("wire_chaos_structured_frac", 1.0, "frac",
+         backend=jax.default_backend(), source="scratch/chaos_serve.py",
+         extra={"n": n, "quota_rejected": n_429,
+                "elapsed_real_s": doc["elapsed_real_s"]})
+    return 0
+
+
+if __name__ == "__main__":
+    _wire = "--wire" in sys.argv[1:] or \
+        os.environ.get("RAFT_CHAOS_WIRE", "").strip().lower() in (
+            "1", "true", "yes", "on")
+    try:
+        raise SystemExit(main_wire() if _wire else main())
     except AssertionError as e:
-        print(json.dumps({"metric": "chaos_soak", "pass": False,
-                          "error": str(e)}))
+        print(json.dumps({"metric": "wire_chaos" if _wire else "chaos_soak",
+                          "pass": False, "error": str(e)}))
         raise SystemExit(1)

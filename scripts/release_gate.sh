@@ -81,6 +81,33 @@ else
     echo "FAIL: chaos soak"; fail=1
 fi
 
+# Wire chaos storm (ISSUE 10 acceptance, DESIGN.md r14): the same seeded
+# determinism, but the faults are HOSTILE CLIENTS over real loopback
+# sockets against the unmodified graftwire ingress — truncated/stalled
+# bodies, decompression bombs, header floods, mid-request disconnects.
+# Asserts: every request gets exactly ONE structured HTTP response, zero
+# acceptor-thread deaths, zero stranded sockets/Futures, per-tenant quota
+# rejections exact, counters reconciling with wire outcomes, and a
+# mid-storm SIGTERM draining clean (late requests 503 service_draining,
+# the pinned admitted row finishes, exit 0). CPU always, fixed seed.
+step "wire chaos storm (hostile clients over loopback vs ingress invariants)"
+if env JAX_PLATFORMS=cpu python scratch/chaos_serve.py --wire > wire_chaos.json; then
+    cat wire_chaos.json
+else
+    echo "--- wire_chaos.json ---"; cat wire_chaos.json
+    echo "FAIL: wire chaos storm"; fail=1
+fi
+
+# graftwire ingress battery (ISSUE 10 satellite): codec units, the
+# malformed-request battery over real sockets, loopback parity, quota
+# exactness, the decompression-bomb regression. Tier-1 runs it too; the
+# gate repeats it so an ingress regression names itself here even when
+# someone runs the gate alone.
+step "graftwire ingress battery (hostile-input + loopback parity)"
+env JAX_PLATFORMS=cpu python -m pytest tests/test_http.py -q -m http \
+    -p no:cacheprovider -p no:xdist -p no:randomly \
+    || { echo "FAIL: graftwire ingress battery"; fail=1; }
+
 # Observability battery (ISSUE 7 + 8 acceptance): FakeClock span
 # timelines that reconcile with reported latency, the /metrics golden,
 # the trajectory-gate failure mode, the flat-memory reservoir pin, the
@@ -103,13 +130,18 @@ echo "backend: $backend"
 # this is a wiring smoke (tiny model; CPU conv throughput is ~linear in
 # batch, so no speedup is expected); the >=2x-at-batch>=4 bar applies to
 # the on-chip run.
-step "serve throughput bench (continuous batching vs sequential)"
+# RAFT_SERVE_BENCH_LOOPBACK=1 adds the graftwire loopback-network mode
+# (ROADMAP item 4): the same workload over real sockets, requests/s vs
+# in-process in the same JSON line — the CPU run doubles as the wire
+# smoke, the TPU run pins the wire overhead on real hardware.
+step "serve throughput bench (continuous batching vs sequential + loopback)"
 if [ "$backend" != "tpu" ]; then
     env JAX_PLATFORMS=cpu RAFT_SERVE_BENCH_TINY=1 \
+        RAFT_SERVE_BENCH_LOOPBACK=1 \
         python scratch/bench_serve.py \
         || { echo "FAIL: serve bench smoke"; fail=1; }
 else
-    python scratch/bench_serve.py \
+    env RAFT_SERVE_BENCH_LOOPBACK=1 python scratch/bench_serve.py \
         || { echo "FAIL: serve throughput bench"; fail=1; }
 fi
 

@@ -23,6 +23,11 @@ Examples::
     # joining/leaving at segment boundaries (throughput mode)
     python serve_stereo.py --restore_ckpt ... -l ... -r ... \
         --max_batch 8 --workers 8
+
+    # network ingress (graftwire, DESIGN.md r14): POST /v1/stereo over
+    # HTTP/1.1, real /healthz + /metrics endpoints, SIGTERM drains clean
+    python serve_stereo.py --restore_ckpt ... --http_port 8080 \
+        --max_batch 8 --warmup 544x960
 """
 
 from __future__ import annotations
@@ -43,10 +48,11 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument('--restore_ckpt', default=None,
                         help="checkpoint (.pth reference weights or native "
                         ".msgpack); omitted = random init (smoke runs)")
-    parser.add_argument('-l', '--left_imgs', required=True,
-                        help="glob for left frames")
-    parser.add_argument('-r', '--right_imgs', required=True,
-                        help="glob for right frames")
+    parser.add_argument('-l', '--left_imgs', default=None,
+                        help="glob for left frames (batch mode; not "
+                        "needed with --http_port)")
+    parser.add_argument('-r', '--right_imgs', default=None,
+                        help="glob for right frames (batch mode)")
     parser.add_argument('--output_directory', default=None,
                         help="save disparity .npy files here (optional)")
     parser.add_argument('--valid_iters', type=int, default=32,
@@ -122,6 +128,27 @@ def build_parser() -> argparse.ArgumentParser:
                         "segment-boundary exits within this window, "
                         "then the rest resolve service_stopped (default "
                         "RAFT_DRAIN_GRACE_MS or 10s)")
+    # graftwire: network ingress (DESIGN.md r14)
+    parser.add_argument('--http_port', type=int, default=None,
+                        help="serve POST /v1/stereo + GET /healthz "
+                        "+ GET /metrics over HTTP/1.1 on this port "
+                        "instead of running the glob batch driver "
+                        "(0 = ephemeral; omit the flag entirely for "
+                        "batch mode — RAFT_HTTP_PORT applies to "
+                        "embedded HttpConfig use, not this flag)")
+    parser.add_argument('--http_host', default="127.0.0.1",
+                        help="ingress bind address (default loopback; "
+                        "widen to 0.0.0.0 deliberately)")
+    parser.add_argument('--tenant_rate', default=None,
+                        help="per-tenant admission quota 'rate[:burst]' "
+                        "requests/s keyed by X-Raft-Tenant (default "
+                        "RAFT_TENANT_RATE or unlimited)")
+    parser.add_argument('--decode_workers', type=int, default=2,
+                        help="decode-offload pool width: HTTP mode "
+                        "decodes request images here instead of on "
+                        "acceptor threads; batch mode prefetches file "
+                        "decode ahead of admission (decode is ~33 "
+                        "ms/sample and caps the host path)")
     add_model_args(parser)
     return parser
 
@@ -136,7 +163,62 @@ def _parse_warmup(spec):
     return tuple(shapes)
 
 
+def iter_decoded_pairs(pairs, decode_one, workers: int = 2,
+                       lookahead=None):
+    """Decode offload for the closed-loop batch driver: yield
+    ``(left_path, right_path, future)`` in submission order with file
+    decode running in a small thread pool up to ``lookahead`` pairs
+    ahead of admission.
+
+    Before this, the submit loop paid ~33 ms/sample of PNG decode
+    (BASELINE.md) INLINE between submissions — serializing host decode
+    ahead of admission exactly like the pre-PR 5 upload path serialized
+    transfers. Ordering is preserved (a deque of futures, consumed
+    FIFO), so outputs are byte-identical to the sequential decode path
+    (test-pinned in tests/test_http.py); the bounded lookahead keeps
+    peak memory at ``lookahead`` decoded pairs regardless of glob size.
+    A consumer that stops consuming (drain) just cancels what it skips —
+    the pool dies with the generator."""
+    from collections import deque
+    from concurrent.futures import ThreadPoolExecutor
+
+    lookahead = max(1, lookahead if lookahead is not None
+                    else 2 * max(1, workers))
+    pool = ThreadPoolExecutor(max_workers=max(1, workers),
+                              thread_name_prefix="stereo-cli-decode")
+    queue = deque()
+    it = iter(pairs)
+
+    def pump() -> None:
+        while len(queue) < lookahead:
+            try:
+                f1, f2 = next(it)
+            except StopIteration:
+                return
+            queue.append((f1, f2, pool.submit(
+                lambda a=f1, b=f2: (decode_one(a), decode_one(b)))))
+
+    try:
+        pump()
+        while queue:
+            f1, f2, fut = queue.popleft()
+            yield f1, f2, fut
+            pump()
+    finally:
+        pool.shutdown(wait=False, cancel_futures=True)
+
+
 def serve(args) -> int:
+    # Mode validation needs only args — run it before any model load or
+    # warmup compile so a missing-glob invocation fails in milliseconds,
+    # not after minutes of checkpoint read + jit (argparse can't express
+    # "required unless --http_port", so it lives here).
+    if args.http_port is None and (not args.left_imgs
+                                   or not args.right_imgs):
+        raise SystemExit("batch mode needs -l/--left_imgs and "
+                         "-r/--right_imgs (or serve the network with "
+                         "--http_port)")
+
     import jax
     import numpy as np
 
@@ -204,6 +286,55 @@ def serve(args) -> int:
         except ValueError:  # non-main thread (embedded use): skip
             pass
 
+    from raft_stereo_tpu.serve.supervise import resolve_drain_grace_ms
+    grace_s = resolve_drain_grace_ms(args.drain_grace_ms) / 1e3
+
+    def write_artifacts() -> None:
+        status = service.status()
+        print(json.dumps(status, indent=2, default=str))
+        if args.status_json:
+            Path(args.status_json).write_text(
+                json.dumps(status, indent=2, default=str))
+        if args.metrics_prom:
+            Path(args.metrics_prom).write_text(service.metrics_text())
+        if args.ledger_out:
+            from raft_stereo_tpu.obs.ledger import save_doc
+            save_doc(session.ledger_doc(), args.ledger_out)
+
+    # -- network ingress mode (graftwire, DESIGN.md r14) -------------------
+    if args.http_port is not None:
+        from raft_stereo_tpu.serve import HttpConfig, HttpFrontend
+        service.start()
+        frontend = HttpFrontend(service, HttpConfig(
+            host=args.http_host, port=args.http_port,
+            tenant_rate=args.tenant_rate,
+            decode_workers=args.decode_workers)).start()
+        print(json.dumps({
+            "event": "listening",
+            "endpoint": f"http://{frontend.host}:{frontend.port}",
+            "routes": ["POST /v1/stereo", "GET /healthz", "GET /metrics"],
+        }), flush=True)
+        try:
+            while not stop_requested.wait(0.2):
+                pass
+            # SIGTERM rides the PR 9 drain: the very same state machine
+            # in-process callers get — late wire requests are answered
+            # 503 service_draining by the still-listening frontend,
+            # admitted rows run to their segment-boundary exits within
+            # the grace window, THEN the listener stops accepting.
+            print(json.dumps({"event": "draining",
+                              "reason": "signal received"}), flush=True)
+            clean = service.drain(grace_s)
+            print(json.dumps({"event": "drained", "clean": clean}),
+                  flush=True)
+        finally:
+            frontend.stop()
+            for sig, handler in prev_handlers.items():
+                signal.signal(sig, handler)
+        write_artifacts()
+        return 0
+
+    # -- glob batch-driver mode (globs validated before model load) --------
     left_images = sorted(glob.glob(args.left_imgs, recursive=True))
     right_images = sorted(glob.glob(args.right_imgs, recursive=True))
     if len(left_images) != len(right_images):
@@ -219,9 +350,6 @@ def serve(args) -> int:
     import time
     from concurrent.futures import TimeoutError as FuturesTimeout
 
-    from raft_stereo_tpu.serve.supervise import resolve_drain_grace_ms
-
-    grace_s = resolve_drain_grace_ms(args.drain_grace_ms) / 1e3
     failures = 0
     seq = 0
     draining = False
@@ -304,29 +432,60 @@ def serve(args) -> int:
         # one for a closed-loop batch job).
         from collections import deque
         pending = deque()
-        for f1, f2 in zip(left_images, right_images):
+
+        def decode_one(path):
+            return read_image_rgb(path).astype(np.float32)[None]
+
+        # Decode rides a small thread pool AHEAD of admission
+        # (iter_decoded_pairs): the submit loop no longer serializes
+        # ~33 ms/sample of PNG decode between submissions, and ordering
+        # — hence output bytes — is unchanged (FIFO future consumption,
+        # pinned in tests/test_http.py).
+        pairs = list(zip(left_images, right_images))
+        decode_stream = iter_decoded_pairs(
+            pairs, decode_one, workers=args.decode_workers)
+        drained_from = None
+        for i, (f1, f2, decoded) in enumerate(decode_stream):
             if stop_requested.is_set():
-                # Submit through the drain WITHOUT paying the decode:
-                # the flip below precedes the submit, so the rejection
-                # is guaranteed — the printed service_draining line
-                # still names each file that was NOT served (the
-                # wire-level proof), at stub cost instead of a full
-                # image read per doomed request.
+                # Stop the decode pump FIRST (closing the generator
+                # cancels every queued decode — the pump refills the
+                # pool per yield, so cancelling just this future would
+                # keep burning ~33 ms/sample on doomed files), then
+                # stub-submit the remainder through the drain below.
                 begin_drain_once()
-                stub = np.zeros((1, 32, 32, 3), dtype=np.float32)
-                pending.append(service.submit(
-                    {"id": f1, "left": stub, "right": stub}))
-                continue
+                decode_stream.close()
+                drained_from = i
+                break
             while len(pending) >= inflight_cap:
                 consume(pending.popleft())
-            request = {
-                "id": f1,
-                "left": read_image_rgb(f1).astype(np.float32)[None],
-                "right": read_image_rgb(f2).astype(np.float32)[None],
-            }
+            try:
+                left, right = decoded.result()
+            except Exception as e:  # noqa: BLE001 — hostile-file boundary
+                # One unreadable/oversized file (e.g. ImageTooLarge from
+                # the decode-bomb cap) is one structured failure line,
+                # never an aborted run with the rest of the glob
+                # unserved.
+                failures += 1
+                code = getattr(e, "code", "decode_failed")
+                print(json.dumps({
+                    "id": f1, "status": "rejected", "code": code,
+                    "message": f"{type(e).__name__}: {e}"}))
+                continue
+            request = {"id": f1, "left": left, "right": right}
             if args.deadline_ms is not None:
                 request["deadline_ms"] = args.deadline_ms
             pending.append(service.submit(request))
+        if drained_from is not None:
+            # Submit through the drain WITHOUT waiting for decode: the
+            # drain flip above precedes the submits, so rejection is
+            # guaranteed — the printed service_draining line still names
+            # each file that was NOT served (the wire-level proof), at
+            # stub cost instead of a full image decode per doomed
+            # request.
+            stub = np.zeros((1, 32, 32, 3), dtype=np.float32)
+            for f1, _f2 in pairs[drained_from:]:
+                pending.append(service.submit(
+                    {"id": f1, "left": stub, "right": stub}))
         while pending:
             consume(pending.popleft())
     finally:
@@ -342,16 +501,7 @@ def serve(args) -> int:
         else:
             service.stop()
 
-    status = service.status()
-    print(json.dumps(status, indent=2, default=str))
-    if args.status_json:
-        Path(args.status_json).write_text(
-            json.dumps(status, indent=2, default=str))
-    if args.metrics_prom:
-        Path(args.metrics_prom).write_text(service.metrics_text())
-    if args.ledger_out:
-        from raft_stereo_tpu.obs.ledger import save_doc
-        save_doc(session.ledger_doc(), args.ledger_out)
+    write_artifacts()
     if failures:
         # Real failures flip the exit code even when a drain signal
         # arrived — an orchestrator must not read a preempted run with

@@ -288,7 +288,9 @@ def test_gl002_host_module_any_registry_ok(tmp_path):
 
 
 def test_gl002_outside_scanned_dirs_ignored(tmp_path):
-    rep = lint(tmp_path, {"data/k.py": """
+    # engine/ stays outside the scan (data/ joined it in r14 — the
+    # decode-bomb cap made env reads there policy, not plumbing).
+    rep = lint(tmp_path, {"engine/k.py": """
         import os
 
         def f():
@@ -303,9 +305,9 @@ def test_gl002_real_tree_native_knob_registered():
     # the widened scan provably sees native/.
     files = collect_files([str(PACKAGE)], base=str(REPO))
     rep = run_checkers(Project(files, serve_knobs=knobs.SERVE_ENV_KNOBS))
-    hits = [f for f in rep.findings if f.code == "GL002"]
-    assert hits and "RAFT_NATIVE" in hits[0].message
-    assert hits[0].path.endswith("native/__init__.py")
+    hits = [f for f in rep.findings if f.code == "GL002"
+            and "RAFT_NATIVE" in f.message]
+    assert hits and hits[0].path.endswith("native/__init__.py")
 
 
 def test_gl002_real_tree_obs_knob_registered():
@@ -349,6 +351,35 @@ def test_gl002_real_tree_watchdog_knob_registered():
     hits = [f for f in rep.findings if f.code == "GL002"]
     assert hits and "RAFT_WATCHDOG_MS" in hits[0].message
     assert hits[0].path.endswith("serve/supervise.py")
+
+
+def test_gl002_real_tree_http_knob_registered():
+    # RAFT_HTTP_BODY_MAX (serve/http.py resolve_body_max) is covered by
+    # SERVE_ENV_KNOBS; drop it and GL002 must fire at the read site — the
+    # r14 ingress knobs cannot silently drift out of the registry (the
+    # drop leaves RAFT_HTTP_PORT / RAFT_HTTP_READ_TIMEOUT_MS /
+    # RAFT_TENANT_RATE covered so the hit is unambiguous).
+    files = collect_files([str(PACKAGE)], base=str(REPO))
+    reduced = tuple(k for k in knobs.SERVE_ENV_KNOBS + knobs.HOST_ENV_KNOBS
+                    if k != "RAFT_HTTP_BODY_MAX")
+    rep = run_checkers(Project(files, serve_knobs=reduced))
+    hits = [f for f in rep.findings if f.code == "GL002"]
+    assert hits and "RAFT_HTTP_BODY_MAX" in hits[0].message
+    assert hits[0].path.endswith("serve/http.py")
+
+
+def test_gl002_real_tree_decode_cap_knob_registered():
+    # RAFT_DECODE_MAX_PIXELS (data/frame_utils.py, the decompression-bomb
+    # cap) is covered by HOST_ENV_KNOBS; drop it and GL002 must fire at
+    # the read site — the r14-widened scan provably sees data/ (before
+    # the widening, an env read there was invisible to lint).
+    files = collect_files([str(PACKAGE)], base=str(REPO))
+    reduced = tuple(k for k in knobs.SERVE_ENV_KNOBS + knobs.HOST_ENV_KNOBS
+                    if k != "RAFT_DECODE_MAX_PIXELS")
+    rep = run_checkers(Project(files, serve_knobs=reduced))
+    hits = [f for f in rep.findings if f.code == "GL002"]
+    assert hits and "RAFT_DECODE_MAX_PIXELS" in hits[0].message
+    assert hits[0].path.endswith("data/frame_utils.py")
 
 
 def test_gl002_real_tree_dropped_knob_fails():
