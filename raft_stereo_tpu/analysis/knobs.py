@@ -128,6 +128,14 @@ HOST_ENV_KNOBS: Tuple[str, ...] = (
                             # policy only — admitted arrays are already
                             # bounded by AdmissionConfig.max_pixels, so
                             # no compiled program's shape depends on it
+    # graftdeck operator-plane knobs (DESIGN.md r15) — telemetry sizing/
+    # windowing only, read once at session construction, exactly like
+    # RAFT_TRACE's ring: no compiled program's bytes depend on either.
+    "RAFT_DECK_TICKS",      # tick flight-deck ring depth (obs/deck.py
+                            # resolve_deck_ticks, default 1024)
+    "RAFT_CAPACITY_WINDOW_MS",  # saturation sliding window for the
+                            # capacity model (obs/capacity.py
+                            # resolve_capacity_window_s, default 60 s)
 )
 
 

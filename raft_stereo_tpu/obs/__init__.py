@@ -19,16 +19,26 @@ Four pieces, one contract (DESIGN.md "Observability (r11)"):
   ``obs.ledger report`` CLI (DESIGN.md "Device observability (r12)");
 - :mod:`~raft_stereo_tpu.obs.flight` — the SLO flight recorder: bounded
   per-breach artifacts (timeline + ledger rows + registry snapshot)
-  persisted to ``RAFT_FLIGHT_DIR``.
+  persisted to ``RAFT_FLIGHT_DIR``;
+- :mod:`~raft_stereo_tpu.obs.deck` — graftdeck: the tick flight-deck
+  (bounded per-tick scheduler records, ``RAFT_DECK_TICKS``), the
+  ``obs.deck report`` CLI and the all-thread stack dump behind
+  ``GET /debug/stacks`` (DESIGN.md "Operator plane (r15)");
+- :mod:`~raft_stereo_tpu.obs.usage` — per-tenant usage accounting
+  (requests/outcomes, exactly-partitioned device seconds, ledger flops,
+  wire bytes) under the PR 10 bounded-label discipline;
+- :mod:`~raft_stereo_tpu.obs.capacity` — the capacity & saturation
+  model: per-bucket theoretical requests/s off the warmed EMA cost
+  table, device-busy fraction off the deck, headroom gauges.
 
 Import-light: nothing here imports jax at module scope (the registry and
 trajectory tooling run in the linter's jax-free environment).
 """
 
-# obs.ledger is deliberately NOT imported here (same as obs.trajectory):
-# both are `python -m` entry points, and importing them from the package
-# __init__ would trip runpy's already-in-sys.modules warning on every CLI
-# invocation. Import them by module path.
+# obs.ledger is deliberately NOT imported here (same as obs.trajectory
+# and obs.deck): all three are `python -m` entry points, and importing
+# them from the package __init__ would trip runpy's already-in-sys.modules
+# warning on every CLI invocation. Import them by module path.
 from raft_stereo_tpu.obs.flight import FlightRecorder
 from raft_stereo_tpu.obs.metrics import (Counter, Gauge, Histogram,
                                          MetricsRegistry)

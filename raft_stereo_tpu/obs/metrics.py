@@ -36,8 +36,16 @@ from __future__ import annotations
 import random
 import re
 import threading
+import time
 import zlib
 from typing import Dict, Iterable, List, Optional, Tuple
+
+#: Captured once at first import: the registry's view of "when this
+#: process started" (standard exposition practice —
+#: ``process_start_time_seconds`` lets a scraper detect restarts and
+#: rate-window counters correctly). Close enough to exec time for any
+#: serving process, with no /proc parsing or third-party dependency.
+_PROCESS_START_S = time.time()
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
@@ -270,6 +278,24 @@ class MetricsRegistry:
                   mode: str = "window", **labels) -> Histogram:
         return self._get(Histogram, name, help, labels, size=reservoir,
                          mode=mode)
+
+    def set_build_info(self, **labels) -> None:
+        """Standard exposition identity: ``raft_build_info`` (value
+        always 1 — the information is the LABELS: config fingerprint,
+        python/jax versions, backend) plus
+        ``raft_process_start_time_seconds``, so every scrape identifies
+        exactly what is running and when it came up.  Get-or-create like
+        every other instrument: re-registering the same identity is a
+        no-op, a new identity (fresh session) adds its own series."""
+        self.gauge(
+            "raft_build_info",
+            "identity of the running build/config (value is always 1; "
+            "the labels carry the information)",
+            **{k: str(v) for k, v in labels.items()}).set(1.0)
+        self.gauge(
+            "raft_process_start_time_seconds",
+            "unix time this process started (metrics-module import "
+            "time)").set(_PROCESS_START_S)
 
     # -- queries -----------------------------------------------------------
 

@@ -278,6 +278,14 @@ def _cmd_check(args) -> int:
                       f"{bands_doc['bands'][m]['value']:.4f} "
                       f"(band {args.rel_band:.0%}) — now enforced",
                       file=sys.stderr)
+    if not bands_doc.get("bands"):
+        # The gate passes vacuously with an empty bands file (it has
+        # been empty since the gate was born — no on-chip --autopin run
+        # yet). Say so LOUDLY in the gate output instead of printing a
+        # clean-looking "0 out of band": a gate that checks nothing must
+        # not read like a gate that checked everything.
+        print("trajectory: WARNING: 0 bands pinned — gate is vacuous "
+              "until the first on-chip --autopin")
     res = check(doc, bands_doc)
     for n in res.notes:
         print(f"note: {n}", file=sys.stderr)

@@ -69,7 +69,11 @@ from concurrent.futures import TimeoutError as FuturesTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
 
+from raft_stereo_tpu.obs.deck import thread_stacks
 from raft_stereo_tpu.obs.tracing import NULL_TRACE
+# ONE sanitizer (obs/usage.py) shared by quota keys, usage accounting
+# and metric labels — re-exported here under its historical name.
+from raft_stereo_tpu.obs.usage import sanitize_tenant  # noqa: F401
 from raft_stereo_tpu.serve import wire
 from raft_stereo_tpu.serve.supervise import _parse_number
 
@@ -174,18 +178,6 @@ def resolve_tenant_rate(value: Optional[str] = None
         raise ValueError(
             f"RAFT_TENANT_RATE burst must be >= 1, got {raw!r}")
     return rate, burst
-
-
-def sanitize_tenant(raw: Optional[str], max_len: int = 64) -> str:
-    """A hostile header value becomes a bounded, label-safe tenant key:
-    [A-Za-z0-9._-] kept, everything else mapped to ``_``, capped at
-    ``max_len``; empty/absent is the ``default`` tenant. Deterministic,
-    so quota accounting and metric labels agree on the key."""
-    if not raw:
-        return "default"
-    out = "".join(c if (c.isalnum() or c in "._-") else "_"
-                  for c in raw[:max_len])
-    return out or "default"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -416,6 +408,26 @@ class _IngressHandler(BaseHTTPRequestHandler):
                     200, fe.service.metrics_text().encode("utf-8"),
                     code_label="metrics",
                     content_type="text/plain; version=0.0.4", head=head)
+            # Operator plane (graftdeck, DESIGN.md r15): read-only,
+            # bounded debug endpoints — same dispatch boundary, same
+            # connection caps, same counted accounting as every other
+            # route (a debug endpoint is still a hostile-client surface).
+            if path == "/debug/ticks":
+                return self._send_json(
+                    200, fe.debug_ticks_doc(self.path),
+                    code_label="debug_ticks", head=head)
+            if path == "/debug/usage":
+                return self._send_json(
+                    200, fe.service.session.usage.doc(),
+                    code_label="debug_usage", head=head)
+            if path == "/debug/stacks":
+                return self._send_json(
+                    200, thread_stacks(),
+                    code_label="debug_stacks", head=head)
+            if path == "/debug/config":
+                return self._send_json(
+                    200, fe.debug_config_doc(),
+                    code_label="debug_config", head=head)
             if head:  # 405/404 bodies would desync strict HEAD framing
                 label = ("method_not_allowed" if path == "/v1/stereo"
                          else "unknown_route")
@@ -430,7 +442,8 @@ class _IngressHandler(BaseHTTPRequestHandler):
         if self.command == "POST":
             if path == "/v1/stereo":
                 return self._do_stereo()
-            if path in ("/healthz", "/metrics"):
+            if path in ("/healthz", "/metrics") or \
+                    path.startswith("/debug/"):
                 return self._reject(405, "method_not_allowed",
                                     f"{path} is GET")
             return self._reject(404, "unknown_route", f"no route {path!r}")
@@ -686,6 +699,10 @@ class _IngressHandler(BaseHTTPRequestHandler):
         fe.registry.counter(
             "raft_http_body_bytes_total",
             "request body bytes read off the wire").inc(len(body))
+        # Per-tenant wire accounting (obs/usage.py): request-body bytes
+        # in; the response bytes land below once encoded.
+        fe.service.session.usage.add_bytes(
+            fe.service.session.usage.label(tenant), n_in=len(body))
         trace.mark("ingress_read", bytes=len(body), tenant=tenant)
 
         try:
@@ -749,8 +766,12 @@ class _IngressHandler(BaseHTTPRequestHandler):
                                 f"decode failed: {type(e).__name__}: {e}")
         fe.decode_hist.observe(time.monotonic() - t0)
 
+        # The sanitized tenant key joins the request here and rides it
+        # through admission into the scheduler rows — per-tenant device
+        # seconds, outcome counters and the /debug/usage rollup all key
+        # on it (obs/usage.py).
         request = {"id": parsed["id"], "left": left, "right": right,
-                   "_trace": trace}
+                   "tenant": tenant, "_trace": trace}
         if parsed["deadline_ms"] is not None:
             request["deadline_ms"] = parsed["deadline_ms"]
         tenant_count("admitted")
@@ -770,8 +791,11 @@ class _IngressHandler(BaseHTTPRequestHandler):
                 f"no service response within {RESPONSE_WAIT_S:.0f}s")
         status = wire.http_status_for(resp)
         retry_after = wire.retry_after_for(resp)
+        payload = wire.encode_response(resp)
+        fe.service.session.usage.add_bytes(
+            fe.service.session.usage.label(tenant), n_out=len(payload))
         self._send_json(
-            status, wire.encode_response(resp),
+            status, payload,
             code_label=("ok" if resp.get("status") == "ok"
                         else str(resp.get("code", "unknown"))),
             headers=({"Retry-After": str(retry_after)}
@@ -937,11 +961,8 @@ class HttpFrontend:
     def __exit__(self, *exc) -> None:
         self.stop()
 
-    def status_doc(self) -> Dict:
-        """The /healthz body: the service's own status document plus the
-        ingress block (the wire-side numbers an operator tunes)."""
-        doc = self.service.status()
-        doc["ingress"] = {
+    def _ingress_block(self) -> Dict:
+        return {
             "endpoint": f"{self.host}:{self.port}",
             "body_max_bytes": self.body_max,
             "read_timeout_ms": self.read_timeout_s * 1e3,
@@ -951,4 +972,40 @@ class HttpFrontend:
             "max_connections": self.cfg.max_connections,
             "quota": self.quotas.status(),
         }
+
+    def status_doc(self) -> Dict:
+        """The /healthz body: the service's own status document plus the
+        ingress block (the wire-side numbers an operator tunes)."""
+        doc = self.service.status()
+        doc["ingress"] = self._ingress_block()
+        return doc
+
+    # -- operator-plane debug endpoints (graftdeck, DESIGN.md r15) ---------
+
+    def debug_ticks_doc(self, raw_path: str = "") -> Dict:
+        """GET /debug/ticks: the tick flight-deck ring (bounded by the
+        ring size; ``?n=<k>`` bounds it further)."""
+        from urllib.parse import parse_qs
+        n = None
+        query = raw_path.partition("?")[2]
+        if query:
+            raw_n = (parse_qs(query, keep_blank_values=False)
+                     .get("n", [None])[0])
+            if raw_n is not None:
+                try:
+                    n = max(1, int(raw_n))
+                except ValueError:
+                    n = None  # a hostile ?n= is ignored, never a 500
+        return self.service.session.deck.doc(n)
+
+    def debug_config_doc(self) -> Dict:
+        """GET /debug/config: the resolved-knob snapshot an operator
+        diffs against what they THINK is deployed — session + service +
+        ingress config, fingerprint, breaker trips, batch-bucket
+        ladder, program-cache contents.  Read-only and bounded."""
+        svc = self.service
+        doc = svc.session.config_doc()
+        doc["schema"] = 1
+        doc["service_cfg"] = dataclasses.asdict(svc.cfg)
+        doc["ingress"] = self._ingress_block()
         return doc

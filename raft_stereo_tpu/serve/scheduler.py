@@ -47,6 +47,7 @@ import numpy as np
 
 from raft_stereo_tpu.obs.ledger import ledger_id
 from raft_stereo_tpu.obs.tracing import NULL_TRACE
+from raft_stereo_tpu.obs.usage import sanitize_tenant
 from raft_stereo_tpu.serve.degrade import SAFETY
 from raft_stereo_tpu.serve.guard import is_kernel_failure
 from raft_stereo_tpu.serve.session import (InferenceFailed, InferenceSession,
@@ -68,9 +69,10 @@ class _Row:
 
     __slots__ = ("request", "padder", "orig_h", "orig_w", "deadline",
                  "iters_done", "t_start", "dev_pair", "upload_error",
-                 "uploaded")
+                 "uploaded", "tenant_label")
 
-    def __init__(self, request, padder, deadline, t_start):
+    def __init__(self, request, padder, deadline, t_start,
+                 tenant_label: str = "default"):
         self.request = request
         self.padder = padder
         self.orig_h = request["left"].shape[1]
@@ -81,6 +83,10 @@ class _Row:
         self.dev_pair = None
         self.upload_error: Optional[Exception] = None
         self.uploaded = threading.Event()
+        # Bounded usage label (obs/usage.py first-come discipline),
+        # resolved once at admission: every device call this row rides
+        # attributes its exact share of device seconds here.
+        self.tenant_label = tenant_label
 
     @property
     def trace(self):
@@ -225,11 +231,17 @@ class BatchScheduler:
 
     def __init__(self, session: InferenceSession, *,
                  resolve: Optional[Callable[[Dict, Dict], None]] = None,
-                 retry: Optional[Callable[[Dict, Dict], bool]] = None):
+                 retry: Optional[Callable[[Dict, Dict], bool]] = None,
+                 generation: int = 0):
         if session.cfg.max_batch < 2:
             raise ValueError("BatchScheduler needs SessionConfig.max_batch "
                              ">= 2; use the sequential worker path at 1")
         self.session = session
+        # Stamped on every tick flight-deck record (obs/deck.py) so a
+        # post-mortem can see which scheduler generation ran a tick —
+        # the service passes its generation counter; tests driving the
+        # scheduler directly default to 0.
+        self.generation = generation
         self.resolve = resolve or self._default_resolve
         # Supervision hooks (serve/supervise.py): ``retry`` is consulted
         # before a failed response is finalized — True means the service
@@ -289,7 +301,9 @@ class BatchScheduler:
         queue and start its host->device upload immediately."""
         padder = self.session.padder_for(request["left"].shape)
         row = _Row(request, padder, request.get("_deadline"),
-                   self.session.clock.now())
+                   self.session.clock.now(),
+                   tenant_label=self.session.usage.label(
+                       sanitize_tenant(request.get("tenant"))))
         key = padder.padded_shape
         bucket = self._buckets.get(key)
         if bucket is None:
@@ -321,12 +335,24 @@ class BatchScheduler:
         bucket = self._next_bucket()
         if bucket is None:
             return False
+        # Tick flight-deck record (obs/deck.py): opened on THIS thread
+        # before any device work, closed in the finally so a failed or
+        # zombie-discarded tick still leaves its row. Queue depth is the
+        # scheduler's own view — joiners waiting across all buckets at
+        # tick start.
+        deck = self.session.deck
+        tick = deck.begin_tick(
+            bucket=f"{bucket.key[0]}x{bucket.key[1]}",
+            generation=self.generation,
+            queue_depth=sum(len(b.pending) for b in self._bucket_list()))
         t0 = time.perf_counter()
         try:
-            self._tick_bucket(bucket)
+            self._tick_bucket(bucket, tick)
         except Exception as e:  # noqa: BLE001 — the crash-proof boundary
             logger.exception("tick failed for bucket %s", bucket.key)
             self._fail_bucket(bucket, e)
+        finally:
+            deck.end_tick(tick)
         self._m_ticks.inc()
         self._tick_hist.observe(time.perf_counter() - t0)
         return True
@@ -342,7 +368,7 @@ class BatchScheduler:
                 return b
         return None
 
-    def _tick_bucket(self, bucket: _Bucket) -> None:
+    def _tick_bucket(self, bucket: _Bucket, tick) -> None:
         from raft_stereo_tpu.models import (stack_refinement_states,
                                             take_refinement_rows)
         session = self.session
@@ -399,18 +425,27 @@ class BatchScheduler:
             lb = jnp.concatenate(lefts + [lefts[0]] * pad, axis=0)
             rb = jnp.concatenate(rights + [rights[0]] * pad, axis=0)
             p0 = clock.now()
-            (state_j,) = self._device_call("prepare", ph, pw, 0, bb, lb, rb,
-                                           traces=[r.trace for r in joiners])
+            # Rider binding (obs/usage.py): the joiners' tenant labels
+            # ride this device call — invoke partitions its steady
+            # device seconds exactly across them, zombie or not (the
+            # binding lives on this thread, and accounting happens at
+            # the same place the program counters increment).
+            with session.usage_riders([r.tenant_label for r in joiners]):
+                (state_j,) = self._device_call(
+                    "prepare", ph, pw, 0, bb, lb, rb,
+                    traces=[r.trace for r in joiners])
             if self.defunct:
                 return  # generation retired mid-prepare: harvest() took
                 #         the joining rows; this result is discarded.
             p1 = clock.now()
             # The program id joins this span to its ledger row (flight
-            # records collect the rows of every program a request rode).
+            # records collect the rows of every program a request rode);
+            # the tick seq links it to the flight-deck record, so a
+            # post-mortem names the exact ticks the request rode.
             prep_id = session.ledger_key_id("prepare", ph, pw, 0, b=bb)
             for r in joiners:  # one device interval, fanned to every rider
                 r.trace.add_span("prepare", p0, p1, batch=len(joiners),
-                                 program=prep_id)
+                                 program=prep_id, tick=tick.seq)
             if pad:
                 state_j = take_refinement_rows(state_j, range(len(joiners)))
             if bucket.carry is None:
@@ -423,6 +458,7 @@ class BatchScheduler:
                 bucket.carry = stack_refinement_states([live, state_j])
             bucket.rows.extend(joiners)
             self._m_joins.inc(len(joiners))
+            tick.joins = len(joiners)
         bucket.joining = []
 
         # Local binding for the rest of the tick: a concurrent generation
@@ -446,18 +482,25 @@ class BatchScheduler:
                 bucket.carry, list(range(n)) + [0] * (bb - n))
         adv_key = session.cache_key("advance", ph, pw, m_iters, b=bb)
         a0 = clock.now()
-        state, _rowsum = self._device_call(
-            "advance", ph, pw, m_iters, bb, bucket.carry,
-            traces=[r.trace for r in rows])
+        with session.usage_riders([r.tenant_label for r in rows]):
+            state, _rowsum = self._device_call(
+                "advance", ph, pw, m_iters, bb, bucket.carry,
+                traces=[r.trace for r in rows])
         if self.defunct:
             return  # retired mid-advance: harvest() owns these rows
         a1 = clock.now()
         bucket.carry = state
         adv_id = ledger_id(adv_key)
+        tick.occupancy = n
+        tick.batch = bb
+        tick.pad_rows = bb - n
+        tick.iters = m_iters
+        tick.program = adv_id
         for row in rows:
             row.iters_done += m_iters
             row.trace.add_span("advance", a0, a1, iters=m_iters,
-                               occupancy=n, batch=bb, program=adv_id)
+                               occupancy=n, batch=bb, program=adv_id,
+                               tick=tick.seq)
         self.registry.counter(
             "raft_sched_occupancy_total",
             "ticks by live-row occupancy", rows=str(n)).inc()
@@ -488,9 +531,10 @@ class BatchScheduler:
         ex_state = take_refinement_rows(
             bucket.carry, exits + [exits[0]] * (eb - len(exits)))
         e0 = clock.now()
-        (flow_up,) = self._device_call(
-            "epilogue", ph, pw, 0, eb, ex_state,
-            traces=[rows[i].trace for i in exits])
+        with session.usage_riders([rows[i].tenant_label for i in exits]):
+            (flow_up,) = self._device_call(
+                "epilogue", ph, pw, 0, eb, ex_state,
+                traces=[rows[i].trace for i in exits])
         if self.defunct:
             return  # retired mid-epilogue: harvest() owns these rows
         e1 = clock.now()
@@ -498,11 +542,12 @@ class BatchScheduler:
         for i in exits:
             rows[i].trace.add_span("epilogue", e0, e1,
                                    batch=len(exits),
-                                   program=epi_id)
+                                   program=epi_id, tick=tick.seq)
         now = clock.now()
         for j, i in enumerate(exits):
             self._finish(rows[i], flow_up[j:j + 1], now)
         self._m_exits.inc(len(exits))
+        tick.exits = len(exits)
         if self.defunct:
             return  # never write stale rows back over a harvested bucket
         survivors = [i for i in range(n) if i not in set(exits)]

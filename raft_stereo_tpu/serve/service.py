@@ -43,6 +43,7 @@ from typing import Dict, Optional
 import logging
 
 from raft_stereo_tpu.obs.tracing import NULL_TRACE
+from raft_stereo_tpu.obs.usage import sanitize_tenant
 from raft_stereo_tpu.serve.session import (DeadlineExceeded, InferenceSession,
                                            SessionError)
 from raft_stereo_tpu.serve.supervise import (Heartbeat, Supervisor,
@@ -224,7 +225,8 @@ class StereoService:
                 # batch state.
                 self._scheduler = BatchScheduler(
                     self.session, resolve=self._resolve_scheduled,
-                    retry=self._retry_scheduled)
+                    retry=self._retry_scheduled,
+                    generation=self._generation)
                 self._heartbeat = Heartbeat("scheduler", self.session.clock)
                 sched, hb = self._scheduler, self._heartbeat
                 # Spawn + publish INSIDE the lock — the same invariant
@@ -371,6 +373,21 @@ class StereoService:
             "raft_requests_total", "request outcomes by disposition",
             outcome=outcome).inc()
 
+    def _tenant_label(self, request: Dict) -> str:
+        """Bounded usage label for one request's tenant (the ingress
+        stamps ``request['tenant']`` with the sanitized header key;
+        in-process callers default to 'default')."""
+        return self.session.usage.label(
+            sanitize_tenant(request.get("tenant")))
+
+    def _count_outcome(self, request: Dict, outcome: str) -> None:
+        """One request outcome into BOTH series: the service-wide
+        ``raft_requests_total`` and the per-tenant usage account
+        (obs/usage.py) — same outcome key, so the two reconcile."""
+        self._count(outcome)
+        self.session.usage.count_request(self._tenant_label(request),
+                                         outcome)
+
     @staticmethod
     def _finish_trace(request: Dict, resp: Dict) -> None:
         trace = request.get("_trace")
@@ -420,8 +437,15 @@ class StereoService:
         # stamp them), and the ledger is bounded by the LRU cache size.
         ids = {s.attrs.get("program") for s in spans
                if s.attrs.get("program")}
+        # Tick-seq range (obs/deck.py): device spans carry the flight-
+        # deck seq of the tick they rode, so the post-mortem names the
+        # exact ticks to pull from GET /debug/ticks.
+        tick_seqs = sorted({s.attrs.get("tick") for s in spans
+                            if s.attrs.get("tick") is not None})
         doc = {
             "schema": 1,
+            "ticks": ({"first": tick_seqs[0], "last": tick_seqs[-1],
+                       "count": len(tick_seqs)} if tick_seqs else None),
             "reasons": reasons,
             "slo_ms": self.cfg.slo_ms,
             "slo_factor": self.cfg.slo_factor,
@@ -482,10 +506,16 @@ class StereoService:
                                "reached a device")
             else:
                 t0 = self.session.clock.now()
-                result = self.session.infer(
-                    request["left"], request["right"], deadline=deadline,
-                    allow_half_res=request.get("allow_half_res"),
-                    prevalidated=True, trace=trace)
+                # Sequential tenant attribution: this worker thread runs
+                # exactly one request's device calls — bind its label so
+                # invoke attributes the whole steady device time to it.
+                with self.session.usage_riders([
+                        self._tenant_label(request)]):
+                    result = self.session.infer(
+                        request["left"], request["right"],
+                        deadline=deadline,
+                        allow_half_res=request.get("allow_half_res"),
+                        prevalidated=True, trace=trace)
                 self._latency.observe(self.session.clock.now() - t0)
                 resp = {
                     "status": "ok",
@@ -529,7 +559,7 @@ class StereoService:
             key = f'{resp["status"]}:{resp["code"]}'
         elif resp.get("quality") != "full":
             self._count("degraded")
-        self._count(key)
+        self._count_outcome(request, key)
         self._finish_trace(request, resp)
         self._maybe_flight(request, resp)
         return resp
@@ -615,7 +645,7 @@ class StereoService:
             # resolved at the hard deadline, not served.
             trace.event("drain", action="force_resolved",
                         code="service_stopped")
-        self._count("rejected:service_stopped")
+        self._count_outcome(request, "rejected:service_stopped")
         self._finish_trace(request, resp)
         if fut is None:
             fut = request.get("_future")
@@ -675,14 +705,14 @@ class StereoService:
             rejection = self._draining_rejection()
             if request.get("id") is not None:
                 rejection["id"] = request["id"]
-            self._count(f'rejected:{rejection["code"]}')
+            self._count_outcome(request, f'rejected:{rejection["code"]}')
             self._finish_trace(request, rejection)
             return rejection
         rejection = self._admit(request)
         if rejection is not None:
             if request.get("id") is not None:
                 rejection["id"] = request["id"]
-            self._count(f'rejected:{rejection["code"]}')
+            self._count_outcome(request, f'rejected:{rejection["code"]}')
             self._finish_trace(request, rejection)
             return rejection
         return self._respond(request)
@@ -725,7 +755,7 @@ class StereoService:
         if rejection is not None:
             if request.get("id") is not None:
                 rejection["id"] = request["id"]
-            self._count(f'rejected:{rejection["code"]}')
+            self._count_outcome(request, f'rejected:{rejection["code"]}')
             self._finish_trace(request, rejection)
             fut.set_result(rejection)
         return fut
@@ -768,7 +798,7 @@ class StereoService:
             self._latency.observe(resp["elapsed_ms"] / 1e3)
             if resp.get("quality") != "full":
                 self._count("degraded")
-        self._count(key)
+        self._count_outcome(request, key)
         # Flight record BEFORE resolving the Future: a caller that wakes
         # on .result() and immediately lists RAFT_FLIGHT_DIR must see the
         # record its breach produced.
@@ -928,7 +958,7 @@ class StereoService:
             from raft_stereo_tpu.serve.scheduler import BatchScheduler
             self._scheduler = BatchScheduler(
                 self.session, resolve=self._resolve_scheduled,
-                retry=self._retry_scheduled)
+                retry=self._retry_scheduled, generation=gen)
             self._heartbeat = Heartbeat("scheduler", self.session.clock)
             sched, hb = self._scheduler, self._heartbeat
             # Spawn + publish the new generation's thread INSIDE the
@@ -1067,6 +1097,11 @@ class StereoService:
             "batching": (self._scheduler.status()
                          if self._scheduler is not None else None),
             "supervision": self.supervision_status(),
+            # The operator-plane capacity block (obs/capacity.py):
+            # per-bucket theoretical requests/s from the warmed EMAs,
+            # live saturation from the tick deck, headroom gauges
+            # published as a side effect.
+            "capacity": self.session.capacity_status(),
             "session": self.session.status(),
         }
 

@@ -46,12 +46,15 @@ from raft_stereo_tpu.analysis.knobs import ENV_KNOBS as _ENV_KNOBS
 from raft_stereo_tpu.config import RAFTStereoConfig
 from raft_stereo_tpu.faults import (RealClock, ServeFaultPlan, ServeFaults,
                                     poison_disparity)
+from raft_stereo_tpu.obs.capacity import resolve_capacity_window_s
+from raft_stereo_tpu.obs.deck import TickDeck
 from raft_stereo_tpu.obs.flight import FlightRecorder
 from raft_stereo_tpu.obs.ledger import (ProgramLedger, analyze_compiled,
                                         hbm_capacity, ledger_id)
 from raft_stereo_tpu.obs.metrics import MetricsRegistry
 from raft_stereo_tpu.obs.profiler import ProfilerWindow
 from raft_stereo_tpu.obs.tracing import NULL_TRACE, Tracer
+from raft_stereo_tpu.obs.usage import DEFAULT_TENANT, UsageAccountant
 from raft_stereo_tpu.ops.padder import InputPadder
 from raft_stereo_tpu.serve.guard import (KernelCircuitBreaker, CANARY_ATOL,
                                          CANARY_RTOL, is_kernel_failure)
@@ -382,6 +385,20 @@ class InferenceSession:
         # requests' timelines (RAFT_FLIGHT_DIR, read once, here).
         self.ledger = ledger if ledger is not None else ProgramLedger()
         self.flight = flight if flight is not None else FlightRecorder()
+        # graftdeck (obs/deck.py, obs/usage.py, obs/capacity.py): the
+        # tick flight-deck ring (RAFT_DECK_TICKS, read once here), the
+        # per-tenant usage accountant sharing the one registry, and the
+        # saturation window for the capacity model.  All host-side
+        # telemetry — no compiled program depends on any of it.
+        self.deck = TickDeck(clock=self.clock)
+        self.usage = UsageAccountant(self.registry)
+        self._capacity_window_s = resolve_capacity_window_s()
+        # Thread-local usage-attribution context: the scheduler binds the
+        # tenant labels of every row riding a device call; the sequential
+        # worker binds its one request's tenant; unbound steady invokes
+        # (direct session.infer) attribute to the "default" tenant so the
+        # per-tenant device-seconds partition stays exhaustive.
+        self._usage_tl = threading.local()
         self._backend = jax.default_backend()
         try:
             self._device_kind = jax.devices()[0].device_kind
@@ -442,6 +459,15 @@ class InferenceSession:
         self._canary_state = {"enabled": self.cfg.canary, "ran": False,
                               "passed": None, "attempts": 0}
         self._run_cfg, self._env = self.breaker.apply(cfg)
+        # Scrape identity (standard exposition practice): every /metrics
+        # scrape names the config fingerprint, runtime versions and
+        # backend it came from, plus the process start time.
+        import platform
+        self.registry.set_build_info(
+            fingerprint=self.fingerprint_id(),
+            python=platform.python_version(),
+            jax=getattr(jax, "__version__", "unknown"),
+            backend=self._backend)
         self.start()
 
     # -- lifecycle --------------------------------------------------------
@@ -549,6 +575,32 @@ class InferenceSession:
         # segments have batch-dependent cost) — so batch is part of the
         # key and callers always pad rows up to a registered bucket.
         return (kind, b, h, w, iters, self._fingerprint(cfg, env))
+
+    def fingerprint_id(self) -> str:
+        """Short stable hash of the CURRENT run fingerprint (config
+        fields + effective kernel switches) — the /debug/config and
+        ``raft_build_info`` identity.  An effective breaker trip changes
+        it, exactly like the cache keys it summarizes."""
+        import hashlib
+        return hashlib.sha256(
+            repr(self._fingerprint()).encode()).hexdigest()[:12]
+
+    # -- per-tenant usage attribution (obs/usage.py) -----------------------
+
+    @contextlib.contextmanager
+    def usage_riders(self, labels):
+        """Bind the tenant labels of the rows riding the next device
+        call(s) on THIS thread: ``invoke`` partitions each steady
+        invocation's device seconds (and ledger flops) exactly across
+        them.  The scheduler binds its batch's labels per device call;
+        the sequential worker binds its one request's label; nesting
+        restores the previous binding."""
+        prev = getattr(self._usage_tl, "labels", None)
+        self._usage_tl.labels = list(labels) or None
+        try:
+            yield
+        finally:
+            self._usage_tl.labels = prev
 
     def _build_fn(self, kind: str, cfg, iters: int):
         return self._jax.jit(build_program(kind, cfg, iters))
@@ -732,6 +784,13 @@ class InferenceSession:
             self.watch.end(token)
         ordinal = self.faults.on_forward()
         t_end = self.clock.now()  # includes any injected device time
+        # ONE host/device split shared by the counters, the tick deck
+        # and the per-tenant usage partition — using the same two floats
+        # everywhere is what makes the deck/counter/usage reconciliation
+        # an equality, not three nearly-equal measurements.
+        host_s = max(0.0, t_disp - t0)
+        device_s = max(0.0, t_end - t_disp)
+        _, b_key, h_key, w_key = prog.key[:4]
         self.registry.counter(
             "raft_program_calls_total",
             "device-program invocations by kind", kind=prog.kind).inc()
@@ -748,11 +807,11 @@ class InferenceSession:
             self.registry.counter(
                 "raft_program_host_seconds_total",
                 "host-side dispatch time by program kind",
-                kind=prog.kind).inc(max(0.0, t_disp - t0))
+                kind=prog.kind).inc(host_s)
             self.registry.counter(
                 "raft_program_device_seconds_total",
                 "device wait (dispatch-to-fetch) by program kind",
-                kind=prog.kind).inc(max(0.0, t_end - t_disp))
+                kind=prog.kind).inc(device_s)
             # The MFU join's numerator: ledger flop/byte estimates
             # accumulated per kind, steady-state only (warmups are
             # excluded from device seconds, so they must be excluded here
@@ -769,12 +828,41 @@ class InferenceSession:
                     "raft_program_hbm_bytes_total",
                     "ledger-estimated HBM bytes moved by program kind",
                     kind=prog.kind).inc(row.bytes_est)
-            trace.add_span(prog.kind, t0, t_end, program=prog.ledger_id)
+            # Per-tenant attribution (obs/usage.py): partition this
+            # steady invocation's device seconds + ledger flops exactly
+            # across the bound rider labels (scheduler-bound batch rows,
+            # the sequential worker's one tenant, or "default" for a
+            # direct session caller) — warmups excluded, matching the
+            # device-seconds counter, so tenant sums reconcile with the
+            # program totals.
+            # The fallback routes through label() like every bound
+            # path, so 'default' is registered in the first-come set
+            # (tenants_tracked counts it) and shares the bound
+            # discipline instead of bypassing it.
+            labels = getattr(self._usage_tl, "labels", None) \
+                or [self.usage.label(DEFAULT_TENANT)]
+            self.usage.add_device(
+                labels, device_s,
+                flops=(row.flops_est if row is not None else None))
+            tick_seq = self.deck.note_invocation(
+                kind=prog.kind, program=prog.ledger_id, b=b_key,
+                h=h_key, w=w_key, t0=t0, t1=t_end, host_s=host_s,
+                device_s=device_s, warming=False)
+            attrs = {"program": prog.ledger_id}
+            if tick_seq is not None:
+                # Standalone (sequential) deck row: the span links to it
+                # the same way scheduler spans link to their tick seq.
+                attrs["tick"] = tick_seq
+            trace.add_span(prog.kind, t0, t_end, **attrs)
         else:
             self.registry.counter(
                 "raft_program_warmup_seconds_total",
                 "first-invocation (compile-inclusive) time by kind",
                 kind=prog.kind).inc(max(0.0, t_end - t0))
+            self.deck.note_invocation(
+                kind=prog.kind, program=prog.ledger_id, b=b_key,
+                h=h_key, w=w_key, t0=t0, t1=t_end, host_s=host_s,
+                device_s=device_s, warming=True)
             trace.add_span(prog.kind, t0, t_end, warming=True,
                            program=prog.ledger_id)
         if self.faults.poisoned(ordinal):
@@ -1099,6 +1187,84 @@ class InferenceSession:
             device_kind=self._device_kind,
             attribution=self.attribution(), cache_hbm=self.cache_hbm())
 
+    # -- capacity & saturation model (obs/capacity.py) ---------------------
+
+    def capacity_status(self) -> Dict:
+        """The /healthz ``capacity`` block: per-bucket theoretical
+        requests/s from the warmed EMA cost table, live device
+        saturation from the tick deck, and the headroom gauges
+        published as a side effect (``raft_capacity_headroom{bucket=}``
+        = theoretical rps x (1 - saturation);
+        ``raft_capacity_saturation``)."""
+        from raft_stereo_tpu.obs import capacity as cap
+        with self._est_lock:
+            ests = dict(self._estimates)
+        # Only rows keyed under the CURRENT run fingerprint feed the
+        # model: after a breaker trip the old rung's EMA entries linger
+        # until eviction, and capacity must describe the programs that
+        # would actually serve — not whichever stale row dict order
+        # happens to surface last.  Same for iteration counts: only the
+        # canonical per-kind iters (the serving paths' own values) are
+        # modeled, so e.g. a short-iters canary "full" program cannot
+        # overwrite the serving "full" estimate.
+        fp = self._fingerprint()
+        m_iters = self.cfg.valid_iters // self.cfg.segments
+        kind_iters = {"full": self.cfg.valid_iters, "prepare": 0,
+                      "segment": m_iters, "advance": m_iters,
+                      "epilogue": 0}
+        rows = [{"kind": k[0], "b": k[1], "h": k[2], "w": k[3],
+                 "iters": k[4], "est": v} for k, v in ests.items()
+                if k[5] == fp and kind_iters.get(k[0]) == k[4]]
+        doc = cap.model(rows, segments=self.cfg.segments,
+                        valid_iters=self.cfg.valid_iters)
+        sat = cap.saturation(self.deck.snapshot(),
+                             now=self.clock.now(),
+                             window_s=self._capacity_window_s)
+        doc["saturation"] = sat
+        ratio = sat["ratio"] if sat is not None else None
+        if ratio is not None:
+            self.registry.gauge(
+                "raft_capacity_saturation",
+                "device-busy fraction over the sliding capacity window "
+                "(1.0 = the device never idled)").set(ratio)
+        for bucket, m in doc["by_bucket"].items():
+            if m.get("rps") is None:
+                continue
+            headroom = m["rps"] * max(0.0, 1.0 - (ratio or 0.0))
+            m["headroom_rps"] = headroom
+            self.registry.gauge(
+                "raft_capacity_headroom",
+                "estimated remaining requests/s by shape bucket "
+                "(theoretical rps x (1 - saturation))",
+                bucket=bucket).set(headroom)
+        return doc
+
+    # -- debug introspection (GET /debug/config) ---------------------------
+
+    def config_doc(self) -> Dict:
+        """The session half of /debug/config: resolved knob snapshot,
+        fingerprint, breaker trips, batch-bucket ladder, program-cache
+        contents.  Read-only and bounded (the cache is LRU-bounded, the
+        env snapshot is the registry key set)."""
+        with self._cache_lock:
+            programs = [{"id": p.ledger_id, "warmed": p.warmed,
+                         "aot": p.compiled is not None}
+                        for p in self._cache.values()]
+        env = self._resolve(self._env)
+        return {
+            "fingerprint": self.fingerprint_id(),
+            "backend": self._backend,
+            "device_kind": self._device_kind,
+            "session_cfg": dataclasses.asdict(self.cfg),
+            "env_knobs": {k: env.get(k) for k in sorted(env)},
+            "breaker": self.breaker.status(),
+            "batch_buckets": list(self._batch_buckets),
+            "max_programs": self._max_programs,
+            "programs": programs,
+            "deck": self.deck.status(),
+            "capacity_window_s": self._capacity_window_s,
+        }
+
     # -- reporting --------------------------------------------------------
 
     def count_request(self, ok: bool, degraded: bool = False,
@@ -1146,4 +1312,6 @@ class InferenceSession:
                        "cache_hbm": self.cache_hbm(),
                        "attribution": self.attribution()},
             "flight": self.flight.status(),
+            "deck": self.deck.status(),
+            "usage": self.usage.status(),
         }

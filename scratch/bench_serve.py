@@ -140,6 +140,24 @@ def main() -> None:
             out["occupancy_hist"] = b["occupancy_hist"]
             out["pad_waste"] = round(b["pad_waste"], 4)
             out["ticks"] = b["ticks"]
+        # graftdeck (DESIGN.md r15): the batching-efficiency numbers the
+        # ROADMAP quotes come off the tick flight-deck + capacity model
+        # so they ride the recorded trajectory instead of log lines.
+        deck_ticks = [t for t in session.deck.snapshot()
+                      if t["kind"] == "tick" and t["batch"] > 0]
+        if deck_ticks:
+            adv_rows = sum(t["batch"] for t in deck_ticks)
+            out["occupancy_mean"] = round(
+                sum(t["occupancy"] for t in deck_ticks)
+                / len(deck_ticks), 4)
+            out["pad_waste_ratio"] = round(
+                sum(t["pad_rows"] for t in deck_ticks) / adv_rows, 4)
+        cap = status.get("capacity") or {}
+        sat = cap.get("saturation")
+        out["sat_ratio"] = (round(sat["ratio"], 4)
+                            if sat is not None else None)
+        out["predicted_rps"] = (round(cap["best_rps"], 4)
+                                if cap.get("best_rps") else None)
         return out
 
     def run_loopback(mb: int) -> dict:
@@ -237,6 +255,10 @@ def main() -> None:
         "max_batch": max_batch,
         "occupancy_hist": bat.get("occupancy_hist"),
         "pad_waste": bat.get("pad_waste"),
+        "occupancy_mean": bat.get("occupancy_mean"),
+        "pad_waste_ratio": bat.get("pad_waste_ratio"),
+        "sat_ratio": bat.get("sat_ratio"),
+        "predicted_rps": bat.get("predicted_rps"),
         "backend": jax.default_backend(),
     }
     if loopback is not None:
@@ -253,7 +275,14 @@ def main() -> None:
     emit(doc["metric"], bat["rps"], "requests/s",
          backend=jax.default_backend(), source="scratch/bench_serve.py",
          extra={"sequential_rps": doc["sequential_rps"],
-                "speedup_vs_sequential": doc["speedup_vs_sequential"]})
+                "speedup_vs_sequential": doc["speedup_vs_sequential"],
+                # Operator-plane extras (graftdeck): gate runs pin
+                # predicted-vs-measured requests/s side by side, plus
+                # the batching-efficiency numbers off the tick deck.
+                "predicted_rps": doc["predicted_rps"],
+                "sat_ratio": doc["sat_ratio"],
+                "occupancy_mean": doc["occupancy_mean"],
+                "pad_waste_ratio": doc["pad_waste_ratio"]})
     if loopback is not None:
         emit(doc["metric"].replace("serve_requests_per_s",
                                    "serve_loopback_requests_per_s"),

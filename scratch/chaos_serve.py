@@ -155,7 +155,11 @@ def main() -> int:
 
     def make_request(i: int) -> dict:
         left, right = pairs[i % len(pairs)]
-        req = {"id": i, "left": left[None], "right": right[None]}
+        # graftdeck (DESIGN.md r15): a rotating tenant population rides
+        # the storm so the per-tenant device-seconds partition is
+        # exercised under every fault class (bounces, retries, zombies).
+        req = {"id": i, "left": left[None], "right": right[None],
+               "tenant": f"tenant-{i % 5}"}
         if i in deadlines:
             req["deadline_ms"] = deadlines[i]
         return req
@@ -167,6 +171,43 @@ def main() -> int:
     submitted = 0
     trips_prev = 0
     tripped_prev: set = set()
+    # /debug/stacks acceptance (graftdeck, DESIGN.md r15): while an
+    # injected hang is parked, the all-thread stack dump must name the
+    # parked invocation frame (the fault hook inside session.invoke's
+    # watchdog bracket).  A dedicated capture thread wakes on the
+    # hang-entry notification itself — deterministic, instead of racing
+    # the supervisor sweep that will release the hang — and keeps
+    # scanning until a parked frame is seen or the storm ends.
+    import threading as _threading
+    storm_done = _threading.Event()
+    hang_capture = {"captured": False}
+
+    def _capture_hang_stacks() -> None:
+        from raft_stereo_tpu.obs.deck import thread_stacks
+        seen = 0
+        while not storm_done.is_set():
+            # Wait for the NEXT hang entry (seen + 1), not merely >= 1:
+            # after the first hang, a missed scan must park here for a
+            # fresh hang instead of degrading into a ~1 kHz full-process
+            # stack-scan busy loop that could starve the storm past its
+            # real-time bound on a slow box.
+            if not session.faults.wait_hang_entered(seen + 1, timeout=0.5):
+                continue
+            seen = session.faults.hangs_entered
+            stacks = thread_stacks()
+            for th in stacks["threads"]:
+                if any(fr["function"] == "on_invoke"
+                       and fr["file"].endswith("faults.py")
+                       for fr in th["frames"]):
+                    hang_capture["captured"] = True
+                    return
+
+    capture_thread = _threading.Thread(
+        target=_capture_hang_stacks, name="chaos-stack-capture",
+        daemon=True)
+    if spec["hangs"]:
+        capture_thread.start()
+
     while len(results) < n:
         assert time.monotonic() < deadline_real, (
             f"chaos soak exceeded its {REAL_BOUND_S}s real-time bound "
@@ -237,6 +278,35 @@ def main() -> int:
         assert session.faults.hangs_entered >= 1, (
             "hang ordinals never landed on a live invocation — the storm "
             "is vacuous for the device-hang path; retune build_plan()")
+    storm_done.set()
+    if spec["hangs"]:
+        capture_thread.join(timeout=5)
+        assert hang_capture["captured"], (
+            "/debug/stacks never captured an injected hang's parked "
+            "frame (faults.py on_invoke) while a hang was live — the "
+            "introspection surface is blind to exactly the state it "
+            "exists for")
+    hang_stack_captured = hang_capture["captured"]
+
+    # graftdeck invariant: per-tenant device-seconds sum to the
+    # accounted program total EXACTLY (integer nanoseconds — the
+    # obs/usage.py partition leaks nothing, double-counts nothing, under
+    # bounces, retries and zombie discards), and the accounted total
+    # reconciles with raft_program_device_seconds_total at float
+    # tolerance (the counter is a float sum of the same intervals).
+    usage_doc = session.usage.doc()
+    tenant_ns = sum(t["device_ns"] for t in usage_doc["by_tenant"].values())
+    assert tenant_ns == usage_doc["device_ns_total"], (
+        f"per-tenant device-ns sum {tenant_ns} != accounted total "
+        f"{usage_doc['device_ns_total']} — the usage partition leaked")
+    prog_dev_s = sum(v for _, v in
+                     reg.series("raft_program_device_seconds_total"))
+    assert abs(usage_doc["device_ns_total"] / 1e9 - prog_dev_s) <= \
+        max(1e-6, 1e-9 * prog_dev_s), (
+        f"usage-accounted device seconds "
+        f"{usage_doc['device_ns_total'] / 1e9} != program counter total "
+        f"{prog_dev_s}")
+
     bounce_records = 0
     for path in session.flight.records():
         with open(path) as f:
@@ -261,6 +331,9 @@ def main() -> int:
         "retries": retries_total,
         "breaker_trips": session.breaker.trip_count,
         "flight_records": len(session.flight.records()),
+        "tenants": len(usage_doc["by_tenant"]),
+        "tenant_device_s": round(usage_doc["device_seconds_total"], 4),
+        "hang_stack_captured": hang_stack_captured,
         "fault_ordinals": {"invokes": session.faults.invokes,
                            "uploads": session.faults.uploads,
                            "ticks": session.faults.ticks,

@@ -1,0 +1,175 @@
+"""Release-gate drive of the operator-plane /debug endpoints (graftdeck,
+DESIGN.md r15) against the LIVE CLI service.
+
+Stands up ``serve_stereo.py --http_port 0`` (tiny random-weight model —
+wiring, not quality), pushes one real stereo request through the wire so
+the deck/usage/capacity surfaces have content, then GETs every operator
+endpoint and validates its JSON schema AND its boundedness (byte caps
+asserted — a debug endpoint that can grow without bound is a self-DoS
+surface).  Finishes with a SIGTERM drain and requires exit 0.
+
+One JSON line on stdout (bench.py's contract), exit 0/1.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+#: Hard byte caps per endpoint body: "bounded" is asserted, not assumed.
+BODY_CAPS = {
+    "/healthz": 1 << 20,
+    "/debug/ticks": 4 << 20,
+    "/debug/usage": 1 << 20,
+    "/debug/stacks": 1 << 20,
+    "/debug/config": 1 << 20,
+}
+
+H, W = 40, 60
+
+
+def _get(base: str, path: str) -> bytes:
+    from urllib.request import urlopen
+    with urlopen(base + path, timeout=30) as resp:
+        assert resp.status == 200, (path, resp.status)
+        return resp.read()
+
+
+def main() -> int:
+    import numpy as np
+
+    from raft_stereo_tpu.serve import wire
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "serve_stereo.py",
+         "--http_port", "0", "--no_canary",
+         "--max_batch", "2", "--valid_iters", "2", "--segments", "2",
+         "--n_gru_layers", "1", "--hidden_dims", "32", "32", "32",
+         "--corr_levels", "2", "--corr_radius", "2",
+         "--corr_implementation", "reg"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env=env, cwd=os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+    endpoint = None
+    try:
+        # A hard kill-timer, not a per-line deadline check: a child
+        # wedged BEFORE printing anything would block the pipe read
+        # forever and hang the gate — the timer turns that into an EOF
+        # and a clean assertion instead.
+        import threading
+        startup_timer = threading.Timer(600.0, proc.kill)
+        startup_timer.start()
+        try:
+            for line in proc.stdout:
+                try:
+                    doc = json.loads(line)
+                except ValueError:
+                    continue
+                if doc.get("event") == "listening":
+                    endpoint = doc["endpoint"]
+                    break
+        finally:
+            startup_timer.cancel()
+        assert endpoint, ("no listening event from serve_stereo.py "
+                          "(wedged startup killed at 600 s?)")
+
+        # One real request so ticks/usage/capacity have content.
+        rng = np.random.default_rng(0)
+        left = rng.uniform(0, 255, (H, W, 3)).astype(np.uint8)
+        right = rng.uniform(0, 255, (H, W, 3)).astype(np.uint8)
+        ct, body = wire.build_multipart(
+            {"left": wire.encode_image_png(left),
+             "right": wire.encode_image_png(right),
+             "id": b"gate-debug-0"})
+        from urllib.request import Request, urlopen
+        req = Request(endpoint + "/v1/stereo", data=body, method="POST",
+                      headers={"Content-Type": ct,
+                               "X-Raft-Tenant": "gate-tenant"})
+        with urlopen(req, timeout=300) as resp:
+            served = wire.decode_response(resp.read())
+        assert served["status"] == "ok", served
+
+        sizes = {}
+        docs = {}
+        for path, cap in BODY_CAPS.items():
+            raw = _get(endpoint, path)
+            assert len(raw) <= cap, (
+                f"{path} body is {len(raw)} bytes > its {cap} bound")
+            sizes[path] = len(raw)
+            docs[path] = json.loads(raw)
+
+        # /debug/ticks: the flight-deck ring, schema'd and ring-bounded.
+        ticks = docs["/debug/ticks"]
+        assert ticks["schema"] == 1 and isinstance(ticks["ticks"], list)
+        assert len(ticks["ticks"]) <= ticks["ring"]
+        assert ticks["recorded"] >= 1, "no deck records after a request"
+        for t in ticks["ticks"]:
+            for k in ("seq", "kind", "t_start", "device_s", "warm_s"):
+                assert k in t, (k, t)
+        # ?n= bounds it further
+        one = json.loads(_get(endpoint, "/debug/ticks?n=1"))
+        assert len(one["ticks"]) == 1
+
+        # /debug/usage: the tenant rollup, integer-exact.
+        usage = docs["/debug/usage"]
+        assert usage["schema"] == 1
+        assert "gate-tenant" in usage["by_tenant"], usage["by_tenant"]
+        assert usage["by_tenant"]["gate-tenant"]["bytes_in"] > 0
+        assert sum(t["device_ns"] for t in usage["by_tenant"].values()) \
+            == usage["device_ns_total"]
+
+        # /debug/stacks: bounded all-thread dump naming real threads.
+        stacks = docs["/debug/stacks"]
+        assert stacks["schema"] == 1 and stacks["threads"]
+        names = {t["name"] for t in stacks["threads"]}
+        assert any(n and "http-listener" in n for n in names), names
+        for t in stacks["threads"]:
+            assert len(t["frames"]) <= 32
+
+        # /debug/config: resolved knobs, fingerprint, cache contents.
+        config = docs["/debug/config"]
+        for k in ("fingerprint", "session_cfg", "service_cfg", "ingress",
+                  "breaker", "batch_buckets", "programs", "env_knobs"):
+            assert k in config, k
+        assert config["session_cfg"]["max_batch"] == 2
+
+        # /healthz carries the capacity block.
+        health = docs["/healthz"]
+        assert "capacity" in health and "by_bucket" in health["capacity"]
+
+        proc.send_signal(signal.SIGTERM)
+        # communicate(), not wait(): the CLI prints its final /healthz
+        # status document on drain, and an unread pipe could wedge it.
+        proc.communicate(timeout=120)
+        assert proc.returncode == 0, (
+            f"CLI exited {proc.returncode} after SIGTERM drain")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+
+    print(json.dumps({
+        "metric": "debug_endpoints",
+        "pass": True,
+        "endpoint_bytes": sizes,
+        "deck_recorded": ticks["recorded"],
+        "tenants": list(usage["by_tenant"]),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        raise SystemExit(main())
+    except AssertionError as e:
+        print(json.dumps({"metric": "debug_endpoints", "pass": False,
+                          "error": str(e)}))
+        raise SystemExit(1)

@@ -108,6 +108,20 @@ env JAX_PLATFORMS=cpu python -m pytest tests/test_http.py -q -m http \
     -p no:cacheprovider -p no:xdist -p no:randomly \
     || { echo "FAIL: graftwire ingress battery"; fail=1; }
 
+# Operator plane (ISSUE 12, DESIGN.md r15): the /debug/* endpoints on
+# the LIVE CLI service — tick flight-deck, per-tenant usage rollup,
+# all-thread stack dump, resolved-config snapshot — each validated for
+# JSON schema AND boundedness (byte caps asserted), plus the /healthz
+# capacity block, ending in a clean SIGTERM drain.
+step "operator plane (/debug endpoints on the live CLI service)"
+if env JAX_PLATFORMS=cpu python scratch/check_debug_endpoints.py \
+        > debug_endpoints.json; then
+    cat debug_endpoints.json
+else
+    echo "--- debug_endpoints.json ---"; cat debug_endpoints.json
+    echo "FAIL: operator-plane debug endpoints"; fail=1
+fi
+
 # Observability battery (ISSUE 7 + 8 acceptance): FakeClock span
 # timelines that reconcile with reported latency, the /metrics golden,
 # the trajectory-gate failure mode, the flat-memory reservoir pin, the
@@ -117,6 +131,15 @@ step "observability battery (graftscope: spans, /metrics, ledger, flight, trajec
 env JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py -q -m obs \
     -p no:cacheprovider -p no:xdist -p no:randomly \
     || { echo "FAIL: observability battery"; fail=1; }
+
+# graftdeck battery (ISSUE 12): the tick flight-deck's three-way
+# FakeClock reconciliation (deck == trace == counters, both serving
+# modes), per-tenant usage exactness + hostile-label hygiene, the
+# capacity model, and the debug introspection surfaces.
+step "operator-plane battery (graftdeck: deck, usage, capacity, stacks)"
+env JAX_PLATFORMS=cpu python -m pytest tests/test_deck.py -q -m deck \
+    -p no:cacheprovider -p no:xdist -p no:randomly \
+    || { echo "FAIL: operator-plane battery"; fail=1; }
 
 backend=$(python - <<'EOF'
 import jax

@@ -382,6 +382,33 @@ def test_gl002_real_tree_decode_cap_knob_registered():
     assert hits[0].path.endswith("data/frame_utils.py")
 
 
+def test_gl002_real_tree_deck_knob_registered():
+    # RAFT_DECK_TICKS (obs/deck.py resolve_deck_ticks, the tick
+    # flight-deck ring depth) is covered by HOST_ENV_KNOBS; drop it and
+    # GL002 must fire at the read site — the r15 operator-plane knobs
+    # cannot silently drift out of the registry (the drop leaves
+    # RAFT_CAPACITY_WINDOW_MS covered so the hit is unambiguous).
+    files = collect_files([str(PACKAGE)], base=str(REPO))
+    reduced = tuple(k for k in knobs.SERVE_ENV_KNOBS + knobs.HOST_ENV_KNOBS
+                    if k != "RAFT_DECK_TICKS")
+    rep = run_checkers(Project(files, serve_knobs=reduced))
+    hits = [f for f in rep.findings if f.code == "GL002"]
+    assert hits and "RAFT_DECK_TICKS" in hits[0].message
+    assert hits[0].path.endswith("obs/deck.py")
+
+
+def test_gl002_real_tree_capacity_window_knob_registered():
+    # Same pin for RAFT_CAPACITY_WINDOW_MS (obs/capacity.py, the
+    # saturation sliding window).
+    files = collect_files([str(PACKAGE)], base=str(REPO))
+    reduced = tuple(k for k in knobs.SERVE_ENV_KNOBS + knobs.HOST_ENV_KNOBS
+                    if k != "RAFT_CAPACITY_WINDOW_MS")
+    rep = run_checkers(Project(files, serve_knobs=reduced))
+    hits = [f for f in rep.findings if f.code == "GL002"]
+    assert hits and "RAFT_CAPACITY_WINDOW_MS" in hits[0].message
+    assert hits[0].path.endswith("obs/capacity.py")
+
+
 def test_gl002_real_tree_dropped_knob_fails():
     # Acceptance fixture: drop RAFT_CORR_TILE from the registry while its
     # read still exists in corr/pallas_reg.py -> GL002 must fire.

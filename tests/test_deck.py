@@ -1,0 +1,690 @@
+"""graftdeck battery: the operator plane (DESIGN.md "Operator plane
+(r15)") — tick flight-deck, per-tenant usage accounting, capacity &
+saturation model, live debug introspection.
+
+The integration tests drive the REAL serving stack (tiny model, CPU) on
+a FakeClock with plan-driven injected device time, so the load-bearing
+claims are equalities, not tolerances:
+
+- **three-way reconciliation** (the ISSUE 12 acceptance bar): the
+  deck's per-tick steady device seconds == the request's trace span
+  timeline == the ``raft_program_device_seconds_total`` counter delta,
+  exactly, in BOTH the scheduler and sequential serving modes;
+- **exact tenant partition**: per-tenant device nanoseconds sum to the
+  accounted total as an integer equality, and hostile tenant-name churn
+  past the label bound lands in ``__other__`` without growing
+  ``/metrics`` (the PR 10 quota-label regression, now for usage);
+- **introspection**: during an injected device hang, the all-thread
+  stack dump names the parked invocation frame; the four ``/debug/*``
+  endpoints serve bounded JSON through the hardened ingress.
+"""
+
+import json
+import subprocess
+import sys
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+from raft_stereo_tpu.config import RAFTStereoConfig
+from raft_stereo_tpu.faults import ChaosPlan, FakeClock, ServeFaultPlan
+from raft_stereo_tpu.models import init_raft_stereo
+from raft_stereo_tpu.obs import capacity as cap
+from raft_stereo_tpu.obs import deck as deck_mod
+from raft_stereo_tpu.obs import usage as usage_mod
+from raft_stereo_tpu.obs.deck import TickDeck, thread_stacks
+from raft_stereo_tpu.obs.flight import FlightRecorder
+from raft_stereo_tpu.obs.metrics import MetricsRegistry
+from raft_stereo_tpu.obs.tracing import Tracer
+from raft_stereo_tpu.obs.usage import (UsageAccountant, partition_ints,
+                                       sanitize_tenant)
+from raft_stereo_tpu.serve import (HttpConfig, HttpFrontend,
+                                   InferenceSession, ServiceConfig,
+                                   SessionConfig, StereoService)
+from raft_stereo_tpu.serve import wire
+
+pytestmark = pytest.mark.deck
+
+TINY = dict(n_gru_layers=1, hidden_dims=(32, 32, 32),
+            corr_levels=2, corr_radius=2)
+H, W = 40, 60
+
+#: Every device invocation advances the FakeClock by this much — exact
+#: nonzero durations with zero real sleeping (test_obs.py's rig).
+TICK = 0.25
+
+
+@pytest.fixture(scope="module")
+def tiny_cfg():
+    return RAFTStereoConfig(**TINY)
+
+
+@pytest.fixture(scope="module")
+def tiny_params(tiny_cfg):
+    return init_raft_stereo(jax.random.PRNGKey(0), tiny_cfg)
+
+
+@pytest.fixture(scope="module")
+def pair():
+    rng = np.random.default_rng(3)
+    return (rng.uniform(0, 255, (H, W, 3)).astype(np.float32),
+            rng.uniform(0, 255, (H, W, 3)).astype(np.float32))
+
+
+def slow_plan(n: int = 128) -> ServeFaultPlan:
+    return ServeFaultPlan(slow_forwards={i: TICK for i in range(n)})
+
+
+def make_session(params, cfg, *, max_batch=1, valid_iters=4, segments=2,
+                 plan=None, clock=None, flight=None):
+    scfg = SessionConfig(valid_iters=valid_iters, segments=segments,
+                         max_batch=max_batch, canary=False)
+    clock = clock or FakeClock()
+    return InferenceSession(params, cfg, scfg, fault_plan=plan,
+                            clock=clock, flight=flight,
+                            tracer=Tracer(clock=clock, sink=""))
+
+
+def device_counter_total(registry) -> float:
+    return sum(v for _, v in
+               registry.series("raft_program_device_seconds_total"))
+
+
+def trace_device_span_sum_s(trace_doc) -> float:
+    """Steady device-span seconds of one request timeline (warming spans
+    are compile-inclusive and binned apart, like the counters)."""
+    return sum(s["ms"] / 1e3 for s in trace_doc["spans"]
+               if s["kind"] in ("prepare", "segment", "advance",
+                                "epilogue", "full")
+               and not s.get("attrs", {}).get("warming"))
+
+
+# ---------------------------------------------------------------------------
+# Units: knob resolution, ring bound, partition, labels, stacks, report.
+# ---------------------------------------------------------------------------
+
+
+def test_resolve_deck_ticks_env(monkeypatch):
+    monkeypatch.delenv("RAFT_DECK_TICKS", raising=False)
+    assert deck_mod.resolve_deck_ticks() == 1024
+    assert deck_mod.resolve_deck_ticks(16) == 16
+    monkeypatch.setenv("RAFT_DECK_TICKS", "64")
+    assert deck_mod.resolve_deck_ticks() == 64
+    monkeypatch.setenv("RAFT_DECK_TICKS", "soon")
+    with pytest.raises(ValueError, match="RAFT_DECK_TICKS"):
+        deck_mod.resolve_deck_ticks()
+    monkeypatch.setenv("RAFT_DECK_TICKS", "0")
+    with pytest.raises(ValueError, match="RAFT_DECK_TICKS"):
+        deck_mod.resolve_deck_ticks()
+
+
+def test_resolve_capacity_window_env(monkeypatch):
+    monkeypatch.delenv("RAFT_CAPACITY_WINDOW_MS", raising=False)
+    assert cap.resolve_capacity_window_s() == 60.0
+    monkeypatch.setenv("RAFT_CAPACITY_WINDOW_MS", "5000")
+    assert cap.resolve_capacity_window_s() == 5.0
+    monkeypatch.setenv("RAFT_CAPACITY_WINDOW_MS", "never")
+    with pytest.raises(ValueError, match="RAFT_CAPACITY_WINDOW_MS"):
+        cap.resolve_capacity_window_s()
+
+
+def test_deck_ring_bounded_and_dropped_counted():
+    clk = FakeClock()
+    deck = TickDeck(clock=clk, ticks=4)
+    for i in range(10):
+        t = deck.begin_tick(bucket="64x64", generation=1, queue_depth=0)
+        clk.sleep(0.1)
+        deck.end_tick(t)
+    st = deck.status()
+    assert st == {"ring": 4, "recorded": 10, "dropped": 6}
+    # an OPEN tick (seq allocated, not yet ringed) must never read as a
+    # spurious ring drop on a concurrent /debug/ticks scrape
+    open_tick = deck.begin_tick(bucket="64x64")
+    st = deck.status()
+    assert st["recorded"] == 11 and st["dropped"] == 6
+    deck.end_tick(open_tick)
+    assert deck.status()["dropped"] == 7
+    doc = deck.doc()
+    assert len(doc["ticks"]) == 4
+    assert [t["seq"] for t in doc["ticks"]] == [7, 8, 9, 10]
+    assert len(deck.doc(n=2)["ticks"]) == 2  # ?n= bounds it further
+
+
+def test_deck_open_tick_is_thread_local():
+    """A zombie generation's thread can never accumulate into a fresh
+    generation's open tick — the open slot is per-thread."""
+    clk = FakeClock()
+    deck = TickDeck(clock=clk, ticks=8)
+    tick = deck.begin_tick(bucket="64x64")
+    seen = {}
+
+    def other():
+        seen["current"] = deck.current()
+        seen["seq"] = deck.note_invocation(
+            kind="advance", program="p", b=1, h=64, w=64, t0=0.0,
+            t1=1.0, host_s=0.0, device_s=1.0, warming=False)
+
+    t = threading.Thread(target=other)
+    t.start()
+    t.join()
+    assert seen["current"] is None       # other thread sees no open tick
+    assert seen["seq"] is not None       # so it recorded standalone
+    assert tick.device_s == 0.0          # and the open tick is untouched
+    deck.end_tick(tick)
+
+
+def test_partition_ints_exact():
+    rng = np.random.default_rng(0)
+    for _ in range(200):
+        total = int(rng.integers(0, 10**12))
+        n = int(rng.integers(1, 17))
+        shares = partition_ints(total, n)
+        assert sum(shares) == total
+        assert max(shares) - min(shares) <= 1
+    with pytest.raises(ValueError):
+        partition_ints(10, 0)
+
+
+def test_usage_label_first_come_bounded():
+    u = UsageAccountant(MetricsRegistry(), max_tenants=3)
+    assert u.label("a") == "a" and u.label("b") == "b"
+    assert u.label("c") == "c"
+    assert u.label("d") == usage_mod.OVERFLOW_LABEL  # bound reached
+    assert u.label("a") == "a"                       # known names keep theirs
+    assert u.label(None) == usage_mod.OVERFLOW_LABEL  # 'default' was late
+    assert sanitize_tenant("Bad Tenant!\n") == "Bad_Tenant__"
+    assert sanitize_tenant("") == "default"
+
+
+def test_usage_metrics_bounded_under_tenant_churn():
+    """The ISSUE 12 satellite: hostile tenant-name churn past the bound
+    lands in __other__ WITHOUT growing /metrics — the registry keeps
+    every label combination forever, so the bound must live here."""
+    reg = MetricsRegistry()
+    u = UsageAccountant(reg, max_tenants=4)
+    for i in range(50):
+        label = u.label(f"churn-{i}")
+        u.count_request(label, "ok")
+        u.add_device([label], 0.001)
+    baseline_lines = len(reg.render_prometheus().splitlines())
+    n_series = len(reg.series("raft_tenant_requests_total"))
+    assert n_series == 5  # 4 first-come names + __other__
+    for i in range(50, 200):  # keep churning: nothing may grow
+        label = u.label(f"churn-{i}")
+        assert label == usage_mod.OVERFLOW_LABEL
+        u.count_request(label, "ok")
+        u.add_device([label], 0.001)
+    assert len(reg.series("raft_tenant_requests_total")) == n_series
+    assert len(reg.render_prometheus().splitlines()) == baseline_lines
+    doc = u.doc()
+    assert doc["overflow_active"] and doc["tenants_tracked"] == 4
+    assert len(doc["by_tenant"]) == 5
+
+
+def test_usage_add_device_exact_across_riders():
+    u = UsageAccountant(MetricsRegistry(), max_tenants=8)
+    rng = np.random.default_rng(1)
+    for _ in range(100):
+        riders = [u.label(f"t{int(rng.integers(0, 5))}")
+                  for _ in range(int(rng.integers(1, 9)))]
+        u.add_device(riders, float(rng.uniform(0, 2.0)),
+                     flops=float(rng.integers(0, 10**9)))
+    doc = u.doc()
+    assert sum(t["device_ns"] for t in doc["by_tenant"].values()) \
+        == doc["device_ns_total"]
+    assert sum(t["flops"] for t in doc["by_tenant"].values()) \
+        == doc["flops_total"]
+
+
+def test_capacity_model_math():
+    rows = [
+        {"kind": "prepare", "b": 4, "h": 64, "w": 64, "iters": 0,
+         "est": 0.1},
+        {"kind": "advance", "b": 4, "h": 64, "w": 64, "iters": 2,
+         "est": 0.2},
+        {"kind": "epilogue", "b": 4, "h": 64, "w": 64, "iters": 0,
+         "est": 0.1},
+        {"kind": "full", "b": 1, "h": 96, "w": 128, "iters": 32,
+         "est": 2.0},
+    ]
+    doc = cap.model(rows, segments=2, valid_iters=4)
+    m = doc["by_bucket"]["64x64"]
+    # 4 rows / (0.1 + 2*0.2 + 0.1) s = 6.666... requests/s
+    assert m["mode"] == "batched" and m["batch"] == 4
+    assert m["rps"] == pytest.approx(4 / 0.6)
+    assert not m["partial"]
+    assert doc["by_bucket"]["96x128"]["rps"] == pytest.approx(0.5)
+    assert doc["best_rps"] == pytest.approx(4 / 0.6)
+
+
+def test_capacity_model_absent_is_honest():
+    doc = cap.model([], segments=2, valid_iters=4)
+    assert doc["by_bucket"] == {} and doc["best_rps"] is None
+    # an advance-less bucket reports None, never a fabricated number
+    doc = cap.model([{"kind": "prepare", "b": 1, "h": 64, "w": 64,
+                      "iters": 0, "est": 0.1}], segments=2, valid_iters=4)
+    assert doc["by_bucket"]["64x64"]["rps"] is None
+    # missing prepare/epilogue estimates flag the row partial
+    doc = cap.model([{"kind": "advance", "b": 2, "h": 64, "w": 64,
+                      "iters": 2, "est": 0.5}], segments=2, valid_iters=4)
+    m = doc["by_bucket"]["64x64"]
+    assert m["partial"] and m["rps"] == pytest.approx(2 / 1.0)
+
+
+def test_saturation_window():
+    rows = [  # 2 s of device time across 4 s of wall
+        {"t_start": 0.0, "t_end": 2.0, "device_s": 1.5, "warm_s": 0.0},
+        {"t_start": 2.0, "t_end": 4.0, "device_s": 0.0, "warm_s": 0.5},
+    ]
+    sat = cap.saturation(rows, now=4.0, window_s=60.0)
+    assert sat["ratio"] == pytest.approx(2.0 / 4.0)
+    assert sat["covered_s"] == pytest.approx(4.0)
+    # records straddling the window edge are clipped proportionally
+    sat = cap.saturation(rows, now=4.0, window_s=1.0)
+    assert sat["ratio"] == pytest.approx(0.25 / 1.0)
+    # no history: absence, never a fabricated 0
+    assert cap.saturation([], now=4.0) is None
+    assert cap.saturation([{"t_start": 0.0, "t_end": None}], now=4.0) \
+        is None
+
+
+def test_thread_stacks_bounded_and_names_self():
+    doc = thread_stacks(max_frames=8)
+    assert doc["schema"] == 1 and doc["threads"]
+    me = [t for t in doc["threads"] if t["current"]]
+    assert len(me) == 1
+    assert any(f["function"] == "test_thread_stacks_bounded_and_names_self"
+               for f in me[0]["frames"])
+    assert all(len(t["frames"]) <= 8 for t in doc["threads"])
+    tiny = thread_stacks(max_threads=1)
+    assert len(tiny["threads"]) == 1
+    assert tiny["truncated"] == (tiny["thread_count"] > 1)
+
+
+def _deck_cli(args, input_text=None):
+    return subprocess.run(
+        [sys.executable, "-m", "raft_stereo_tpu.obs.deck"] + args,
+        capture_output=True, text=True, input=input_text)
+
+
+def test_deck_report_cli(tmp_path):
+    clk = FakeClock()
+    deck = TickDeck(clock=clk, ticks=64)
+    for i in range(6):
+        t = deck.begin_tick(bucket="64x64", generation=1, queue_depth=i)
+        t.batch, t.occupancy, t.pad_rows = 4, 3, 1
+        clk.sleep(0.5)
+        deck.end_tick(t)
+        clk.sleep(0.1)  # idle gap between ticks
+    path = tmp_path / "ticks.json"
+    path.write_text(json.dumps(deck.doc()))
+    res = _deck_cli(["report", str(path)])
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "occupancy histogram" in res.stdout
+    assert "pad waste by bucket" in res.stdout
+    assert "64x64: 6/24 = 25.0%" in res.stdout
+    assert "idle gaps between ticks" in res.stdout
+    assert "n=5" in res.stdout
+    # stdin form
+    res = _deck_cli(["report", "-"], input_text=json.dumps(deck.doc()))
+    assert res.returncode == 0
+    # malformed can never read as a clean report
+    path.write_text("{not json")
+    assert _deck_cli(["report", str(path)]).returncode == 2
+    path.write_text(json.dumps({"schema": 1, "ticks": [{"bad": 1}]}))
+    assert _deck_cli(["report", str(path)]).returncode == 2
+
+
+# ---------------------------------------------------------------------------
+# Three-way reconciliation (the acceptance bar), both serving modes.
+# ---------------------------------------------------------------------------
+
+
+def test_scheduler_mode_three_way_reconciliation(tiny_params, tiny_cfg,
+                                                 pair):
+    """Deck per-tick device seconds == trace span timeline ==
+    raft_program_device_seconds_total delta, exactly, under FakeClock —
+    and the tick records carry the full operator schema."""
+    clock = FakeClock()
+    sess = make_session(tiny_params, tiny_cfg, max_batch=4,
+                        plan=slow_plan(), clock=clock)
+    with StereoService(sess, ServiceConfig(max_queue=8)) as svc:
+        # First request warms every program (compile-inclusive time is
+        # binned as warm_s, excluded from all three sides).
+        assert svc.submit({"id": "w", "left": pair[0],
+                           "right": pair[1]}).result(timeout=120)[
+                               "status"] == "ok"
+        dev0 = device_counter_total(sess.registry)
+        seq0 = sess.deck.status()["recorded"]
+        resp = svc.submit({"id": "r", "left": pair[0], "right": pair[1],
+                           "tenant": "alice"}).result(timeout=120)
+    assert resp["status"] == "ok" and resp["quality"] == "full"
+    counter_delta = device_counter_total(sess.registry) - dev0
+    new_rows = [t for t in sess.deck.snapshot() if t["seq"] >= seq0]
+    deck_dev = sum(t["device_s"] for t in new_rows)
+    doc = sess.tracer.last()
+    span_dev = trace_device_span_sum_s(doc)
+    # prepare + 2 advances + epilogue at TICK injected device time each.
+    assert counter_delta == pytest.approx(4 * TICK, abs=0)
+    assert deck_dev == counter_delta        # exact, not approx
+    assert span_dev == counter_delta
+    # The tick records carry the operator schema.
+    ticks = [t for t in new_rows if t["kind"] == "tick"]
+    assert ticks, new_rows
+    adv = [t for t in ticks if t["batch"] > 0]
+    assert adv and all(t["bucket"] == "64x64" for t in adv)
+    assert all(t["generation"] == 1 for t in ticks)
+    assert all(t["queue_depth"] is not None for t in ticks)
+    assert sum(t["joins"] for t in ticks) == 1
+    assert sum(t["exits"] for t in ticks) == 1
+    assert all(t["pad_rows"] == t["batch"] - t["occupancy"] for t in adv)
+    assert all(t["program"] and "advance" in t["program"] for t in adv)
+    # Every device span names the tick it rode (the flight-record link).
+    tick_seqs = {t["seq"] for t in ticks}
+    for s in doc["spans"]:
+        if s["kind"] in ("prepare", "advance", "epilogue"):
+            assert s["attrs"]["tick"] in tick_seqs
+
+
+def test_sequential_mode_three_way_reconciliation(tiny_params, tiny_cfg,
+                                                  pair):
+    clock = FakeClock()
+    sess = make_session(tiny_params, tiny_cfg, plan=slow_plan(),
+                        clock=clock)
+    with StereoService(sess, ServiceConfig(max_queue=4,
+                                           workers=1)) as svc:
+        assert svc.submit({"id": "w", "left": pair[0], "right": pair[1],
+                           "deadline_ms": 1e9}).result(timeout=120)[
+                               "status"] == "ok"
+        dev0 = device_counter_total(sess.registry)
+        seq0 = sess.deck.status()["recorded"]
+        resp = svc.submit({"id": "r", "left": pair[0], "right": pair[1],
+                           "deadline_ms": 1e9}).result(timeout=120)
+    assert resp["status"] == "ok" and resp["quality"] == "full"
+    counter_delta = device_counter_total(sess.registry) - dev0
+    new_rows = [t for t in sess.deck.snapshot() if t["seq"] >= seq0]
+    deck_dev = sum(t["device_s"] for t in new_rows)
+    doc = sess.tracer.last()
+    span_dev = trace_device_span_sum_s(doc)
+    # prepare + 2 segments at TICK injected device time each.
+    assert counter_delta == pytest.approx(3 * TICK, abs=0)
+    assert deck_dev == counter_delta
+    assert span_dev == counter_delta
+    # Standalone rows: per-invocation, kind-labeled, span-linked.
+    assert all(t["kind"] in ("prepare", "segment") for t in new_rows)
+    seqs = {t["seq"] for t in new_rows}
+    linked = [s["attrs"]["tick"] for s in doc["spans"]
+              if s.get("attrs", {}).get("tick") is not None]
+    assert linked and set(linked) <= seqs
+
+
+def test_flight_record_names_tick_range(tmp_path, tiny_params, tiny_cfg,
+                                        pair):
+    """An SLO post-mortem names the exact ticks the request rode."""
+    clock = FakeClock()
+    flight = FlightRecorder(out_dir=str(tmp_path), limit=8)
+    sess = make_session(tiny_params, tiny_cfg, max_batch=4,
+                        plan=slow_plan(), clock=clock, flight=flight)
+    with StereoService(sess, ServiceConfig(max_queue=8,
+                                           slo_ms=100.0)) as svc:
+        resp = svc.submit({"id": "r", "left": pair[0],
+                           "right": pair[1]}).result(timeout=120)
+    assert resp["status"] == "ok"
+    paths = flight.records()
+    assert len(paths) == 1
+    doc = json.loads(open(paths[0]).read())
+    assert doc["ticks"] is not None
+    spans = doc["trace"]["spans"]
+    span_ticks = sorted({s["attrs"]["tick"] for s in spans
+                         if s.get("attrs", {}).get("tick") is not None})
+    assert doc["ticks"]["first"] == span_ticks[0]
+    assert doc["ticks"]["last"] == span_ticks[-1]
+    assert doc["ticks"]["count"] == len(span_ticks)
+
+
+# ---------------------------------------------------------------------------
+# Per-tenant usage accounting end to end.
+# ---------------------------------------------------------------------------
+
+
+def test_usage_tenants_end_to_end_batched(tiny_params, tiny_cfg, pair):
+    clock = FakeClock()
+    sess = make_session(tiny_params, tiny_cfg, max_batch=4,
+                        plan=slow_plan(), clock=clock)
+    with StereoService(sess, ServiceConfig(max_queue=8)) as svc:
+        for rid, tenant in (("w", "alice"), ("a", "alice"), ("b", "bob")):
+            assert svc.submit({"id": rid, "left": pair[0],
+                               "right": pair[1], "tenant": tenant}
+                              ).result(timeout=120)["status"] == "ok"
+    doc = sess.usage.doc()
+    assert set(doc["by_tenant"]) >= {"alice", "bob"}
+    # integer-exact: nothing leaked, nothing double-attributed
+    assert sum(t["device_ns"] for t in doc["by_tenant"].values()) \
+        == doc["device_ns_total"]
+    # the accounted total reconciles with the program counter (same
+    # intervals, float vs int-ns accumulation)
+    prog = device_counter_total(sess.registry)
+    assert doc["device_ns_total"] / 1e9 == pytest.approx(prog, abs=1e-6)
+    # outcome counters per tenant mirror the responses
+    assert doc["by_tenant"]["alice"]["requests"]["ok"] == 2
+    assert doc["by_tenant"]["bob"]["requests"]["ok"] == 1
+    assert int(sess.registry.value("raft_tenant_requests_total",
+                                   tenant="bob", outcome="ok")) == 1
+    assert sess.registry.value("raft_tenant_device_seconds_total",
+                               tenant="bob") > 0
+    # /healthz summary block
+    st = sess.status()["usage"]
+    assert st["tenants_tracked"] >= 2
+
+
+def test_usage_sequential_default_tenant(tiny_params, tiny_cfg, pair):
+    """In-process callers without a tenant land under 'default' — the
+    partition stays exhaustive in sequential mode too."""
+    clock = FakeClock()
+    sess = make_session(tiny_params, tiny_cfg, plan=slow_plan(),
+                        clock=clock)
+    with StereoService(sess, ServiceConfig(max_queue=4)) as svc:
+        for rid in ("w", "r"):
+            assert svc.submit({"id": rid, "left": pair[0],
+                               "right": pair[1]}).result(timeout=120)[
+                                   "status"] == "ok"
+    doc = sess.usage.doc()
+    assert list(doc["by_tenant"]) == ["default"]
+    assert doc["by_tenant"]["default"]["device_ns"] \
+        == doc["device_ns_total"] > 0
+    assert doc["by_tenant"]["default"]["requests"]["ok"] == 2
+
+
+# ---------------------------------------------------------------------------
+# Capacity model against the live session.
+# ---------------------------------------------------------------------------
+
+
+def test_capacity_after_serving(tiny_params, tiny_cfg, pair):
+    clock = FakeClock()
+    sess = make_session(tiny_params, tiny_cfg, max_batch=4,
+                        plan=slow_plan(), clock=clock)
+    with StereoService(sess, ServiceConfig(max_queue=8)) as svc:
+        for rid in ("w", "r"):
+            assert svc.submit({"id": rid, "left": pair[0],
+                               "right": pair[1]}).result(timeout=120)[
+                                   "status"] == "ok"
+        status = svc.status()
+    capdoc = status["capacity"]
+    m = capdoc["by_bucket"]["64x64"]
+    # warmed EMAs exist for prepare/advance/epilogue at b=1: with TICK
+    # injected per invocation, one request costs prepare + 2 advances +
+    # epilogue = 4 * TICK -> 1 request/s.
+    assert m["rps"] == pytest.approx(1.0)
+    assert m["mode"] == "batched" and not m["partial"]
+    sat = capdoc["saturation"]
+    assert sat is not None and sat["ratio"] > 0
+    # headroom gauge published: rps * (1 - saturation)
+    assert m["headroom_rps"] == pytest.approx(
+        m["rps"] * max(0.0, 1.0 - sat["ratio"]))
+    assert sess.registry.value("raft_capacity_headroom",
+                               bucket="64x64") == m["headroom_rps"]
+    assert sess.registry.value(
+        "raft_capacity_saturation") == sat["ratio"]
+
+
+# ---------------------------------------------------------------------------
+# Build info (the scrape-identity satellite).
+# ---------------------------------------------------------------------------
+
+
+def test_build_info_gauges(tiny_params, tiny_cfg):
+    sess = make_session(tiny_params, tiny_cfg)
+    text = sess.registry.render_prometheus()
+    assert "# TYPE raft_build_info gauge" in text
+    series = sess.registry.series("raft_build_info")
+    assert len(series) == 1
+    labels, value = series[0]
+    assert value == 1.0
+    assert labels["fingerprint"] == sess.fingerprint_id()
+    assert labels["backend"] == "cpu"
+    assert labels["jax"] and labels["python"]
+    assert sess.registry.value("raft_process_start_time_seconds") > 0
+
+
+# ---------------------------------------------------------------------------
+# Debug introspection: the injected-hang acceptance + live endpoints.
+# ---------------------------------------------------------------------------
+
+
+def test_stacks_name_injected_hang_parked_frame(tiny_params, tiny_cfg,
+                                                pair):
+    """During an injected device hang, the dump names the parked
+    invocation frame (faults.py on_invoke inside the watch bracket)."""
+    clock = FakeClock()
+    plan = ChaosPlan(hang_invokes={1: 5.0}, hang_cap_s=30.0)
+    sess = make_session(tiny_params, tiny_cfg, plan=plan, clock=clock)
+    sess.infer(pair[0][None], pair[1][None])  # ordinal 0: warms, no hang
+    done = {}
+
+    def victim():
+        done["resp"] = sess.infer(pair[0][None], pair[1][None])
+
+    t = threading.Thread(target=victim, name="hang-victim", daemon=True)
+    t.start()
+    assert sess.faults.wait_hang_entered(1, timeout=30)
+    doc = thread_stacks()
+    parked = [th for th in doc["threads"]
+              if any(f["function"] == "on_invoke"
+                     and f["file"].endswith("faults.py")
+                     for f in th["frames"])]
+    assert parked, [th["name"] for th in doc["threads"]]
+    assert parked[0]["name"] == "hang-victim"
+    # the watchdog's view agrees: the invocation is registered in-flight
+    assert sess.watch.count == 1
+    sess.faults.release_hangs()
+    t.join(timeout=60)
+    assert done["resp"].quality == "full"
+
+
+@pytest.fixture(scope="module")
+def live_frontend(tiny_params, tiny_cfg):
+    session = InferenceSession(
+        tiny_params, tiny_cfg,
+        SessionConfig(valid_iters=4, segments=2, max_batch=2,
+                      canary=False))
+    svc = StereoService(session, ServiceConfig(max_queue=8)).start()
+    with HttpFrontend(svc, HttpConfig(port=0)) as fe:
+        # one real wire request so every surface has content
+        rng = np.random.default_rng(0)
+        left = rng.uniform(0, 255, (H, W, 3)).astype(np.uint8)
+        right = rng.uniform(0, 255, (H, W, 3)).astype(np.uint8)
+        ct, body = wire.build_multipart(
+            {"left": wire.encode_image_png(left),
+             "right": wire.encode_image_png(right), "id": b"d-0"})
+        req = urllib.request.Request(
+            f"http://{fe.host}:{fe.port}/v1/stereo", data=body,
+            method="POST", headers={"Content-Type": ct,
+                                    "X-Raft-Tenant": "deck-tenant"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            assert r.status == 200
+        yield fe
+    svc.stop()
+
+
+def _debug_get(fe, path):
+    with urllib.request.urlopen(
+            f"http://{fe.host}:{fe.port}{path}", timeout=30) as r:
+        return r.status, r.read()
+
+
+def test_debug_ticks_endpoint(live_frontend):
+    status, raw = _debug_get(live_frontend, "/debug/ticks")
+    assert status == 200
+    doc = json.loads(raw)
+    assert doc["schema"] == 1 and doc["ticks"]
+    assert len(doc["ticks"]) <= doc["ring"]
+    assert {"seq", "kind", "t_start", "device_s", "warm_s",
+            "bucket"} <= set(doc["ticks"][0])
+    _, raw = _debug_get(live_frontend, "/debug/ticks?n=1")
+    assert len(json.loads(raw)["ticks"]) == 1
+    # hostile ?n= is ignored, never a 500
+    status, _ = _debug_get(live_frontend, "/debug/ticks?n=lots")
+    assert status == 200
+
+
+def test_debug_usage_endpoint(live_frontend):
+    status, raw = _debug_get(live_frontend, "/debug/usage")
+    assert status == 200
+    doc = json.loads(raw)
+    assert "deck-tenant" in doc["by_tenant"]
+    row = doc["by_tenant"]["deck-tenant"]
+    assert row["bytes_in"] > 0 and row["bytes_out"] > 0
+    assert row["requests"].get("ok") == 1
+    assert sum(t["device_ns"] for t in doc["by_tenant"].values()) \
+        == doc["device_ns_total"]
+
+
+def test_debug_stacks_endpoint(live_frontend):
+    status, raw = _debug_get(live_frontend, "/debug/stacks")
+    assert status == 200
+    doc = json.loads(raw)
+    names = {t["name"] for t in doc["threads"]}
+    assert any(n and "http-listener" in n for n in names), names
+    assert all(len(t["frames"]) <= 32 for t in doc["threads"])
+    assert len(raw) < (1 << 20)  # bounded by construction
+
+
+def test_debug_config_endpoint(live_frontend):
+    status, raw = _debug_get(live_frontend, "/debug/config")
+    assert status == 200
+    doc = json.loads(raw)
+    for key in ("fingerprint", "session_cfg", "service_cfg", "ingress",
+                "env_knobs", "breaker", "batch_buckets", "programs",
+                "deck"):
+        assert key in doc, key
+    assert doc["session_cfg"]["max_batch"] == 2
+    assert doc["service_cfg"]["max_queue"] == 8
+    assert all(p["id"] for p in doc["programs"])
+    # the resolved knob snapshot covers the kernel switch set
+    assert "RAFT_CORR_TILE" in doc["env_knobs"]
+
+
+def test_debug_endpoints_ride_the_same_defenses(live_frontend):
+    """POST to a debug route is 405 (not a crash, not a 404), HEAD is
+    the header-only twin, and every response is counted."""
+    fe = live_frontend
+    req = urllib.request.Request(
+        f"http://{fe.host}:{fe.port}/debug/ticks", data=b"",
+        method="POST")
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        urllib.request.urlopen(req, timeout=30)
+    assert exc.value.code == 405
+    head = urllib.request.Request(
+        f"http://{fe.host}:{fe.port}/debug/usage", method="HEAD")
+    with urllib.request.urlopen(head, timeout=30) as r:
+        assert r.status == 200 and r.read() == b""
+    for code in ("debug_ticks", "debug_usage", "debug_stacks",
+                 "debug_config"):
+        assert fe.registry.value("raft_http_responses_total",
+                                 status="200", code=code) >= 1

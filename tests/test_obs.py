@@ -375,6 +375,31 @@ def test_trajectory_gate_cli_fails_out_of_band(tmp_path):
     assert res.returncode == 0, res.stdout + res.stderr
 
 
+def test_trajectory_gate_vacuous_warning_with_empty_bands(tmp_path):
+    """ISSUE 12 satellite: an empty bands file has made the gate pass
+    vacuously since PR 7 — the check must now SAY so, loudly, in the
+    gate output, and stop saying so the moment a band exists."""
+    traj = tmp_path / "TRAJECTORY.json"
+    bands = tmp_path / "bands.json"
+    traj.write_text(json.dumps({"schema": 1, "entries": [
+        {"metric": "m", "value": 5.0, "unit": "x"}]}))
+    warning = "0 bands pinned — gate is vacuous"
+    # empty bands dict AND missing bands file both warn
+    bands.write_text(json.dumps({"schema": 1, "bands": {}}))
+    res = _traj_cli(["check", str(traj), "--bands", str(bands)])
+    assert res.returncode == 0
+    assert warning in res.stdout
+    res = _traj_cli(["check", str(traj), "--bands",
+                     str(tmp_path / "missing.json")])
+    assert res.returncode == 0 and warning in res.stdout
+    # the first pinned band silences it
+    bands.write_text(json.dumps({"schema": 1, "bands": {
+        "m": {"value": 5.0, "rel_band": 0.2}}}))
+    res = _traj_cli(["check", str(traj), "--bands", str(bands)])
+    assert res.returncode == 0
+    assert warning not in res.stdout + res.stderr
+
+
 def test_trajectory_gate_cli_malformed_is_rc2(tmp_path):
     traj = tmp_path / "TRAJECTORY.json"
     traj.write_text("{not json")
