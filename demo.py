@@ -37,6 +37,27 @@ def build_parser() -> argparse.ArgumentParser:
                         help="directory to save output", default="demo_output")
     parser.add_argument('--valid_iters', type=int, default=32,
                         help='number of flow-field updates during forward pass')
+    parser.add_argument('--video', action='store_true',
+                        help="treat the sorted glob as ONE ordered video "
+                        "sequence and run it through a graftstream "
+                        "session: each frame's 1/8-res disparity "
+                        "warm-starts the next (prepare_warm), and warm "
+                        "frames exit early when the per-segment "
+                        "delta-flow norm falls below --converge_tol — "
+                        "per-frame iterations + quality labels are "
+                        "printed. The first frame (no prior disparity) "
+                        "is bit-identical to the single-pair path "
+                        "(pinned in tests/test_stream.py)")
+    parser.add_argument('--segments', type=int, default=4,
+                        help="video mode: host-visible segments the "
+                        "refinement splits into (must divide "
+                        "valid_iters); convergence is checked at "
+                        "segment boundaries. Ignored without --video")
+    parser.add_argument('--converge_tol', type=float, default=None,
+                        help="video mode: convergence tolerance "
+                        "(px/iter segment-mean |delta_x| at 1/8 res); "
+                        "default RAFT_CONVERGE_TOL else 0.01; 0 "
+                        "disables the early exit")
     parser.add_argument('--bucket', type=int, default=32,
                         help="pad shapes to multiples of this (multiple of "
                         "32) so a mixed-size glob shares compiled programs; "
@@ -66,9 +87,21 @@ def demo(args) -> None:
     # The session runs the SAME single-scan program make_eval_forward
     # compiled (byte-identical output, test-pinned) but bucket-caches
     # compilations, so a mixed-size glob stops recompiling per frame.
+    # Video mode splits the scan into --segments chunks so the stream
+    # runner can warm-start frames and exit at convergence boundaries
+    # (k segments of m iters are bit-identical to one k*m scan — the
+    # PR 3 composition pins — so the split itself changes no output).
+    segments = args.segments if args.video else 1
+    if args.video and args.valid_iters % segments:
+        raise SystemExit(f"--segments {segments} must divide "
+                         f"--valid_iters {args.valid_iters}")
     session = InferenceSession(params, cfg, SessionConfig(
-        valid_iters=args.valid_iters, bucket=args.bucket, segments=1,
-        canary=False))
+        valid_iters=args.valid_iters, bucket=args.bucket,
+        segments=segments, canary=False))
+    runner = None
+    if args.video:
+        from raft_stereo_tpu.serve import StreamRunner
+        runner = StreamRunner(session, converge_tol=args.converge_tol)
 
     output_directory = Path(args.output_directory)
     output_directory.mkdir(exist_ok=True)
@@ -118,7 +151,13 @@ def demo(args) -> None:
         pending_save = None
         for imfile1, image1, image2 in prefetch_samples(loader):
             try:
-                result = session.infer(image1, image2)
+                if runner is not None:
+                    result = runner.infer(image1, image2)
+                    print(f"frame {runner.frames - 1}: {imfile1} "
+                          f"iters={result.iters} "
+                          f"quality={result.quality}")
+                else:
+                    result = session.infer(image1, image2)
             except (InputRejected, SessionError) as e:
                 # One bad frame (NaN pixels, non-finite disparity) must
                 # not abort the rest of the glob — log and keep going.

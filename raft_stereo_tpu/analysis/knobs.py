@@ -136,6 +136,28 @@ HOST_ENV_KNOBS: Tuple[str, ...] = (
     "RAFT_CAPACITY_WINDOW_MS",  # saturation sliding window for the
                             # capacity model (obs/capacity.py
                             # resolve_capacity_window_s, default 60 s)
+    # graftstream knobs (DESIGN.md r17, serve/stream.py) — all three
+    # stay OUT of the program fingerprint:
+    # - RAFT_STREAM_SESSIONS / RAFT_STREAM_TTL_MS size the HOST-side
+    #   session table (how many warm-start seeds are held, for how
+    #   long); no compiled program's bytes depend on either — they are
+    #   RAFT_DECK_TICKS-class table sizing;
+    # - RAFT_CONVERGE_TOL is compared on the HOST against the per-row
+    #   delta-flow norm the advance program ALREADY returns for every
+    #   caller — the tolerance never reaches a trace, so it does not
+    #   belong in the program key.  (Had the monitor been compiled
+    #   against the tolerance, it would change the advance program and
+    #   would have to ride the key — the design deliberately avoids
+    #   that: one advance program serves every tolerance.)
+    "RAFT_STREAM_SESSIONS",  # stream session-table global cap
+                            # (serve/stream.py resolve_stream_sessions,
+                            # default 128)
+    "RAFT_STREAM_TTL_MS",   # idle stream-session expiry, ms
+                            # (serve/stream.py resolve_stream_ttl_ms,
+                            # default 60 s)
+    "RAFT_CONVERGE_TOL",    # convergence early-exit tolerance, px/iter
+                            # at 1/8 res (serve/stream.py
+                            # resolve_converge_tol, default 0.01)
 )
 
 

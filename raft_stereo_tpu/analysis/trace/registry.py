@@ -145,8 +145,9 @@ class TraceRegistry:
 
 
 def default_registry(geometry: str = "headline") -> TraceRegistry:
-    """The real tree's registry: five serving program kinds + the train
-    step + the eval forward, with ladder/knob probes at headline."""
+    """The real tree's registry: six serving program kinds (the
+    graftstream ``prepare_warm`` included) + the train step + the eval
+    forward, with ladder/knob probes at headline."""
     import jax
     import jax.numpy as jnp
 
@@ -211,6 +212,19 @@ def default_registry(geometry: str = "headline") -> TraceRegistry:
                     carry_input=True),
         serve_entry("serve/epilogue", "epilogue", 0, carry_input=True),
     ]
+
+    # graftstream warm start (DESIGN.md r17): prepare_warm is a separate
+    # program kind (extra x-only flow operand), so the GV checkers walk
+    # it like every other serving program.
+    def build_prep_warm():
+        fn = build_program("prepare_warm", cfg_serve, 0)
+        f = cfg_serve.downsample_factor
+        flow = jax.ShapeDtypeStruct((1, g["h"] // f, g["w"] // f, 1),
+                                    jnp.float32)
+        return fn, (params_spec(), img, img, flow)
+    entries.append(TraceEntry(name="serve/prepare_warm",
+                              build=build_prep_warm, env=dict(base_env),
+                              hot_path="serve", mixed_precision=True))
 
     def build_eval():
         def fwd(p, i1, i2):

@@ -343,6 +343,17 @@ def raft_stereo_prepare(params: Params, cfg: RAFTStereoConfig,
     triples), ``fmap1``/``fmap2`` (feature maps the correlation volume is
     rebuilt from), ``coords1`` — so it crosses ``jax.jit`` boundaries and
     feeds :func:`raft_stereo_segment`.
+
+    Warm-start contract (streaming, serve/stream.py): ``flow_init`` seeds
+    ``coords1 = coords0 + flow_init``.  The serving ``prepare_warm``
+    program constructs ``flow_init`` from an x-only operand with a ZERO y
+    channel baked into the program, so the carried flow's y component is
+    exactly 0 forever (every iteration's delta-y is zeroed by the
+    epipolar projection).  That invariant is what lets warm carries ride
+    the SAME compiled advance program as cold ones: ``fuse_motion=False``
+    exists only to protect the fused motion encoder from a
+    caller-supplied flow_init with nonzero y (models/update.py), which
+    the x-only construction rules out.
     """
     compute_dtype = jnp.bfloat16 if cfg.mixed_precision else jnp.float32
     net_list, inp_list, fmap1, fmap2 = _context_and_features(
@@ -416,10 +427,26 @@ def raft_stereo_segment_carry(params: Params, cfg: RAFTStereoConfig, state, *,
     upsample) only for the rows that exit at this segment boundary —
     ``raft_stereo_epilogue(segment_carry(state))`` is bit-identical to
     ``raft_stereo_segment(state)[2]`` because the mask head reads the
-    carried hidden state and never feeds back into it."""
+    carried hidden state and never feeds back into it.
+
+    Returns ``(new_state, dnorm)`` where ``dnorm`` is the per-row
+    convergence monitor: the segment's mean per-iteration
+    ``|delta_flow_x|`` (``mean|coords1_out - coords1_in| / iters``,
+    px/iter at 1/``downsample_factor`` res), shape ``(B,)`` fp32.
+    Computed OUTSIDE the scan from its endpoint coords — the scan body
+    and its carry are byte-for-byte the ones :func:`raft_stereo_segment`
+    compiles, so the epilogue∘segment_carry == segment bitwise pin is
+    untouched (an in-carry last-iteration monitor measurably perturbed
+    XLA:CPU's scan codegen).  The serving layers compare ``dnorm``
+    against ``RAFT_CONVERGE_TOL`` on the HOST at segment boundaries
+    (serve/stream.py) — the tolerance never enters the compiled program,
+    so it stays out of the program fingerprint."""
     new_state, _, _ = _advance_carry(
         params, cfg, state, iters=iters, warm_start=warm_start)
-    return new_state
+    dnorm = jnp.mean(jnp.abs(
+        (new_state["coords1"] - state["coords1"]).astype(
+            jnp.float32)[..., 0]), axis=(1, 2)) / float(iters)
+    return new_state, dnorm
 
 
 def raft_stereo_epilogue(params: Params, cfg: RAFTStereoConfig, state):

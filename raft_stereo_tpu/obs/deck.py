@@ -100,7 +100,9 @@ class TickRecord:
     batch: int = 0                 # advance batch bucket (rows incl. pads)
     occupancy: int = 0             # live rows advanced
     joins: int = 0
+    warm_joins: int = 0            # joins seeded via prepare_warm
     exits: int = 0
+    converged: int = 0             # exits via the convergence monitor
     pad_rows: int = 0
     iters: int = 0                 # refinement iters this tick advanced
     program: Optional[str] = None  # advance program's ledger id

@@ -73,7 +73,8 @@ class _TenantRow:
     """Mutable per-tenant account; all fields integer or plain dict,
     mutated only under the accountant's lock."""
 
-    __slots__ = ("device_ns", "flops", "bytes_in", "bytes_out", "outcomes")
+    __slots__ = ("device_ns", "flops", "bytes_in", "bytes_out", "outcomes",
+                 "warm_joins", "converged")
 
     def __init__(self):
         self.device_ns = 0
@@ -81,6 +82,12 @@ class _TenantRow:
         self.bytes_in = 0
         self.bytes_out = 0
         self.outcomes: Dict[str, int] = {}
+        # graftstream (serve/stream.py): frames that warm-started and
+        # rows that exited through the convergence monitor — the
+        # /debug/usage view of who is actually getting the streaming
+        # speedup.
+        self.warm_joins = 0
+        self.converged = 0
 
 
 class UsageAccountant:
@@ -169,6 +176,30 @@ class UsageAccountant:
                     "ledger-estimated flops attributed to tenants",
                     tenant=label).inc(flop_shares[i])
 
+    def note_stream(self, label: str, warm_join: bool = False,
+                    converged: bool = False) -> None:
+        """graftstream accounting: one warm join and/or one converged
+        exit for this tenant.  Counted where the event actually happens
+        (the scheduler's warm prepare, the convergence exit decision) —
+        the per-tenant twin of the global ``raft_stream_*`` counters, so
+        /debug/usage can answer "who is getting the streaming win"."""
+        if not (warm_join or converged):
+            return
+        with self._lock:
+            row = self._row(label)
+            if warm_join:
+                row.warm_joins += 1
+            if converged:
+                row.converged += 1
+        if warm_join:
+            self.registry.counter(
+                "raft_tenant_stream_warm_joins_total",
+                "warm-started frames by tenant", tenant=label).inc()
+        if converged:
+            self.registry.counter(
+                "raft_tenant_stream_converged_total",
+                "convergence early exits by tenant", tenant=label).inc()
+
     def add_bytes(self, label: str, n_in: int = 0, n_out: int = 0) -> None:
         """Wire bytes for one request (the ingress accounts these; the
         in-process paths have no wire bytes and account nothing)."""
@@ -205,6 +236,8 @@ class UsageAccountant:
                 "bytes_in": r.bytes_in,
                 "bytes_out": r.bytes_out,
                 "requests": dict(sorted(r.outcomes.items())),
+                "stream": {"warm_joins": r.warm_joins,
+                           "converged_exits": r.converged},
             } for label, r in self._rows.items()}
             total_ns = self._device_ns_total
             flops_total = self._flops_total

@@ -14,6 +14,11 @@ Layers, bottom up:
 - ``scheduler`` — iteration-level continuous batching: requests join a
                   running device batch at tick boundaries, exit at
                   segment boundaries (``SessionConfig.max_batch > 1``);
+- ``stream``    — graftstream: long-lived video-stereo sessions — a
+                  bounded (LRU + TTL + per-tenant caps) table of held
+                  1/8-res disparities that warm-start consecutive
+                  frames through the ``prepare_warm`` program, plus the
+                  convergence early exit (``converged:k`` labels);
 - ``service``   — bounded queue, backpressure, per-request deadlines,
                   /healthz status;
 - ``supervise`` — graftguard: hang watchdogs over every device
@@ -57,6 +62,12 @@ from raft_stereo_tpu.serve.session import (  # noqa: F401
     InferenceSession,
     SessionConfig,
     SessionError,
+)
+from raft_stereo_tpu.serve.stream import (  # noqa: F401
+    StreamManager,
+    StreamOutcome,
+    StreamRunner,
+    stream_infer,
 )
 from raft_stereo_tpu.serve.validate import (  # noqa: F401
     AdmissionConfig,

@@ -774,6 +774,17 @@ class _IngressHandler(BaseHTTPRequestHandler):
                    "tenant": tenant, "_trace": trace}
         if parsed["deadline_ms"] is not None:
             request["deadline_ms"] = parsed["deadline_ms"]
+        # graftstream session affinity: keep-alive POSTs carrying one
+        # X-Raft-Session value form a stream — consecutive frames land
+        # in the same (tenant, session) slot and warm-start.  The id is
+        # sanitized by the StreamManager with the same bounded-label
+        # discipline as tenants, so hostile session-name churn cannot
+        # grow memory or /metrics (the table is LRU+TTL bounded).
+        stream_id = self.headers.get("X-Raft-Session")
+        if stream_id:
+            request["stream"] = stream_id
+        if parsed["converge_tol"] is not None:
+            request["converge_tol"] = parsed["converge_tol"]
         tenant_count("admitted")
         try:
             resp = fe.service.submit(request).result(

@@ -69,7 +69,8 @@ class _Row:
 
     __slots__ = ("request", "padder", "orig_h", "orig_w", "deadline",
                  "iters_done", "t_start", "dev_pair", "upload_error",
-                 "uploaded", "tenant_label")
+                 "uploaded", "tenant_label", "flow_init", "dev_flow",
+                 "converge_tol", "converged")
 
     def __init__(self, request, padder, deadline, t_start,
                  tenant_label: str = "default"):
@@ -87,6 +88,15 @@ class _Row:
         # resolved once at admission: every device call this row rides
         # attributes its exact share of device seconds here.
         self.tenant_label = tenant_label
+        # graftstream (serve/stream.py): a warm frame carries its
+        # previous frame's padded low-res flow (the StreamManager
+        # stamped it at admission — and it stays ON the request dict, so
+        # a generation bounce re-admits the row still warm); the
+        # convergence tolerance arms the early-exit monitor.
+        self.flow_init = request.get("_flow_init")
+        self.dev_flow = None
+        self.converge_tol = request.get("_converge_tol")
+        self.converged = False
 
     @property
     def trace(self):
@@ -197,6 +207,11 @@ class _Uploader:
                     lp, rp = row.padder.pad_np(row.request["left"],
                                                row.request["right"])
                     row.dev_pair = (jax.device_put(lp), jax.device_put(rp))
+                    if row.flow_init is not None:
+                        # Warm-start seed (already at the padded low-res
+                        # bucket shape — the StreamManager only hands
+                        # out a matching field).
+                        row.dev_flow = jax.device_put(row.flow_init)
                 except Exception as e:  # noqa: BLE001 — surfaced per-row
                     row.upload_error = e
                 row.trace.add_span("upload", t0, self._clock.now(),
@@ -232,7 +247,7 @@ class BatchScheduler:
     def __init__(self, session: InferenceSession, *,
                  resolve: Optional[Callable[[Dict, Dict], None]] = None,
                  retry: Optional[Callable[[Dict, Dict], bool]] = None,
-                 generation: int = 0):
+                 generation: int = 0, stream=None):
         if session.cfg.max_batch < 2:
             raise ValueError("BatchScheduler needs SessionConfig.max_batch "
                              ">= 2; use the sequential worker path at 1")
@@ -254,6 +269,11 @@ class BatchScheduler:
         # re-admitted.
         self.retry = retry
         self.defunct = False
+        # graftstream accounting hooks (serve/stream.py StreamManager):
+        # warm joins and convergence exits are counted where they happen
+        # (this tick loop); tests driving the scheduler directly may
+        # leave it None.
+        self.stream = stream
         self.uploader = _Uploader(session.clock, faults=session.faults)
         self._buckets: Dict[Tuple[int, int], _Bucket] = {}
         self._rr: List[Tuple[int, int]] = []   # round-robin bucket order
@@ -417,37 +437,71 @@ class BatchScheduler:
             row.trace.mark("queue_wait")
             capacity -= 1
         if joiners:
-            bb = session.batch_bucket(len(joiners))
             import jax.numpy as jnp
-            lefts = [r.dev_pair[0] for r in joiners]
-            rights = [r.dev_pair[1] for r in joiners]
-            pad = bb - len(joiners)
-            lb = jnp.concatenate(lefts + [lefts[0]] * pad, axis=0)
-            rb = jnp.concatenate(rights + [rights[0]] * pad, axis=0)
-            p0 = clock.now()
-            # Rider binding (obs/usage.py): the joiners' tenant labels
-            # ride this device call — invoke partitions its steady
-            # device seconds exactly across them, zombie or not (the
-            # binding lives on this thread, and accounting happens at
-            # the same place the program counters increment).
-            with session.usage_riders([r.tenant_label for r in joiners]):
-                (state_j,) = self._device_call(
-                    "prepare", ph, pw, 0, bb, lb, rb,
-                    traces=[r.trace for r in joiners])
-            if self.defunct:
-                return  # generation retired mid-prepare: harvest() took
-                #         the joining rows; this result is discarded.
-            p1 = clock.now()
-            # The program id joins this span to its ledger row (flight
-            # records collect the rows of every program a request rode);
-            # the tick seq links it to the flight-deck record, so a
-            # post-mortem names the exact ticks the request rode.
-            prep_id = session.ledger_key_id("prepare", ph, pw, 0, b=bb)
-            for r in joiners:  # one device interval, fanned to every rider
-                r.trace.add_span("prepare", p0, p1, batch=len(joiners),
-                                 program=prep_id, tick=tick.seq)
-            if pad:
-                state_j = take_refinement_rows(state_j, range(len(joiners)))
+            # graftstream: warm joiners (a held flow_init rode in with
+            # the request) seed their carries through the prepare_warm
+            # program; cold joiners run the classic prepare.  Two device
+            # calls at most — the resulting carries then share ONE
+            # advance program (the x-only seed keeps the flow_y == 0
+            # invariant, see serve/session.py build_program), so warm
+            # and cold rows batch together from here on.
+            cold = [r for r in joiners if r.dev_flow is None]
+            warm = [r for r in joiners if r.dev_flow is not None]
+            # Reorder the published join group to match the carry concat
+            # order below (same membership, so harvest coverage is
+            # unchanged; appends already happened).
+            joiners[:] = cold + warm
+            states = []
+            for kind, group in (("prepare", cold),
+                                ("prepare_warm", warm)):
+                if not group:
+                    continue
+                bb = session.batch_bucket(len(group))
+                lefts = [r.dev_pair[0] for r in group]
+                rights = [r.dev_pair[1] for r in group]
+                pad = bb - len(group)
+                lb = jnp.concatenate(lefts + [lefts[0]] * pad, axis=0)
+                rb = jnp.concatenate(rights + [rights[0]] * pad, axis=0)
+                args = (lb, rb)
+                if kind == "prepare_warm":
+                    flows = [r.dev_flow for r in group]
+                    args = (lb, rb, jnp.concatenate(
+                        flows + [flows[0]] * pad, axis=0))
+                p0 = clock.now()
+                # Rider binding (obs/usage.py): the joiners' tenant
+                # labels ride this device call — invoke partitions its
+                # steady device seconds exactly across them, zombie or
+                # not (the binding lives on this thread, and accounting
+                # happens at the same place the program counters
+                # increment).  prepare_warm is its own kind, so the PR
+                # 12 three-way reconciliation extends to it unchanged.
+                with session.usage_riders(
+                        [r.tenant_label for r in group]):
+                    (state_g,) = self._device_call(
+                        kind, ph, pw, 0, bb, *args,
+                        traces=[r.trace for r in group])
+                if self.defunct:
+                    return  # retired mid-prepare: harvest() took the
+                    #         joining rows; this result is discarded.
+                p1 = clock.now()
+                # The program id joins this span to its ledger row
+                # (flight records collect the rows of every program a
+                # request rode); the tick seq links it to the
+                # flight-deck record, so a post-mortem names the exact
+                # ticks the request rode.
+                prep_id = session.ledger_key_id(kind, ph, pw, 0, b=bb)
+                for r in group:  # one device interval, fanned per rider
+                    r.trace.add_span(kind, p0, p1, batch=len(group),
+                                     program=prep_id, tick=tick.seq)
+                if pad:
+                    state_g = take_refinement_rows(state_g,
+                                                   range(len(group)))
+                states.append(state_g)
+            if self.stream is not None:
+                for r in warm:
+                    self.stream.note_warm_join(r.tenant_label)
+            state_j = (states[0] if len(states) == 1
+                       else stack_refinement_states(states))
             if bucket.carry is None:
                 bucket.carry = state_j
             else:
@@ -459,6 +513,7 @@ class BatchScheduler:
             bucket.rows.extend(joiners)
             self._m_joins.inc(len(joiners))
             tick.joins = len(joiners)
+            tick.warm_joins = len(warm)
         bucket.joining = []
 
         # Local binding for the rest of the tick: a concurrent generation
@@ -483,7 +538,7 @@ class BatchScheduler:
         adv_key = session.cache_key("advance", ph, pw, m_iters, b=bb)
         a0 = clock.now()
         with session.usage_riders([r.tenant_label for r in rows]):
-            state, _rowsum = self._device_call(
+            state, _rowsum, dnorm = self._device_call(
                 "advance", ph, pw, m_iters, bb, bucket.carry,
                 traces=[r.trace for r in rows])
         if self.defunct:
@@ -507,15 +562,32 @@ class BatchScheduler:
         self._m_batch_rows.inc(bb)
         self._m_pad_rows.inc(bb - n)
 
-        # 3. Exits: finished rows, plus rows whose deadline cannot absorb
-        # another batched segment (per-row anytime degradation — the first
-        # segment always runs because this check only happens after one).
+        # 3. Exits: finished rows, rows whose convergence monitor fell
+        # below their tolerance (graftstream early exit — the per-row
+        # delta-flow norm rode the advance fetch, so the check is free
+        # and evaluated exactly at segment boundaries), plus rows whose
+        # deadline cannot absorb another batched segment (per-row
+        # anytime degradation — the first segment always runs because
+        # this check only happens after one).
         now = clock.now()
         est = session.estimate(adv_key)
         exits: List[int] = []
+        n_converged = 0
         for i, row in enumerate(rows):
             if row.iters_done >= session.cfg.valid_iters:
                 exits.append(i)
+            elif row.converge_tol is not None and \
+                    float(dnorm[i]) < row.converge_tol:
+                # Honest label: converged:k with k == iterations this
+                # row ACTUALLY ran (stamped by _finish off iters_done).
+                row.converged = True
+                row.trace.event(
+                    "converged", label=f"converged:{row.iters_done}",
+                    norm=float(dnorm[i]), tol=row.converge_tol)
+                exits.append(i)
+                n_converged += 1
+                if self.stream is not None:
+                    self.stream.note_converged(row.tenant_label)
             elif row.deadline is not None and (
                     now >= row.deadline
                     or (est is not None
@@ -532,7 +604,7 @@ class BatchScheduler:
             bucket.carry, exits + [exits[0]] * (eb - len(exits)))
         e0 = clock.now()
         with session.usage_riders([rows[i].tenant_label for i in exits]):
-            (flow_up,) = self._device_call(
+            flow_up, flow_low = self._device_call(
                 "epilogue", ph, pw, 0, eb, ex_state,
                 traces=[rows[i].trace for i in exits])
         if self.defunct:
@@ -545,9 +617,18 @@ class BatchScheduler:
                                    program=epi_id, tick=tick.seq)
         now = clock.now()
         for j, i in enumerate(exits):
+            if rows[i].request.get("_stream") is not None:
+                # The exiting row's 1/8-res flow is the next frame's
+                # warm-start seed: ride it on the request dict so the
+                # service's response hook deposits it into the stream
+                # session BEFORE the caller's Future resolves.
+                rows[i].request["_stream_flow"] = \
+                    np.array(flow_low[j:j + 1], dtype=np.float32)
+                rows[i].request["_stream_shape"] = bucket.key
             self._finish(rows[i], flow_up[j:j + 1], now)
         self._m_exits.inc(len(exits))
         tick.exits = len(exits)
+        tick.converged = n_converged
         if self.defunct:
             return  # never write stale rows back over a harvested bucket
         survivors = [i for i in range(n) if i not in set(exits)]
@@ -607,8 +688,12 @@ class BatchScheduler:
         session = self.session
         with row.trace.span("unpad"):
             flow = row.padder.unpad_np(flow_padded)[0, ..., 0]
-        quality = ("full" if row.iters_done >= session.cfg.valid_iters
-                   else f"reduced_iters:{row.iters_done}")
+        if row.iters_done >= session.cfg.valid_iters:
+            quality = "full"
+        elif row.converged:
+            quality = f"converged:{row.iters_done}"
+        else:
+            quality = f"reduced_iters:{row.iters_done}"
         if flow.shape != (row.orig_h, row.orig_w):
             session.count_request(ok=False)
             self._respond(row, _error(

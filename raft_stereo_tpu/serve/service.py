@@ -112,6 +112,21 @@ class ServiceConfig:
     # positive watchdog floor only). check_now() remains drivable by
     # hand either way.
     supervise: bool = True
+    # graftstream (serve/stream.py). All three resolve at construction:
+    # explicit value > env knob > default — host-side session-table
+    # sizing and a host-side norm comparison, never part of any program
+    # fingerprint (analysis/knobs.py HOST_ENV_KNOBS).
+    #
+    # stream_sessions: global bound on live stream sessions (LRU).
+    # None -> RAFT_STREAM_SESSIONS -> 128.
+    stream_sessions: Optional[int] = None
+    # stream_ttl_ms: idle-session expiry on the session clock.
+    # None -> RAFT_STREAM_TTL_MS -> 60 s.
+    stream_ttl_ms: Optional[float] = None
+    # converge_tol: default convergence tolerance stamped on warm
+    # frames (px/iter segment-mean |delta_x| at 1/8 res; 0 disables).
+    # None -> RAFT_CONVERGE_TOL -> 0.01.
+    converge_tol: Optional[float] = None
 
 
 def _reject(code: str, message: str) -> Dict:
@@ -184,6 +199,17 @@ class StereoService:
         # queue.
         self._batched = session.cfg.max_batch > 1
         self._scheduler = None
+        # graftstream (serve/stream.py): the bounded session table +
+        # warm-start/convergence stamping protocol.  Always constructed
+        # (zero sessions when no client streams); serving paths count
+        # warm joins / converged exits through it, and the response
+        # hooks below deposit each served frame's low-res flow BEFORE
+        # the Future resolves.
+        from raft_stereo_tpu.serve.stream import StreamManager
+        self.stream = StreamManager(
+            session, max_sessions=self.cfg.stream_sessions,
+            ttl_ms=self.cfg.stream_ttl_ms,
+            converge_tol=self.cfg.converge_tol)
 
     # -- lifecycle --------------------------------------------------------
 
@@ -226,7 +252,7 @@ class StereoService:
                 self._scheduler = BatchScheduler(
                     self.session, resolve=self._resolve_scheduled,
                     retry=self._retry_scheduled,
-                    generation=self._generation)
+                    generation=self._generation, stream=self.stream)
                 self._heartbeat = Heartbeat("scheduler", self.session.clock)
                 sched, hb = self._scheduler, self._heartbeat
                 # Spawn + publish INSIDE the lock — the same invariant
@@ -286,6 +312,12 @@ class StereoService:
             request, fut = item
             self._force_resolve(request, fut, drain_event=True)
         self._gauge_depth.set(self._queue.qsize())
+        # Stream sessions die with the service (graftstream lifecycle):
+        # a restart serves cold first frames — a stale held flow must
+        # never outlive the service generation that produced it.
+        dropped = self.stream.drop_all()
+        if dropped:
+            logger.info("dropped %d stream session(s) on stop", dropped)
         with self._lock:
             self._workers = [t for t in self._workers if t.is_alive()]
         # Zombie threads (generations retired by a bounce whose join
@@ -487,9 +519,16 @@ class StereoService:
         request["_deadline"] = (
             None if deadline_ms is None
             else self.session.clock.now() + deadline_ms / 1e3)
+        # graftstream: resolve the stream session (if any) and stamp the
+        # warm-start seed + convergence tolerance onto the request.  On
+        # the request dict deliberately — a generation bounce re-admits
+        # harvested rows from these dicts, so a bounced stream frame
+        # stays warm (chaos-pinned).
+        self.stream.admit(request)
         trace.mark("admission", h=int(request["left"].shape[1]),
                    w=int(request["left"].shape[2]),
-                   deadline_ms=deadline_ms)
+                   deadline_ms=deadline_ms,
+                   warm=request.get("_flow_init") is not None)
         return None
 
     def _respond_once(self, request: Dict) -> Dict:
@@ -509,13 +548,56 @@ class StereoService:
                 # Sequential tenant attribution: this worker thread runs
                 # exactly one request's device calls — bind its label so
                 # invoke attributes the whole steady device time to it.
-                with self.session.usage_riders([
-                        self._tenant_label(request)]):
-                    result = self.session.infer(
-                        request["left"], request["right"],
-                        deadline=deadline,
-                        allow_half_res=request.get("allow_half_res"),
-                        prevalidated=True, trace=trace)
+                label = self._tenant_label(request)
+                # A stream member always takes the segmented path — a
+                # COLD first frame must still deposit its low-res flow
+                # or the stream never warms (bit-identical to the full
+                # program by the composition pins, so routing cold
+                # frames here costs nothing but program count).  Known
+                # tradeoff (DESIGN.md r17): this path has no half-res
+                # degrade rung — a held warm-start seed is keyed to the
+                # full-res bucket, so halving would discard it; a
+                # deadline that cannot absorb one full-res segment
+                # resolves as reduced_iters with deadline_missed
+                # reported honestly instead of the stateless path's
+                # half_res route.  ROADMAP item 4's tier cascade is the
+                # planned principled home for cross-resolution demotion.
+                streaming = (request.get("_stream") is not None
+                             or request.get("_flow_init") is not None
+                             or request.get("_converge_tol") is not None)
+                with self.session.usage_riders([label]):
+                    if streaming:
+                        # graftstream sequential path: the segmented
+                        # prepare[_warm]/advance/epilogue composition so
+                        # warm starts and convergence exits engage
+                        # (bit-identical to the full program when
+                        # neither fires — the composition pins).
+                        from raft_stereo_tpu.serve.stream import \
+                            stream_infer
+                        out = stream_infer(
+                            self.session, request["left"],
+                            request["right"],
+                            flow_init=request.get("_flow_init"),
+                            converge_tol=request.get("_converge_tol"),
+                            deadline=deadline, prevalidated=True,
+                            trace=trace)
+                        result = out.result
+                        if out.warm:
+                            # Counted where it happened (the warm
+                            # prepare actually ran) — the scheduler's
+                            # accounting stance, mirrored.
+                            self.stream.note_warm_join(label)
+                        if request.get("_stream") is not None:
+                            request["_stream_flow"] = out.flow_low
+                            request["_stream_shape"] = out.padded_shape
+                        if result.quality.startswith("converged:"):
+                            self.stream.note_converged(label)
+                    else:
+                        result = self.session.infer(
+                            request["left"], request["right"],
+                            deadline=deadline,
+                            allow_half_res=request.get("allow_half_res"),
+                            prevalidated=True, trace=trace)
                 self._latency.observe(self.session.clock.now() - t0)
                 resp = {
                     "status": "ok",
@@ -549,6 +631,10 @@ class StereoService:
     def _finalize(self, request: Dict, resp: Dict) -> Dict:
         """Count, stamp retries, finish the trace, flight-record — the
         single resolution tail every sequential response goes through."""
+        # Deposit the served frame's warm-start seed FIRST: a client
+        # that receives this response and immediately sends the next
+        # frame must find the session warm.
+        self.stream.deposit(request, resp)
         if request.get("id") is not None:
             resp["id"] = request["id"]
         retries = request.get("_retries", 0)
@@ -788,6 +874,10 @@ class StereoService:
         if not self._claim(request):
             return  # another generation resolved this request first
         self._mark_resolved()
+        # Deposit the warm-start seed BEFORE the Future resolves (same
+        # ordering argument as the flight record below): a woken caller
+        # posting its next frame must find the session warm.
+        self.stream.deposit(request, resp)
         retries = request.get("_retries", 0)
         if retries and "retries" not in resp:
             resp["retries"] = retries
@@ -958,7 +1048,8 @@ class StereoService:
             from raft_stereo_tpu.serve.scheduler import BatchScheduler
             self._scheduler = BatchScheduler(
                 self.session, resolve=self._resolve_scheduled,
-                retry=self._retry_scheduled, generation=gen)
+                retry=self._retry_scheduled, generation=gen,
+                stream=self.stream)
             self._heartbeat = Heartbeat("scheduler", self.session.clock)
             sched, hb = self._scheduler, self._heartbeat
             # Spawn + publish the new generation's thread INSIDE the
@@ -1096,6 +1187,9 @@ class StereoService:
                            "n": self._latency.n},
             "batching": (self._scheduler.status()
                          if self._scheduler is not None else None),
+            # graftstream: the bounded session table + warm/converged
+            # counters (serve/stream.py).
+            "stream": self.stream.status(),
             "supervision": self.supervision_status(),
             # The operator-plane capacity block (obs/capacity.py):
             # per-bucket theoretical requests/s from the warmed EMAs,

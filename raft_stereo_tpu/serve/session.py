@@ -104,9 +104,9 @@ class SessionConfig:
         32 == the reference per-shape padding formula.
     max_programs: LRU bound on cached compiled programs. With
         ``max_batch > 1`` the effective bound is raised to fit one fully
-        warm shape bucket (prepare/advance/epilogue at every batch
-        bucket) — a smaller bound would evict the warmup's own programs
-        and recompile per tick.
+        warm shape bucket (prepare/prepare_warm/advance/epilogue at
+        every batch bucket) — a smaller bound would evict the warmup's
+        own programs and recompile per tick.
     warmup_shapes: (H, W) image shapes whose full-scan programs compile at
         construction, so first requests don't pay the compile.
     warmup_segmented: also pre-compile the prepare/segment programs for
@@ -284,7 +284,19 @@ _SESSION_COUNTERS = {
 # `_build_fn` and the graftverify trace registry
 # (analysis/trace/registry.py), which traces each kind at pinned shapes so
 # the GV checkers walk exactly the programs serving would compile.
-PROGRAM_KINDS = ("full", "prepare", "segment", "advance", "epilogue")
+#
+# "prepare_warm" is the streaming warm-start seam (serve/stream.py): the
+# same encoder half as "prepare" plus a flow_init operand seeding
+# ``coords1 = coords0 + flow_init``.  It is a DIFFERENT traced program
+# (extra operand, extra adds), so it is a separate kind with its own
+# cache rows, ledger rows and warmup entry — reusing the cold key would
+# be exactly the PR 3 stale-program bug class.  The flow operand is
+# x-only (the program bakes in a zero y channel), which preserves the
+# flow-y == 0 invariant the fused motion encoder relies on — so warm and
+# cold carries share ONE advance program and one epilogue, and
+# prepare_warm is the only new program a stream costs.
+PROGRAM_KINDS = ("full", "prepare", "prepare_warm", "segment", "advance",
+                 "epilogue")
 
 # Scan-scale declaration per kind for the program ledger (obs/ledger.py):
 # XLA cost analysis counts a scan body ONCE regardless of trip count, so
@@ -294,8 +306,8 @@ PROGRAM_KINDS = ("full", "prepare", "segment", "advance", "epilogue")
 # estimate is honest for it, so its MFU reports absent rather than ~32x
 # wrong ("segment" includes one mask-head pass per call, so its scaled
 # estimate slightly overcounts that head; documented in DESIGN.md r12).
-SCAN_SCALE = {"full": None, "prepare": 1, "segment": "iters",
-              "advance": "iters", "epilogue": 1}
+SCAN_SCALE = {"full": None, "prepare": 1, "prepare_warm": 1,
+              "segment": "iters", "advance": "iters", "epilogue": 1}
 
 
 def build_program(kind: str, cfg, iters: int):
@@ -329,6 +341,23 @@ def build_program(kind: str, cfg, iters: int):
             # fetch iterates outputs; the carry dict is one output).
             return (raft_stereo_prepare(p, cfg, image1, image2),)
         return prep
+    if kind == "prepare_warm":
+        # Streaming warm start: seed coords1 from the previous frame's
+        # 1/8-res disparity. ``flow_x`` is x-only ``(b, h/f, w/f, 1)``;
+        # the zero y channel is constructed IN the program, so the
+        # carried flow's y component is exactly 0 — the invariant that
+        # lets warm carries ride the same advance/epilogue programs as
+        # cold ones (see models/raft_stereo.py raft_stereo_prepare).
+        # With an all-zero flow_x this computes coords0 + 0.0, which is
+        # bit-identical to the cold prepare's coords0 (pinned in
+        # tests/test_stream.py).
+        def prep_warm(p, image1, image2, flow_x):
+            flow_init = jnp.concatenate(
+                [flow_x.astype(jnp.float32), jnp.zeros_like(flow_x)],
+                axis=-1)
+            return (raft_stereo_prepare(p, cfg, image1, image2,
+                                        flow_init=flow_init),)
+        return prep_warm
     if kind == "segment":
         def seg(p, state):
             state, _, flow_up = raft_stereo_segment(
@@ -339,19 +368,28 @@ def build_program(kind: str, cfg, iters: int):
         # The continuous-batching tick: advance the whole device batch
         # WITHOUT the mask-head epilogue (exiting rows pay it once, in
         # the batched "epilogue" program). The per-row coords sums are
-        # the host fetch that doubles as the completion barrier.
+        # the host fetch that doubles as the completion barrier; the
+        # per-row delta-flow norm (last iteration's mean |delta_x|)
+        # rides the same fetch — the convergence monitor the streaming
+        # early exit compares against RAFT_CONVERGE_TOL on the HOST, so
+        # the tolerance never shapes this program (and stays out of the
+        # fingerprint by construction).
         def adv(p, state):
-            state = raft_stereo_segment_carry(p, cfg, state, iters=iters)
+            state, dnorm = raft_stereo_segment_carry(p, cfg, state,
+                                                     iters=iters)
             rowsum = jnp.sum(state["coords1"].astype(jnp.float32),
                              axis=(1, 2, 3))
-            return state, rowsum
+            return state, rowsum, dnorm
         return adv
     if kind == "epilogue":
         # Mask head + convex upsample for a batch of exiting carries —
         # one stacked round trip for every row that finished this tick.
+        # The x-only low-res flow rides along (tiny next to flow_up:
+        # 1/64th the pixels) — it is the next frame's warm-start seed,
+        # held host-side per stream session (serve/stream.py).
         def epi(p, state):
-            _, flow_up = raft_stereo_epilogue(p, cfg, state)
-            return (flow_up,)
+            flow_low, flow_up = raft_stereo_epilogue(p, cfg, state)
+            return flow_up, flow_low[..., :1].astype(jnp.float32)
         return epi
     raise ValueError(f"unknown program kind {kind!r}")
 
@@ -428,16 +466,27 @@ class InferenceSession:
         # selects which batch sizes get compiled, not what any one
         # compiled program computes (analysis/knobs.py SERVE_ENV_KNOBS).
         self._batch_buckets = self._resolve_batch_buckets()
-        # Effective LRU bound: continuous batching keeps prepare/advance/
-        # epilogue warm at EVERY batch bucket for a shape — with the
-        # sequential default (8) a max_batch=8 warmup would evict its own
-        # programs and the scheduler would recompile per tick, forever.
-        # One fully-warm shape bucket is the floor; operators serving many
-        # shapes raise max_programs themselves.
+        # Effective LRU bound: continuous batching keeps prepare/
+        # prepare_warm/advance/epilogue warm at EVERY batch bucket for a
+        # shape — with the sequential default (8) a max_batch=8 warmup
+        # would evict its own programs and the scheduler would recompile
+        # per tick, forever.  One fully-warm shape bucket is the floor
+        # (FOUR kinds per bucket since graftstream added prepare_warm —
+        # the old 3-per-bucket floor would have let the warmup evict its
+        # own first programs again); operators serving many shapes raise
+        # max_programs themselves.
         self._max_programs = self.cfg.max_programs
         if self.cfg.max_batch > 1:
             self._max_programs = max(
-                self.cfg.max_programs, 3 * len(self._batch_buckets) + 2)
+                self.cfg.max_programs, 4 * len(self._batch_buckets) + 2)
+        elif self.cfg.warmup_segmented:
+            # Sequential deadline serving warms full + prepare/segment
+            # (+ the half-res pair) + the b=1 streaming trio
+            # (prepare_warm/advance/epilogue) per shape = up to 8
+            # programs; the default bound of 8 would let the warmup
+            # evict its own first program.  One fully warm sequential
+            # shape bucket plus headroom is the floor.
+            self._max_programs = max(self.cfg.max_programs, 10)
         # The ladder/knob-registry sync check lives in the breaker's
         # constructor now (guard.py imports the same ENV_KNOBS registry);
         # resolve_env additionally keeps unknown override keys, so a rung
@@ -1020,6 +1069,13 @@ class InferenceSession:
                     # below covers every program the scheduler uses).
                     from raft_stereo_tpu.serve import degrade
                     degrade.warm_segmented(self, padder, zeros)
+                    # The sequential streaming path (serve/stream.py
+                    # stream_infer) runs b=1 prepare_warm/advance/
+                    # epilogue — warm them too, or the first stream
+                    # frame of a deadline-serving deployment pays up to
+                    # three XLA compiles mid-request (the same contract
+                    # _warm_batched honors for the scheduler).
+                    self._warm_stream_sequential(padder, zeros)
                 if self.cfg.max_batch > 1:
                     self._warm_batched(padder, zeros)
                 return
@@ -1031,6 +1087,26 @@ class InferenceSession:
         raise InferenceFailed("ladder_exhausted",
                               f"warmup for bucket {h}x{w} never succeeded")
 
+    def _warm_stream_sequential(self, padder: InputPadder,
+                                zeros: np.ndarray) -> None:
+        """Compile (and once-run) the b=1 streaming programs for one
+        shape bucket — prepare_warm, advance, epilogue — the set
+        :func:`raft_stereo_tpu.serve.stream.stream_infer` drives in
+        sequential mode.  (The cold ``prepare`` is already warm from
+        ``degrade.warm_segmented``.)"""
+        import jax.numpy as jnp
+        m = self.cfg.valid_iters // self.cfg.segments
+        ph, pw = padder.padded_shape
+        lp, rp = padder.pad_np(zeros, zeros)
+        factor = self._run_cfg.downsample_factor
+        warm = self.get_program("prepare_warm", ph, pw, 0)
+        fz = jnp.zeros((1, ph // factor, pw // factor, 1), jnp.float32)
+        (state,) = self.invoke(warm, lp, rp, fz)
+        adv = self.get_program("advance", ph, pw, m)
+        state, _, _ = self.invoke(adv, state)
+        epi = self.get_program("epilogue", ph, pw, 0)
+        self.invoke(epi, state)
+
     def _warm_batched(self, padder: InputPadder, zeros: np.ndarray) -> None:
         """Compile (and once-run) the continuous-batching programs for one
         shape bucket at every batch bucket — prepare, advance, epilogue —
@@ -1041,13 +1117,21 @@ class InferenceSession:
         m = self.cfg.valid_iters // self.cfg.segments
         ph, pw = padder.padded_shape
         lp, rp = padder.pad_np(zeros, zeros)
+        factor = self._run_cfg.downsample_factor
         for b in self._batch_buckets:
             lb = jnp.concatenate([jnp.asarray(lp)] * b, axis=0)
             rb = jnp.concatenate([jnp.asarray(rp)] * b, axis=0)
             prep = self.get_program("prepare", ph, pw, 0, b=b)
             (state,) = self.invoke(prep, lb, rb)
+            # The streaming warm-start entry (serve/stream.py) — its own
+            # program kind, so it gets its own warmup: the first warm
+            # join of a stream must not pay a compile mid-stream.
+            warm = self.get_program("prepare_warm", ph, pw, 0, b=b)
+            fz = jnp.zeros((b, ph // factor, pw // factor, 1),
+                           jnp.float32)
+            self.invoke(warm, lb, rb, fz)
             adv = self.get_program("advance", ph, pw, m, b=b)
-            state, _ = self.invoke(adv, state)
+            state, _, _ = self.invoke(adv, state)
             epi = self.get_program("epilogue", ph, pw, 0, b=b)
             self.invoke(epi, state)
 
@@ -1210,8 +1294,8 @@ class InferenceSession:
         fp = self._fingerprint()
         m_iters = self.cfg.valid_iters // self.cfg.segments
         kind_iters = {"full": self.cfg.valid_iters, "prepare": 0,
-                      "segment": m_iters, "advance": m_iters,
-                      "epilogue": 0}
+                      "prepare_warm": 0, "segment": m_iters,
+                      "advance": m_iters, "epilogue": 0}
         rows = [{"kind": k[0], "b": k[1], "h": k[2], "w": k[3],
                  "iters": k[4], "est": v} for k, v in ests.items()
                 if k[5] == fp and kind_iters.get(k[0]) == k[4]]

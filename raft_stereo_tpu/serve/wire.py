@@ -196,7 +196,8 @@ def parse_stereo_request(content_type: Optional[str], headers,
     if not body:
         raise WireRejected("empty_body", "request body is empty")
     media, params = parse_content_type(content_type)
-    fields: Dict[str, Optional[str]] = {"id": None, "deadline_ms": None}
+    fields: Dict[str, Optional[str]] = {"id": None, "deadline_ms": None,
+                                        "converge_tol": None}
     if media == "multipart/form-data":
         parts = parse_multipart(body, params.get("boundary", ""))
         for k in fields:
@@ -239,7 +240,8 @@ def parse_stereo_request(content_type: Optional[str], headers,
             f"content-type {media or '(none)'!r} is not one of "
             f"multipart/form-data, application/x-raft-stereo",
             http_status=415)
-    for h, k in (("X-Raft-Id", "id"), ("X-Raft-Deadline-Ms", "deadline_ms")):
+    for h, k in (("X-Raft-Id", "id"), ("X-Raft-Deadline-Ms", "deadline_ms"),
+                 ("X-Raft-Converge-Tol", "converge_tol")):
         v = headers.get(h)
         if v is not None:
             fields[k] = v
@@ -260,8 +262,25 @@ def parse_stereo_request(content_type: Optional[str], headers,
                 "bad_deadline",
                 f"deadline_ms must be finite, "
                 f"got {fields['deadline_ms']!r}")
+    converge_tol: Optional[float] = None
+    if fields["converge_tol"] is not None:
+        # Streaming convergence tolerance (graftstream): same hostile-
+        # input stance as the deadline — a NaN would make the norm
+        # comparison silently False, a negative is meaningless.
+        try:
+            converge_tol = float(fields["converge_tol"])
+        except ValueError:
+            raise WireRejected(
+                "bad_converge_tol",
+                f"converge_tol must be a number, "
+                f"got {fields['converge_tol']!r}") from None
+        if not math.isfinite(converge_tol) or converge_tol < 0:
+            raise WireRejected(
+                "bad_converge_tol",
+                f"converge_tol must be finite and >= 0, "
+                f"got {fields['converge_tol']!r}")
     return {"left": left, "right": right, "id": fields["id"],
-            "deadline_ms": deadline_ms}
+            "deadline_ms": deadline_ms, "converge_tol": converge_tol}
 
 
 # ---------------------------------------------------------------------------

@@ -162,6 +162,18 @@ def main() -> int:
                "tenant": f"tenant-{i % 5}"}
         if i in deadlines:
             req["deadline_ms"] = deadlines[i]
+        # graftstream (DESIGN.md r17): a third of the storm rides stream
+        # sessions, so warm joins, deposits, TTL sweeps and the session
+        # table are exercised under every fault class — bounces must
+        # re-admit warm rows with their held flow_init, expiry mid-storm
+        # must drop deposits as counted no-ops, never crashes.
+        if i % 3 == 0:
+            req["stream"] = f"cam-{(i // 3) % 2}"
+            if i % 9 == 0:
+                # Loose tolerance: these frames exit converged:k when
+                # they get the chance — the honest-label machinery under
+                # the storm.
+                req["converge_tol"] = 1e9
         return req
 
     t_real0 = time.monotonic()
@@ -230,6 +242,54 @@ def main() -> int:
             results[rid] = futs.pop(rid).result(timeout=1)
         time.sleep(0.002)
 
+    # graftstream deterministic scenarios (ISSUE 13 chaos pins), run
+    # after the storm so their ordering is exact:
+    # (a) mid-stream bounce: a warm frame submitted and immediately
+    #     bounced must resolve ok, and its request dict must still hold
+    #     the warm-start seed the re-admission rode (harvest preserves
+    #     _flow_init — the scheduler-level twin is pinned in
+    #     tests/test_stream.py).
+    extra_responses = []
+    sf1 = make_request(0)
+    sf1["id"] = "stream-bounce-1"
+    sf1["stream"] = "bounce-cam"
+    sf1.pop("deadline_ms", None)
+    extra_responses.append(svc.submit(sf1).result(timeout=30))
+    assert extra_responses[-1]["status"] == "ok", extra_responses[-1]
+    warm_before = int(svc.registry.value("raft_stream_warm_joins_total"))
+    sf2 = make_request(0)
+    sf2["id"] = "stream-bounce-2"
+    sf2["stream"] = "bounce-cam"
+    sf2.pop("deadline_ms", None)
+    fut2 = svc.submit(sf2)
+    assert svc.bounce("chaos_stream"), "manual mid-stream bounce refused"
+    r2 = fut2.result(timeout=30)
+    extra_responses.append(r2)
+    assert r2["status"] == "ok", r2
+    assert sf2.get("_flow_init") is not None, (
+        "the bounced stream frame lost its held flow_init")
+    assert int(svc.registry.value("raft_stream_warm_joins_total")) \
+        >= warm_before + 1, "the warm frame never warm-joined"
+    # (b) TTL expiry: the session clock jumping past the TTL while the
+    #     stream is idle expires the session (counted); the next frame
+    #     starts cold and still serves fine.
+    expired_before = int(svc.registry.value(
+        "raft_stream_sessions_expired_total"))
+    clock.sleep(svc.stream.ttl_s * 2 + 1)
+    sf3 = make_request(0)
+    sf3["id"] = "stream-ttl"
+    sf3["stream"] = "bounce-cam"
+    sf3.pop("deadline_ms", None)
+    extra_responses.append(svc.submit(sf3).result(timeout=30))
+    assert extra_responses[-1]["status"] == "ok", extra_responses[-1]
+    assert sf3.get("_flow_init") is None, (
+        "an expired session handed out a stale warm-start seed")
+    assert int(svc.registry.value(
+        "raft_stream_sessions_expired_total")) >= expired_before + 1
+
+    stream_status = svc.stream.status()
+    assert stream_status["sessions"] <= stream_status["max_sessions"]
+
     # Invariant 5: draining rejects late submits, then quiesces clean.
     svc.begin_drain()
     late = svc.submit(make_request(0)).result(timeout=10)
@@ -237,10 +297,13 @@ def main() -> int:
         late["code"] == "service_draining", late
     clean = svc.drain()
     assert clean, "drain failed to quiesce an idle service"
+    assert svc.stream.status()["sessions"] == 0, (
+        "stream sessions survived the drain — held flows must die with "
+        "the service generation")
     elapsed_real = time.monotonic() - t_real0
 
     # Invariant 1: every outcome is structured.
-    responses = list(results.values()) + [late]
+    responses = list(results.values()) + [late] + extra_responses
     assert len(results) == n
     for r in responses:
         assert r["status"] in ("ok", "rejected", "error"), r
@@ -318,6 +381,22 @@ def main() -> int:
         f"{n_restarts} bounces but {bounce_records} watchdog flight "
         f"records — a watchdog action left no evidence")
 
+    # graftstream storm non-vacuity: the storm must actually have
+    # exercised warm joins AND produced at least one honest converged:k
+    # response (a third of the storm streams; loose-tolerance members
+    # converge at their first boundary whenever they serve ok).
+    n_converged_resp = sum(
+        1 for r in responses
+        if r.get("status") == "ok"
+        and str(r.get("quality", "")).startswith("converged:"))
+    assert n_converged_resp >= 1, (
+        "no storm response carried a converged:k label — the streaming "
+        "fault coverage is vacuous; retune the stream mix")
+    for r in responses:  # honest-label invariant: k == iters run
+        q = str(r.get("quality", ""))
+        if q.startswith("converged:"):
+            assert int(q.split(":")[1]) == r["iters"], r
+
     outcome_counts = dict(sorted(expect.items()))
     doc = {
         "metric": "chaos_soak",
@@ -325,6 +404,10 @@ def main() -> int:
         "n": n,
         "seed": spec["seed"],
         "outcomes": outcome_counts,
+        "stream": {**{k: stream_status[k] for k in
+                      ("created", "evicted", "expired", "warm_joins",
+                       "converged_exits", "deposits_dropped")},
+                   "converged_responses": n_converged_resp},
         "restarts": restarts,
         "watchdog_trips": {labels["kind"]: int(v) for labels, v in
                            reg.series("raft_watchdog_trips_total")},

@@ -81,7 +81,11 @@ def main() -> int:
         assert endpoint, ("no listening event from serve_stereo.py "
                           "(wedged startup killed at 600 s?)")
 
-        # One real request so ticks/usage/capacity have content.
+        # Three real requests so ticks/usage/capacity have content —
+        # and, riding ONE X-Raft-Session, so the graftstream surfaces
+        # (warm joins, converged exits) light up through the live wire:
+        # frame 1 cold, frame 2 warm, frame 3 warm with a loose
+        # convergence tolerance so it exits converged:k.
         rng = np.random.default_rng(0)
         left = rng.uniform(0, 255, (H, W, 3)).astype(np.uint8)
         right = rng.uniform(0, 255, (H, W, 3)).astype(np.uint8)
@@ -90,12 +94,25 @@ def main() -> int:
              "right": wire.encode_image_png(right),
              "id": b"gate-debug-0"})
         from urllib.request import Request, urlopen
-        req = Request(endpoint + "/v1/stereo", data=body, method="POST",
-                      headers={"Content-Type": ct,
-                               "X-Raft-Tenant": "gate-tenant"})
-        with urlopen(req, timeout=300) as resp:
-            served = wire.decode_response(resp.read())
+
+        def post(extra_headers):
+            req = Request(
+                endpoint + "/v1/stereo", data=body, method="POST",
+                headers={"Content-Type": ct,
+                         "X-Raft-Tenant": "gate-tenant",
+                         "X-Raft-Session": "gate-cam",
+                         **extra_headers})
+            with urlopen(req, timeout=300) as resp:
+                return wire.decode_response(resp.read())
+
+        served = post({})
         assert served["status"] == "ok", served
+        warm = post({})
+        assert warm["status"] == "ok", warm
+        conv = post({"X-Raft-Converge-Tol": "1e9"})
+        assert conv["status"] == "ok", conv
+        assert str(conv["quality"]).startswith("converged:"), conv
+        assert int(str(conv["quality"]).split(":")[1]) == conv["iters"]
 
         sizes = {}
         docs = {}
@@ -118,13 +135,39 @@ def main() -> int:
         one = json.loads(_get(endpoint, "/debug/ticks?n=1"))
         assert len(one["ticks"]) == 1
 
-        # /debug/usage: the tenant rollup, integer-exact.
+        # /debug/usage: the tenant rollup, integer-exact — now also the
+        # graftstream per-tenant warm-join/converged counts (ISSUE 13:
+        # the usage surface must expose them through the live CLI).
         usage = docs["/debug/usage"]
         assert usage["schema"] == 1
         assert "gate-tenant" in usage["by_tenant"], usage["by_tenant"]
         assert usage["by_tenant"]["gate-tenant"]["bytes_in"] > 0
         assert sum(t["device_ns"] for t in usage["by_tenant"].values()) \
             == usage["device_ns_total"]
+        gate_stream = usage["by_tenant"]["gate-tenant"]["stream"]
+        assert gate_stream["warm_joins"] >= 2, gate_stream
+        assert gate_stream["converged_exits"] >= 1, gate_stream
+
+        # /debug/ticks rows expose warm-join and converged-exit counts
+        # (the deck surface half of the same ISSUE 13 requirement).  The
+        # response Future resolves INSIDE the tick, so the final tick
+        # record may publish an instant later — re-fetch briefly.
+        ticks = docs["/debug/ticks"]
+        for t in ticks["ticks"]:
+            assert "warm_joins" in t and "converged" in t, t
+        for _ in range(100):
+            if sum(t["warm_joins"] for t in ticks["ticks"]) >= 2 and \
+                    sum(t["converged"] for t in ticks["ticks"]) >= 1:
+                break
+            time.sleep(0.05)
+            ticks = json.loads(_get(endpoint, "/debug/ticks"))
+        assert sum(t["warm_joins"] for t in ticks["ticks"]) >= 2
+        assert sum(t["converged"] for t in ticks["ticks"]) >= 1
+
+        # /healthz stream block: the bounded session table is live.
+        health = docs["/healthz"]
+        assert health["stream"]["sessions"] >= 1, health["stream"]
+        assert health["stream"]["warm_joins"] >= 2
 
         # /debug/stacks: bounded all-thread dump naming real threads.
         stacks = docs["/debug/stacks"]
@@ -162,6 +205,8 @@ def main() -> int:
         "endpoint_bytes": sizes,
         "deck_recorded": ticks["recorded"],
         "tenants": list(usage["by_tenant"]),
+        "stream": {"warm_joins": gate_stream["warm_joins"],
+                   "converged_exits": gate_stream["converged_exits"]},
     }))
     return 0
 

@@ -141,12 +141,41 @@ env JAX_PLATFORMS=cpu python -m pytest tests/test_deck.py -q -m deck \
     -p no:cacheprovider -p no:xdist -p no:randomly \
     || { echo "FAIL: operator-plane battery"; fail=1; }
 
+# graftstream battery (ISSUE 13, DESIGN.md r17): prepare_warm parity
+# pins (zero-flow bitwise vs cold prepare; warm chain vs the reference
+# flow_init forward), honest converged:k labels with deck/usage/counter
+# joins, session-table bounds under a 200-session storm, TTL
+# expiry-mid-flight, and bounce re-admission with the held flow_init.
+step "streaming battery (graftstream: warm starts, convergence, session table)"
+env JAX_PLATFORMS=cpu python -m pytest tests/test_stream.py -q -m stream \
+    -p no:cacheprovider -p no:xdist -p no:randomly \
+    || { echo "FAIL: streaming battery"; fail=1; }
+
 backend=$(python - <<'EOF'
 import jax
 print(jax.default_backend())
 EOF
 )
 echo "backend: $backend"
+
+# Streaming convergence bench (ISSUE 13 acceptance): cold-vs-warm
+# iterations-to-convergence on a synthetic panning sequence through the
+# real StreamRunner — warm frames must average <= half the cold
+# iterations at the same tolerance.  Iteration counts are
+# backend-independent, so this bar gates on CPU; the fps numbers become
+# meaningful (and land in the trajectory) on the on-chip run.
+step "streaming bench (warm-start >=2x iterations-to-convergence)"
+if [ "$backend" != "tpu" ]; then
+    stream_bench_cmd="env JAX_PLATFORMS=cpu python scratch/bench_stream.py"
+else
+    stream_bench_cmd="python scratch/bench_stream.py"
+fi
+if $stream_bench_cmd > bench_stream.json; then
+    cat bench_stream.json
+else
+    echo "--- bench_stream.json ---"; cat bench_stream.json
+    echo "FAIL: streaming bench"; fail=1
+fi
 
 # Serve-throughput bench (ISSUE 5 acceptance): requests/s through the real
 # StereoService, sequential vs continuous batching, one JSON line. On CPU

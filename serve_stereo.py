@@ -128,6 +128,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "segment-boundary exits within this window, "
                         "then the rest resolve service_stopped (default "
                         "RAFT_DRAIN_GRACE_MS or 10s)")
+    # graftstream: streaming video stereo (DESIGN.md r17)
+    parser.add_argument('--stream_sessions', type=int, default=None,
+                        help="global bound on live stream sessions "
+                        "(X-Raft-Session warm-start table; default "
+                        "RAFT_STREAM_SESSIONS or 128)")
+    parser.add_argument('--stream_ttl_ms', type=float, default=None,
+                        help="idle stream-session expiry (default "
+                        "RAFT_STREAM_TTL_MS or 60s)")
+    parser.add_argument('--converge_tol', type=float, default=None,
+                        help="convergence early-exit tolerance stamped "
+                        "on warm frames: segment-mean per-iteration "
+                        "|delta_x| at 1/8 res, px (0 disables; default "
+                        "RAFT_CONVERGE_TOL or 0.01)")
     # graftwire: network ingress (DESIGN.md r14)
     parser.add_argument('--http_port', type=int, default=None,
                         help="serve POST /v1/stereo + GET /healthz "
@@ -258,7 +271,10 @@ def serve(args) -> int:
         max_queue=args.max_queue, workers=args.workers,
         tick_ms=args.tick_ms, slo_ms=args.slo_ms,
         watchdog_ms=args.watchdog_ms, retry_budget=args.retry_budget,
-        drain_grace_ms=args.drain_grace_ms))
+        drain_grace_ms=args.drain_grace_ms,
+        stream_sessions=args.stream_sessions,
+        stream_ttl_ms=args.stream_ttl_ms,
+        converge_tol=args.converge_tol))
 
     # Graceful drain on SIGTERM/SIGINT (ROADMAP open item 4): the handler
     # only sets a flag (async-signal-safe); the submit loop below flips

@@ -409,6 +409,22 @@ def test_gl002_real_tree_capacity_window_knob_registered():
     assert hits[0].path.endswith("obs/capacity.py")
 
 
+def test_gl002_real_tree_stream_knob_registered():
+    # RAFT_STREAM_SESSIONS (serve/stream.py resolve_stream_sessions, the
+    # graftstream session-table cap) is covered by HOST_ENV_KNOBS; drop
+    # it and GL002 must fire at the read site — the r17 streaming knobs
+    # cannot silently drift out of the registry (the drop leaves
+    # RAFT_STREAM_TTL_MS / RAFT_CONVERGE_TOL covered so the hit is
+    # unambiguous).
+    files = collect_files([str(PACKAGE)], base=str(REPO))
+    reduced = tuple(k for k in knobs.SERVE_ENV_KNOBS + knobs.HOST_ENV_KNOBS
+                    if k != "RAFT_STREAM_SESSIONS")
+    rep = run_checkers(Project(files, serve_knobs=reduced))
+    hits = [f for f in rep.findings if f.code == "GL002"]
+    assert hits and "RAFT_STREAM_SESSIONS" in hits[0].message
+    assert hits[0].path.endswith("serve/stream.py")
+
+
 def test_gl002_real_tree_dropped_knob_fails():
     # Acceptance fixture: drop RAFT_CORR_TILE from the registry while its
     # read still exists in corr/pallas_reg.py -> GL002 must fire.

@@ -109,13 +109,17 @@ def test_epilogue_composes_with_segment_carry(tiny_params, tiny_cfg, pairs):
     _, low_ref, up_ref = jax.jit(
         lambda p, s: raft_stereo_segment(p, cfg, s, iters=2))(
         tiny_params, state)
-    carry = jax.jit(
+    carry, dnorm = jax.jit(
         lambda p, s: raft_stereo_segment_carry(p, cfg, s, iters=2))(
         tiny_params, state)
     low, up = jax.jit(lambda p, s: raft_stereo_epilogue(p, cfg, s))(
         tiny_params, carry)
     assert np.asarray(up).tobytes() == np.asarray(up_ref).tobytes()
     assert np.asarray(low).tobytes() == np.asarray(low_ref).tobytes()
+    # The convergence monitor is derived from the same endpoint coords:
+    # mean |delta_x| per iteration, per row, finite and non-negative.
+    dn = np.asarray(dnorm)
+    assert dn.shape == (1,) and np.isfinite(dn).all() and (dn >= 0).all()
 
 
 def test_batch_rows_bitwise_independent(tiny_params, tiny_cfg, pairs):
@@ -186,10 +190,11 @@ def test_batch_bucket_resolution_and_cache_key(tiny_params, tiny_cfg):
         SessionConfig(max_batch=4, batch_buckets=(4, 2))
     with pytest.raises(ValueError, match="max_batch"):
         SessionConfig(max_batch=0)
-    # LRU floor: one fully warm shape bucket (prepare/advance/epilogue at
-    # every batch bucket) must fit, or warmup would evict its own programs
+    # LRU floor: one fully warm shape bucket (prepare/prepare_warm/
+    # advance/epilogue at every batch bucket) must fit, or warmup would
+    # evict its own programs
     s8 = make_session(tiny_params, tiny_cfg, max_batch=8, max_programs=4)
-    assert s8._max_programs >= 3 * len(s8.batch_buckets)
+    assert s8._max_programs >= 4 * len(s8.batch_buckets)
 
 
 def test_ema_keyed_per_batch_bucket(tiny_params, tiny_cfg, pairs):
@@ -208,13 +213,13 @@ def test_ema_keyed_per_batch_bucket(tiny_params, tiny_cfg, pairs):
     prep = sess.get_program("prepare", 64, 64, 0, b=1)
     (state,) = sess.invoke(prep, lp, rp)
     adv1 = sess.get_program("advance", 64, 64, 2, b=1)
-    state1, _ = sess.invoke(adv1, state)          # warming: excluded
+    state1, _, _ = sess.invoke(adv1, state)       # warming: excluded
     sess.invoke(adv1, state1)                      # recorded: 5.0
     assert sess.estimate(adv1.key) == pytest.approx(5.0)
     state4 = take_refinement_rows(state, [0, 0, 0, 0])
     adv4 = sess.get_program("advance", 64, 64, 2, b=4)
     assert adv4.key != adv1.key
-    state4b, _ = sess.invoke(adv4, state4)         # warming: excluded
+    state4b, _, _ = sess.invoke(adv4, state4)      # warming: excluded
     assert sess.estimate(adv4.key) is None
     assert sess.estimate(adv1.key) == pytest.approx(5.0)  # untouched
     sess.invoke(adv4, state4b)                     # recorded: 7.0

@@ -122,9 +122,12 @@ def test_inference_rejects_bad_segmenting(tiny_params, tiny_cfg, pair):
 def test_session_serves_full_quality(clean_session, pair):
     # warmup compiled the bucket's programs at construction: full,
     # prepare, segment — plus the half bucket's prepare/segment (the
-    # degrade policy only routes half_res onto warm programs)
+    # degrade policy only routes half_res onto warm programs) — plus,
+    # since graftstream (r17), the b=1 streaming trio
+    # prepare_warm/advance/epilogue (stream_infer must never pay a
+    # compile mid-request on a warmed bucket)
     warm_compiles = clean_session.metrics()["compiles"]
-    assert warm_compiles == 5
+    assert warm_compiles == 8
     res = clean_session.infer(*pair)
     assert res.quality == "full" and not res.degraded
     assert res.iters == 4
@@ -395,13 +398,14 @@ def test_deadline_half_res(tiny_params, tiny_cfg, pair):
     clk = FakeClock()
     # Construction warms full + half buckets (invocation ordinals 0-4;
     # warming runs are deliberately NOT recorded into the EMAs — they
-    # carry compile time in production). Request 0 (ordinals 5-7, each
+    # carry compile time in production). Request 0 (ordinals 8-10 — the
+    # r17 warmup adds prepare_warm/advance/epilogue runs at 5-7 — each
     # slowed 40 fake-seconds) seeds the full-res prepare/segment EMAs;
     # the half-res programs stay instant.
     sess = make_session(tiny_params, tiny_cfg, clock=clk,
                         warmup_shapes=((H, W),), warmup_segmented=True,
                         plan=ServeFaultPlan(
-                            slow_forwards={5: 40.0, 6: 40.0, 7: 40.0}))
+                            slow_forwards={8: 40.0, 9: 40.0, 10: 40.0}))
     seed = sess.infer(*pair, budget_s=1e6)   # seeds prep=40, seg=40
     assert seed.quality == "full"
     res = sess.infer(*pair, budget_s=20.0)

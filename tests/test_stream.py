@@ -1,0 +1,491 @@
+"""graftstream battery: warm-start parity pins, honest converged labels,
+session-table bounds under churn, TTL expiry, bounce re-admission with
+the held flow, and the knob resolution contract.
+
+Everything runs on CPU with the tiny model; FakeClock drives TTL math
+deterministically.  The batched service fixture is module-scoped (the
+program cache is the point of the session).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_stereo_tpu.config import RAFTStereoConfig
+from raft_stereo_tpu.faults import FakeClock
+from raft_stereo_tpu.models import (init_raft_stereo, raft_stereo_epilogue,
+                                    raft_stereo_forward,
+                                    raft_stereo_prepare,
+                                    raft_stereo_segment_carry)
+from raft_stereo_tpu.serve import (BatchScheduler, InferenceSession,
+                                   ServiceConfig, SessionConfig,
+                                   StereoService, StreamRunner)
+from raft_stereo_tpu.serve.stream import (StreamManager,
+                                          resolve_converge_tol,
+                                          resolve_stream_sessions,
+                                          resolve_stream_ttl_ms)
+from raft_stereo_tpu.serve.validate import AdmissionConfig, validate_pair
+
+pytestmark = pytest.mark.stream
+
+TINY = dict(n_gru_layers=1, hidden_dims=(32, 32, 32),
+            corr_levels=2, corr_radius=2)
+H, W = 40, 60  # not multiples of 32: padding really engages
+
+
+@pytest.fixture(scope="module")
+def tiny_cfg():
+    return RAFTStereoConfig(**TINY)
+
+
+@pytest.fixture(scope="module")
+def tiny_params(tiny_cfg):
+    return init_raft_stereo(jax.random.PRNGKey(0), tiny_cfg)
+
+
+@pytest.fixture(scope="module")
+def pair():
+    rng = np.random.default_rng(11)
+    return (rng.uniform(0, 255, (1, H, W, 3)).astype(np.float32),
+            rng.uniform(0, 255, (1, H, W, 3)).astype(np.float32))
+
+
+def canonical(pair):
+    return validate_pair(pair[0], pair[1], AdmissionConfig())
+
+
+def padded(pair):
+    """Model-level tests need bucket-padded shapes (the raw 40x60 is not
+    divisible by the 1/8 downsample) — the same padding serving applies."""
+    from raft_stereo_tpu.ops.padder import InputPadder
+    i1, i2 = canonical(pair)
+    p = InputPadder(i1.shape, divis_by=32, bucket=32)
+    return p.pad_np(i1, i2)
+
+
+@pytest.fixture(scope="module")
+def bsvc(tiny_params, tiny_cfg):
+    """Shared batched service (programs accumulate across tests)."""
+    session = InferenceSession(
+        tiny_params, tiny_cfg,
+        SessionConfig(valid_iters=4, segments=2, max_batch=4,
+                      canary=False),
+        clock=FakeClock())
+    svc = StereoService(session, ServiceConfig(max_queue=16)).start()
+    yield svc
+    svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# Model seam: the prepare_warm program and its parity contract.
+# ---------------------------------------------------------------------------
+
+
+def test_prepare_warm_zero_flow_is_bitwise_cold_prepare(tiny_params,
+                                                        tiny_cfg, pair):
+    """The ISSUE 13 warm-start parity pin: prepare_warm with an all-zero
+    flow_init computes coords0 + 0.0 — bit-identical to the cold prepare
+    (every other carry leaf never sees the flow operand)."""
+    from raft_stereo_tpu.serve.session import build_program
+    i1, i2 = padded(pair)
+    cold = jax.jit(build_program("prepare", tiny_cfg, 0))(
+        tiny_params, i1, i2)[0]
+    f = tiny_cfg.downsample_factor
+    zeros = np.zeros((1, i1.shape[1] // f, i1.shape[2] // f, 1),
+                     np.float32)
+    warm = jax.jit(build_program("prepare_warm", tiny_cfg, 0))(
+        tiny_params, i1, i2, zeros)[0]
+    flat_c, tree_c = jax.tree_util.tree_flatten(cold)
+    flat_w, tree_w = jax.tree_util.tree_flatten(warm)
+    assert tree_c == tree_w
+    for a, b in zip(flat_c, flat_w):
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+
+
+def test_warm_chain_matches_forward_with_flow_init(tiny_params, tiny_cfg,
+                                                   pair):
+    """prepare_warm(flow) + advance chain + epilogue reproduces the
+    reference test-mode forward with the same flow_init.  Bit-identical
+    HERE because (a) the x-only seed keeps flow_y == 0, and (b) at tiny
+    CPU shapes the fused motion encoder is disengaged on both paths, so
+    fuse_motion=True (warm advance) vs False (reference flow_init
+    forward) selects the same apply_motion_encoder ops.  On-chip at
+    kernel-engaging shapes the warm path keeps the FUSED motion encoder
+    (legal: the y==0 invariant holds by construction) while the
+    reference forward disables it — there the comparison is canary-band,
+    not bitwise (DESIGN.md r17 documents this)."""
+    from raft_stereo_tpu.serve.session import build_program
+    i1, i2 = padded(pair)
+    f = tiny_cfg.downsample_factor
+    h8, w8 = i1.shape[1] // f, i1.shape[2] // f
+    rng = np.random.default_rng(5)
+    flow_x = rng.uniform(-1.5, 1.5, (1, h8, w8, 1)).astype(np.float32)
+    flow_full = jnp.concatenate(
+        [jnp.asarray(flow_x), jnp.zeros((1, h8, w8, 1), jnp.float32)],
+        axis=-1)
+
+    def ref(p, a, b):
+        return raft_stereo_forward(p, tiny_cfg, a, b, iters=4,
+                                   flow_init=flow_full, test_mode=True)
+    _, up_ref = jax.jit(ref)(tiny_params, i1, i2)
+
+    (state,) = jax.jit(build_program("prepare_warm", tiny_cfg, 0))(
+        tiny_params, i1, i2, flow_x)
+    for _ in range(2):
+        state, _, _ = jax.jit(
+            build_program("advance", tiny_cfg, 2))(tiny_params, state)
+    up, _flow_low = jax.jit(build_program("epilogue", tiny_cfg, 0))(
+        tiny_params, state)
+    assert np.asarray(up).tobytes() == np.asarray(up_ref).tobytes()
+
+
+def test_advance_dnorm_is_segment_mean_delta(tiny_params, tiny_cfg, pair):
+    """The convergence monitor's definition is pinned: dnorm ==
+    mean |coords1_out - coords1_in|_x / iters, per row."""
+    i1, i2 = padded(pair)
+    state = jax.jit(lambda p, a, b: raft_stereo_prepare(
+        p, tiny_cfg, a, b))(tiny_params, i1, i2)
+    new_state, dnorm = jax.jit(
+        lambda p, s: raft_stereo_segment_carry(p, tiny_cfg, s, iters=2))(
+        tiny_params, state)
+    expect = np.abs(np.asarray(new_state["coords1"])
+                    - np.asarray(state["coords1"]))[..., 0].mean() / 2
+    assert np.asarray(dnorm)[0] == pytest.approx(expect, rel=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Serving: first-frame parity, honest labels, deck/usage/counter joins.
+# ---------------------------------------------------------------------------
+
+
+def test_stream_first_frame_bitwise_stateless_and_warm_converges(bsvc,
+                                                                 pair):
+    session = bsvc.session
+    l, r = pair
+    f1 = bsvc.submit({"id": "f1", "left": l, "right": r,
+                      "stream": "cam-a"}).result(timeout=300)
+    ref = bsvc.submit({"id": "ref", "left": l,
+                       "right": r}).result(timeout=300)
+    assert f1["status"] == ref["status"] == "ok"
+    assert f1["quality"] == "full"
+    assert f1["disparity"].tobytes() == ref["disparity"].tobytes()
+
+    # Frame 2 warm-starts (same padded bucket) and, with an absurdly
+    # loose tolerance, exits at the FIRST segment boundary with the
+    # honest converged:k label — k == iterations actually run.
+    f2 = bsvc.submit({"id": "f2", "left": l, "right": r,
+                      "stream": "cam-a",
+                      "converge_tol": 1e9}).result(timeout=300)
+    assert f2["status"] == "ok"
+    assert f2["quality"] == "converged:2" and f2["iters"] == 2
+
+    st = bsvc.status()["stream"]
+    assert st["warm_joins"] >= 1 and st["converged_exits"] >= 1
+
+    # The PR 12 three-way surfaces extend to the new kind: the deck tick
+    # rows carry warm-join/converged counts, the program counters grew a
+    # prepare_warm series, and the usage rollup attributes the stream
+    # events to the tenant.  The Future resolves INSIDE the tick (before
+    # end_tick publishes the record), so poll briefly for the ring.
+    import time
+    for _ in range(500):
+        ticks = session.deck.snapshot()
+        if sum(t.get("warm_joins", 0) for t in ticks) >= 1 and \
+                sum(t.get("converged", 0) for t in ticks) >= 1:
+            break
+        time.sleep(0.01)
+    assert sum(t.get("warm_joins", 0) for t in ticks) >= 1
+    assert sum(t.get("converged", 0) for t in ticks) >= 1
+    kinds = {labels["kind"] for labels, _ in
+             bsvc.registry.series("raft_program_calls_total")}
+    assert "prepare_warm" in kinds
+    usage = session.usage.doc()
+    assert usage["by_tenant"]["default"]["stream"]["warm_joins"] >= 1
+    assert usage["by_tenant"]["default"]["stream"]["converged_exits"] >= 1
+
+    # Warm rows' trace spans carry the prepare_warm kind with a tick
+    # link (the span-timeline side of the reconciliation).
+    trace = None
+    for t in session.tracer.timelines():
+        if t.get("request_id") == "f2":
+            trace = t
+    assert trace is not None
+    warm_spans = [s for s in trace["spans"]
+                  if s["kind"] == "prepare_warm"]
+    assert warm_spans and \
+        warm_spans[0].get("attrs", {}).get("tick") is not None
+
+
+def test_sequential_stream_path_warm_join(tiny_params, tiny_cfg, pair):
+    session = InferenceSession(
+        tiny_params, tiny_cfg,
+        SessionConfig(valid_iters=4, segments=2, canary=False),
+        clock=FakeClock())
+    svc = StereoService(session, ServiceConfig(max_queue=4,
+                                               workers=1)).start()
+    try:
+        l, r = pair
+        f1 = svc.handle({"id": "f1", "left": l, "right": r,
+                         "stream": "cam-s"})
+        ref = svc.handle({"id": "ref", "left": l, "right": r})
+        assert f1["status"] == "ok"
+        assert f1["disparity"].tobytes() == ref["disparity"].tobytes()
+        f2 = svc.handle({"id": "f2", "left": l, "right": r,
+                         "stream": "cam-s", "converge_tol": 1e9})
+        assert f2["quality"] == "converged:2" and f2["iters"] == 2
+        st = svc.status()["stream"]
+        assert st["warm_joins"] == 1 and st["converged_exits"] == 1
+    finally:
+        svc.stop()
+    # Sessions die on stop.
+    assert svc.stream.status()["sessions"] == 0
+
+
+def test_stream_runner_first_frame_parity(tiny_params, tiny_cfg, pair):
+    """demo.py --video's first frame is bit-identical to the single-pair
+    path (the satellite pin)."""
+    session = InferenceSession(
+        tiny_params, tiny_cfg,
+        SessionConfig(valid_iters=4, segments=2, canary=False),
+        clock=FakeClock())
+    runner = StreamRunner(session)
+    l, r = pair
+    out = runner.infer(l, r)
+    ref = session.infer(l, r)
+    assert out.quality == "full"
+    assert out.disparity.tobytes() == ref.disparity.tobytes()
+    # Frame 2 warm-starts; with the tolerance forced loose it converges
+    # with the honest label.
+    runner.converge_tol = 1e9
+    out2 = runner.infer(l, r)
+    assert out2.quality == "converged:2" and out2.iters == 2
+    assert runner.warm_frames == 1
+
+
+# ---------------------------------------------------------------------------
+# Session table: bounds under churn, TTL, bounce re-admission.
+# ---------------------------------------------------------------------------
+
+
+def _admitted(manager, session, pair, tenant, sid):
+    left, right = pair
+    req = {"left": left, "right": right, "tenant": tenant, "stream": sid}
+    manager.admit(req)
+    return req
+
+
+def test_session_storm_cannot_grow_table_or_metrics(tiny_params, tiny_cfg,
+                                                    pair):
+    """The ISSUE 13 bounds pin: a 200-session storm past the cap leaves
+    the table at its cap and /metrics flat (mirror of the PR 10/12
+    tenant-label hygiene pins — stream counters are global or keyed by
+    the BOUNDED tenant label, never by session id)."""
+    session = InferenceSession(
+        tiny_params, tiny_cfg,
+        SessionConfig(valid_iters=4, segments=2, canary=False),
+        clock=FakeClock())
+    manager = StreamManager(session, max_sessions=8, per_tenant=4)
+    l, r = canonical(pair)
+    for i in range(20):
+        _admitted(manager, session, (l, r), f"t-{i % 3}", f"cam-{i}")
+    lines_before = session.registry.render_prometheus().count("\n")
+    for i in range(20, 200):
+        _admitted(manager, session, (l, r), f"t-{i % 3}", f"cam-{i}")
+    lines_after = session.registry.render_prometheus().count("\n")
+    st = manager.status()
+    assert st["sessions"] <= 8
+    assert all(v <= 4 for v in st["per_tenant"].values())
+    assert st["evicted"] >= 190
+    assert lines_after == lines_before, (
+        "session churn grew /metrics — a label leaked per session id")
+
+
+def test_per_tenant_cap_cannot_displace_other_tenants(tiny_params,
+                                                      tiny_cfg, pair):
+    session = InferenceSession(
+        tiny_params, tiny_cfg,
+        SessionConfig(valid_iters=4, segments=2, canary=False),
+        clock=FakeClock())
+    manager = StreamManager(session, max_sessions=16, per_tenant=2)
+    l, r = canonical(pair)
+    _admitted(manager, session, (l, r), "victim", "cam-0")
+    for i in range(50):  # one hostile tenant churning session names
+        _admitted(manager, session, (l, r), "hog", f"cam-{i}")
+    st = manager.status()
+    assert st["per_tenant"].get("hog", 0) <= 2
+    assert st["per_tenant"].get("victim") == 1, (
+        "a tenant at its own cap displaced another tenant's session")
+
+
+def test_ttl_expiry_and_midflight_deposit_drop(tiny_params, tiny_cfg,
+                                               pair):
+    clock = FakeClock()
+    session = InferenceSession(
+        tiny_params, tiny_cfg,
+        SessionConfig(valid_iters=4, segments=2, canary=False),
+        clock=clock)
+    manager = StreamManager(session, ttl_ms=1000.0)
+    l, r = canonical(pair)
+    req = _admitted(manager, session, (l, r), "t", "cam")
+    assert manager.status()["sessions"] == 1
+    # The frame is in flight when the TTL expires...
+    clock.sleep(2.0)
+    req["_stream_flow"] = np.zeros((1, 8, 8, 1), np.float32)
+    req["_stream_shape"] = (64, 64)
+    manager.deposit(req, {"status": "ok"})
+    st = manager.status()
+    # ...so the deposit lands as a counted drop, never a resurrection.
+    assert st["sessions"] == 0
+    assert st["expired"] == 1 and st["deposits_dropped"] == 1
+    # The next frame of that stream simply starts cold.
+    req2 = _admitted(manager, session, (l, r), "t", "cam")
+    assert req2.get("_flow_init") is None
+    assert manager.status()["sessions"] == 1
+
+
+def test_bounce_harvest_keeps_warm_seed(tiny_params, tiny_cfg, pair):
+    """The held flow_init rides the REQUEST dict, so a generation
+    bounce's harvest/re-admit cycle keeps the row warm: the re-admitted
+    row runs prepare_warm (counted), not a cold prepare."""
+    session = InferenceSession(
+        tiny_params, tiny_cfg,
+        SessionConfig(valid_iters=4, segments=2, max_batch=2,
+                      canary=False),
+        clock=FakeClock())
+    manager = StreamManager(session)
+    l, r = canonical(pair)
+    f = tiny_cfg.downsample_factor
+    padder = session.padder_for(l.shape)
+    ph, pw = padder.padded_shape
+    flow = np.zeros((1, ph // f, pw // f, 1), np.float32)
+
+    responses = []
+    sched = BatchScheduler(session,
+                           resolve=lambda rq, rs: responses.append(rs),
+                           stream=manager)
+    req = {"id": "warm", "left": l, "right": r, "_flow_init": flow,
+           "_converge_tol": 1e9, "_stream": ("t", "cam")}
+    sched.submit(req)
+    # Generation bounce before any tick ran: defunct + harvest.
+    sched.defunct = True
+    harvested = sched.harvest()
+    assert harvested == [req]
+    assert harvested[0].get("_flow_init") is not None, (
+        "harvest dropped the warm-start seed")
+
+    # Re-admission into a fresh generation stays warm.
+    sched2 = BatchScheduler(session,
+                            resolve=lambda rq, rs: responses.append(rs),
+                            stream=manager, generation=1)
+    sched2.submit(req)
+    before = int(session.registry.value("raft_stream_warm_joins_total"))
+    import time
+    for _ in range(2000):
+        if responses:
+            break
+        if not sched2.run_tick():
+            time.sleep(0.002)
+    assert responses and responses[0]["status"] == "ok"
+    assert responses[0]["quality"] == "converged:2"
+    after = int(session.registry.value("raft_stream_warm_joins_total"))
+    assert after == before + 1
+    sched2.shutdown()
+
+
+def test_mixed_cold_and_warm_joiners_share_one_batch(tiny_params,
+                                                     tiny_cfg, pair):
+    """Warm and cold rows prepare through different programs but advance
+    in ONE batch — and the warm row's result is byte-identical to the
+    same warm request served alone (batch-row independence extends to
+    the prepare_warm seam)."""
+    session = InferenceSession(
+        tiny_params, tiny_cfg,
+        SessionConfig(valid_iters=4, segments=2, max_batch=4,
+                      canary=False),
+        clock=FakeClock())
+    l, r = canonical(pair)
+    f = tiny_cfg.downsample_factor
+    ph, pw = session.padder_for(l.shape).padded_shape
+    rng = np.random.default_rng(9)
+    flow = rng.uniform(-1, 1, (1, ph // f, pw // f, 1)).astype(np.float32)
+
+    def run(requests):
+        out = {}
+        sched = BatchScheduler(
+            session, resolve=lambda rq, rs: out.__setitem__(rq["id"], rs))
+        for rq in requests:
+            sched.submit(rq)
+        # All joiners must land in ONE tick (same batch bucket both
+        # runs — cross-batch-size bitwise identity is canary-band on
+        # this container, within-bucket identity is the pinned claim).
+        import time
+        for bucket in sched._buckets.values():
+            for row in list(bucket.pending):
+                assert row.uploaded.wait(timeout=30)
+        for _ in range(4000):
+            if len(out) == len(requests):
+                break
+            if not sched.run_tick():
+                time.sleep(0.002)
+        sched.shutdown()
+        assert len(out) == len(requests)
+        return out
+
+    def warm_req():
+        return {"id": "w", "left": l, "right": r,
+                "_flow_init": flow.copy()}
+
+    def cold_req(i, rid=None):
+        return {"id": rid or f"c{i}", "left": l, "right": r}
+
+    # Both runs advance at batch bucket 4 (batch_bucket(3) ==
+    # batch_bucket(4) == 4) but with DIFFERENT batch compositions: the
+    # warm row's bytes must not depend on its batchmates.
+    a = run([warm_req(), cold_req(0), cold_req(1)])
+    b = run([warm_req(), cold_req(2), cold_req(3), cold_req(4)])
+    assert a["w"]["status"] == b["w"]["status"] == "ok"
+    assert a["w"]["disparity"].tobytes() == b["w"]["disparity"].tobytes()
+    # A cold row's bytes are independent of whether a warm row rode the
+    # batch next to it (same bucket, same live-row count).
+    c = run([cold_req(0), cold_req(5), cold_req(6)])
+    assert a["c0"]["disparity"].tobytes() == \
+        c["c0"]["disparity"].tobytes()
+    # The warm row genuinely warm-started: its result differs from the
+    # cold rows' (a zero-information warm start would be vacuous here).
+    assert a["w"]["disparity"].tobytes() != a["c0"]["disparity"].tobytes()
+
+
+# ---------------------------------------------------------------------------
+# Knob resolution contract.
+# ---------------------------------------------------------------------------
+
+
+def test_knob_resolution_named_errors(monkeypatch):
+    monkeypatch.setenv("RAFT_STREAM_SESSIONS", "nope")
+    with pytest.raises(ValueError, match="RAFT_STREAM_SESSIONS"):
+        resolve_stream_sessions()
+    monkeypatch.setenv("RAFT_STREAM_SESSIONS", "0")
+    with pytest.raises(ValueError, match="RAFT_STREAM_SESSIONS"):
+        resolve_stream_sessions()
+    monkeypatch.setenv("RAFT_STREAM_SESSIONS", "32")
+    assert resolve_stream_sessions() == 32
+    assert resolve_stream_sessions(4) == 4
+
+    monkeypatch.setenv("RAFT_STREAM_TTL_MS", "-5")
+    with pytest.raises(ValueError, match="RAFT_STREAM_TTL_MS"):
+        resolve_stream_ttl_ms()
+    monkeypatch.setenv("RAFT_STREAM_TTL_MS", "2500")
+    assert resolve_stream_ttl_ms() == 2500.0
+
+    monkeypatch.setenv("RAFT_CONVERGE_TOL", "junk")
+    with pytest.raises(ValueError, match="RAFT_CONVERGE_TOL"):
+        resolve_converge_tol()
+    monkeypatch.setenv("RAFT_CONVERGE_TOL", "-0.1")
+    with pytest.raises(ValueError, match="RAFT_CONVERGE_TOL"):
+        resolve_converge_tol()
+    monkeypatch.setenv("RAFT_CONVERGE_TOL", "0.25")
+    assert resolve_converge_tol() == 0.25
+    monkeypatch.delenv("RAFT_CONVERGE_TOL")
+    assert resolve_converge_tol() == 0.01

@@ -153,7 +153,8 @@ def test_headline_registry_structure():
     expected counts so the extraction itself can't rot."""
     reg = default_registry("headline")
     names = {e.name for e in reg.entries}
-    assert {"serve/full", "serve/prepare", "serve/segment", "serve/advance",
+    assert {"serve/full", "serve/prepare", "serve/prepare_warm",
+            "serve/segment", "serve/advance",
             "serve/epilogue", "eval/forward", "train/step"} <= names
     assert len(reg.ladder_variants) == 7  # untripped + 6 rungs
     from raft_stereo_tpu.serve.guard import DEFAULT_LADDER
