@@ -158,6 +158,35 @@ HOST_ENV_KNOBS: Tuple[str, ...] = (
     "RAFT_CONVERGE_TOL",    # convergence early-exit tolerance, px/iter
                             # at 1/8 res (serve/stream.py
                             # resolve_converge_tol, default 0.01)
+    # graftrecall knobs (DESIGN.md r18, serve/cache.py) — all four stay
+    # OUT of the program fingerprint for the stream-knob reason: they
+    # size/steer a HOST-side response store and never reach a trace.
+    # Staleness is handled the other way around — the cache folds the
+    # LIVE program fingerprint into every entry key, so a knob that DID
+    # change compiled programs (ENV_KNOBS, config) automatically
+    # invalidates every cached response without ever being part of
+    # these knobs' semantics:
+    # - RAFT_CACHE_BYTES / RAFT_CACHE_TTL_MS bound the host-RAM LRU
+    #   (RAFT_STREAM_SESSIONS-class table sizing; 0 bytes = disabled,
+    #   the library default — serve_stereo.py defaults it ON at 256 MiB,
+    #   the watchdog precedent);
+    # - RAFT_CACHE_NEAR_TOL is a HOST-side signature comparison whose
+    #   only effect is handing the existing prepare_warm program an
+    #   x-only seed operand — the RAFT_CONVERGE_TOL argument verbatim;
+    # - RAFT_CACHE_DIR is a telemetry-sink-class output path (spilled
+    #   entries), read once at cache construction.
+    "RAFT_CACHE_BYTES",     # response-cache host-RAM budget, bytes
+                            # (serve/cache.py resolve_cache_bytes,
+                            # 0 = disabled; CLI default 256 MiB)
+    "RAFT_CACHE_TTL_MS",    # response-cache entry TTL, ms
+                            # (serve/cache.py resolve_cache_ttl_ms,
+                            # default 10 min)
+    "RAFT_CACHE_NEAR_TOL",  # near-tier block-mean signature threshold,
+                            # gray levels; 0 = near tier off
+                            # (serve/cache.py resolve_cache_near_tol)
+    "RAFT_CACHE_DIR",       # optional disk-spill directory for evicted
+                            # exact-tier entries (serve/cache.py
+                            # resolve_cache_dir, read at construction)
 )
 
 

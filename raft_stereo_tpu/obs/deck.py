@@ -103,6 +103,10 @@ class TickRecord:
     warm_joins: int = 0            # joins seeded via prepare_warm
     exits: int = 0
     converged: int = 0             # exits via the convergence monitor
+    cache_hits: int = 0            # CUMULATIVE response-cache hits
+    #                                (exact + near) at tick start — diff
+    #                                two rows for the hit rate over a
+    #                                window (graftrecall, serve/cache.py)
     pad_rows: int = 0
     iters: int = 0                 # refinement iters this tick advanced
     program: Optional[str] = None  # advance program's ledger id
@@ -353,6 +357,18 @@ def report(doc: Dict, out=None) -> Dict:
     if not waste:
         print("  (no advancing ticks recorded)", file=out)
 
+    # Response-cache hit rate over the ring window (graftrecall):
+    # cache_hits is cumulative at tick start, so last - first is the
+    # hits served while these ticks ran.
+    ch = [int(t.get("cache_hits", 0)) for t in ticks]
+    cache_window = (ch[-1] - ch[0]) if len(ch) >= 2 else 0
+    if any(ch):
+        served = sum(t.get("exits", 0) for t in ticks)
+        print(f"response-cache hits over the ring window: {cache_window} "
+              f"(vs {served} computed exits"
+              + (f", hit frac {cache_window / (cache_window + served):.1%}"
+                 if cache_window + served else "") + ")", file=out)
+
     # Idle-gap analysis: host time between one tick's end and the next
     # tick's start — the is-the-chip-starved number.
     gaps: List[float] = []
@@ -376,6 +392,7 @@ def report(doc: Dict, out=None) -> Dict:
             "occupancy_mean": occ_mean,
             "pad_waste": {b: (p / r if r else 0.0)
                           for b, (p, r) in waste.items()},
+            "cache_hits_window": cache_window,
             "idle_gaps": {"n": len(gaps), "total_s": sum(gaps),
                           "busy_s": busy}}
 

@@ -74,7 +74,8 @@ class _TenantRow:
     mutated only under the accountant's lock."""
 
     __slots__ = ("device_ns", "flops", "bytes_in", "bytes_out", "outcomes",
-                 "warm_joins", "converged")
+                 "warm_joins", "converged", "cache_hits",
+                 "cache_near_hits", "cache_misses")
 
     def __init__(self):
         self.device_ns = 0
@@ -88,6 +89,12 @@ class _TenantRow:
         # speedup.
         self.warm_joins = 0
         self.converged = 0
+        # graftrecall (serve/cache.py): exact hits, near-tier warm
+        # seeds and misses — the /debug/usage view of who is actually
+        # getting the zero-device-seconds win.
+        self.cache_hits = 0
+        self.cache_near_hits = 0
+        self.cache_misses = 0
 
 
 class UsageAccountant:
@@ -200,6 +207,38 @@ class UsageAccountant:
                 "raft_tenant_stream_converged_total",
                 "convergence early exits by tenant", tenant=label).inc()
 
+    def note_cache(self, label: str, exact: bool = False,
+                   near: bool = False, miss: bool = False) -> None:
+        """graftrecall accounting (serve/cache.py): one exact hit, one
+        near-tier warm seed, or one miss for this tenant.  Counted where
+        the cache decision actually lands (ResponseCache.admit) — the
+        per-tenant twin of the global ``raft_cache_*`` counters, so
+        /debug/usage can answer "who is getting the cache win"."""
+        if not (exact or near or miss):
+            return
+        with self._lock:
+            row = self._row(label)
+            if exact:
+                row.cache_hits += 1
+            if near:
+                row.cache_near_hits += 1
+            if miss:
+                row.cache_misses += 1
+        if exact:
+            self.registry.counter(
+                "raft_tenant_cache_hits_total",
+                "exact-tier response-cache hits by tenant",
+                tenant=label).inc()
+        if near:
+            self.registry.counter(
+                "raft_tenant_cache_near_hits_total",
+                "near-tier warm-start seeds by tenant",
+                tenant=label).inc()
+        if miss:
+            self.registry.counter(
+                "raft_tenant_cache_misses_total",
+                "response-cache misses by tenant", tenant=label).inc()
+
     def add_bytes(self, label: str, n_in: int = 0, n_out: int = 0) -> None:
         """Wire bytes for one request (the ingress accounts these; the
         in-process paths have no wire bytes and account nothing)."""
@@ -238,6 +277,9 @@ class UsageAccountant:
                 "requests": dict(sorted(r.outcomes.items())),
                 "stream": {"warm_joins": r.warm_joins,
                            "converged_exits": r.converged},
+                "cache": {"hits": r.cache_hits,
+                          "near_hits": r.cache_near_hits,
+                          "misses": r.cache_misses},
             } for label, r in self._rows.items()}
             total_ns = self._device_ns_total
             flops_total = self._flops_total

@@ -19,6 +19,14 @@ Layers, bottom up:
                   1/8-res disparities that warm-start consecutive
                   frames through the ``prepare_warm`` program, plus the
                   convergence early exit (``converged:k`` labels);
+- ``cache``     — graftrecall: two-tier content-addressed response
+                  cache — an exact tier (sha256 of the padded pair +
+                  program fingerprint + tier + tenant → the stored
+                  response, bit-identical, zero device seconds,
+                  ``cache:exact``) and a near tier (block-mean
+                  perceptual signature → warm-start seed through
+                  ``prepare_warm``, ``warm:cache:k``), byte-bounded
+                  with per-tenant sub-caps and TTL;
 - ``service``   — bounded queue, backpressure, per-request deadlines,
                   /healthz status;
 - ``supervise`` — graftguard: hang watchdogs over every device
@@ -42,6 +50,10 @@ from raft_stereo_tpu.serve.guard import (  # noqa: F401
     DEFAULT_LADDER,
     FastPath,
     KernelCircuitBreaker,
+)
+from raft_stereo_tpu.serve.cache import (  # noqa: F401
+    CacheEntry,
+    ResponseCache,
 )
 from raft_stereo_tpu.serve.scheduler import (  # noqa: F401
     BatchScheduler,

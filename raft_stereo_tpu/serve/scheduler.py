@@ -70,7 +70,7 @@ class _Row:
     __slots__ = ("request", "padder", "orig_h", "orig_w", "deadline",
                  "iters_done", "t_start", "dev_pair", "upload_error",
                  "uploaded", "tenant_label", "flow_init", "dev_flow",
-                 "converge_tol", "converged")
+                 "converge_tol", "converged", "cache_warm")
 
     def __init__(self, request, padder, deadline, t_start,
                  tenant_label: str = "default"):
@@ -97,6 +97,10 @@ class _Row:
         self.dev_flow = None
         self.converge_tol = request.get("_converge_tol")
         self.converged = False
+        # graftrecall (serve/cache.py): a near-tier seed rides the SAME
+        # warm-start machinery as a stream frame, but is labeled
+        # ``warm:cache:k`` and must not count in the STREAM metrics.
+        self.cache_warm = bool(request.get("_cache_warm"))
 
     @property
     def trace(self):
@@ -247,7 +251,7 @@ class BatchScheduler:
     def __init__(self, session: InferenceSession, *,
                  resolve: Optional[Callable[[Dict, Dict], None]] = None,
                  retry: Optional[Callable[[Dict, Dict], bool]] = None,
-                 generation: int = 0, stream=None):
+                 generation: int = 0, stream=None, cache=None):
         if session.cfg.max_batch < 2:
             raise ValueError("BatchScheduler needs SessionConfig.max_batch "
                              ">= 2; use the sequential worker path at 1")
@@ -274,6 +278,11 @@ class BatchScheduler:
         # (this tick loop); tests driving the scheduler directly may
         # leave it None.
         self.stream = stream
+        # graftrecall (serve/cache.py ResponseCache): exact hits never
+        # reach this scheduler at all — the only couplings here are the
+        # cumulative hit column stamped on each deck tick row and the
+        # warm:cache labeling of near-seeded rows.
+        self.cache = cache
         self.uploader = _Uploader(session.clock, faults=session.faults)
         self._buckets: Dict[Tuple[int, int], _Bucket] = {}
         self._rr: List[Tuple[int, int]] = []   # round-robin bucket order
@@ -365,6 +374,10 @@ class BatchScheduler:
             bucket=f"{bucket.key[0]}x{bucket.key[1]}",
             generation=self.generation,
             queue_depth=sum(len(b.pending) for b in self._bucket_list()))
+        if self.cache is not None:
+            # Cumulative hit count at tick start: diffing two deck rows
+            # gives the hit rate over that window (obs/deck.py report).
+            tick.cache_hits = self.cache.hits_cumulative
         t0 = time.perf_counter()
         try:
             self._tick_bucket(bucket, tick)
@@ -499,7 +512,12 @@ class BatchScheduler:
                 states.append(state_g)
             if self.stream is not None:
                 for r in warm:
-                    self.stream.note_warm_join(r.tenant_label)
+                    # Cache-seeded rows ride the same prepare_warm
+                    # device call but are NOT stream frames: their hit
+                    # was counted by ResponseCache.admit — counting
+                    # them here would inflate the stream metrics.
+                    if not r.cache_warm:
+                        self.stream.note_warm_join(r.tenant_label)
             state_j = (states[0] if len(states) == 1
                        else stack_refinement_states(states))
             if bucket.carry is None:
@@ -578,15 +596,20 @@ class BatchScheduler:
                 exits.append(i)
             elif row.converge_tol is not None and \
                     float(dnorm[i]) < row.converge_tol:
-                # Honest label: converged:k with k == iterations this
-                # row ACTUALLY ran (stamped by _finish off iters_done).
+                # Honest label: converged:k — or warm:cache:k for a
+                # near-tier cache seed (graftrecall) — with k ==
+                # iterations this row ACTUALLY ran (stamped by _finish
+                # off iters_done).
                 row.converged = True
                 row.trace.event(
-                    "converged", label=f"converged:{row.iters_done}",
+                    "converged",
+                    label=(f"warm:cache:{row.iters_done}"
+                           if row.cache_warm
+                           else f"converged:{row.iters_done}"),
                     norm=float(dnorm[i]), tol=row.converge_tol)
                 exits.append(i)
                 n_converged += 1
-                if self.stream is not None:
+                if self.stream is not None and not row.cache_warm:
                     self.stream.note_converged(row.tenant_label)
             elif row.deadline is not None and (
                     now >= row.deadline
@@ -625,6 +648,16 @@ class BatchScheduler:
                 rows[i].request["_stream_flow"] = \
                     np.array(flow_low[j:j + 1], dtype=np.float32)
                 rows[i].request["_stream_shape"] = bucket.key
+            # graftrecall: with the NEAR tier armed, every exit also
+            # carries its low-res flow for the response cache's deposit
+            # (future near-duplicates seed from it).  Already fetched
+            # by the batched epilogue, but the row copy is skipped
+            # entirely when no tier would consume it — a disabled
+            # cache stays zero-cost on this path.
+            if self.cache is not None and self.cache.wants_flow:
+                rows[i].request["_cache_flow"] = \
+                    np.array(flow_low[j:j + 1], dtype=np.float32)
+                rows[i].request["_cache_shape"] = bucket.key
             self._finish(rows[i], flow_up[j:j + 1], now)
         self._m_exits.inc(len(exits))
         tick.exits = len(exits)
@@ -691,7 +724,11 @@ class BatchScheduler:
         if row.iters_done >= session.cfg.valid_iters:
             quality = "full"
         elif row.converged:
-            quality = f"converged:{row.iters_done}"
+            # Near-tier cache seeds label their convergence honestly as
+            # warm:cache:k (graftrecall) — k is still the iterations
+            # this row actually ran, same contract as converged:k.
+            quality = (f"warm:cache:{row.iters_done}" if row.cache_warm
+                       else f"converged:{row.iters_done}")
         else:
             quality = f"reduced_iters:{row.iters_done}"
         if flow.shape != (row.orig_h, row.orig_w):

@@ -127,6 +127,25 @@ class ServiceConfig:
     # frames (px/iter segment-mean |delta_x| at 1/8 res; 0 disables).
     # None -> RAFT_CONVERGE_TOL -> 0.01.
     converge_tol: Optional[float] = None
+    # graftrecall (serve/cache.py). All four resolve at construction:
+    # explicit value > env knob > default — a host-side response store,
+    # never part of any program fingerprint (the fingerprint is folded
+    # INTO every cache key instead; analysis/knobs.py HOST_ENV_KNOBS).
+    #
+    # cache_bytes: host-RAM budget for the two-tier response cache.
+    # None -> RAFT_CACHE_BYTES -> 0 = disabled (the library default —
+    # the watchdog stance: test rigs and embedders opt in,
+    # serve_stereo.py defaults it ON at 256 MiB).
+    cache_bytes: Optional[int] = None
+    # cache_ttl_ms: entry expiry on the session clock.
+    # None -> RAFT_CACHE_TTL_MS -> 10 min.
+    cache_ttl_ms: Optional[float] = None
+    # cache_near_tol: near-tier block-mean signature threshold (gray
+    # levels; 0 = near tier off). None -> RAFT_CACHE_NEAR_TOL -> 0.
+    cache_near_tol: Optional[float] = None
+    # cache_dir: optional disk spill for evicted exact-tier entries.
+    # None -> RAFT_CACHE_DIR -> RAM only.
+    cache_dir: Optional[str] = None
 
 
 def _reject(code: str, message: str) -> Dict:
@@ -210,6 +229,20 @@ class StereoService:
             session, max_sessions=self.cfg.stream_sessions,
             ttl_ms=self.cfg.stream_ttl_ms,
             converge_tol=self.cfg.converge_tol)
+        # graftrecall (serve/cache.py): the two-tier content-addressed
+        # response cache.  Always constructed (zero state when
+        # disabled); admission consults it after validation, response
+        # resolution deposits into it BEFORE the Future resolves.  The
+        # stream's converge_tol is its warm-exit default so both
+        # warm-start flavors (stream seed, near-tier seed) exit by one
+        # rule.
+        from raft_stereo_tpu.serve.cache import ResponseCache
+        self.cache = ResponseCache(
+            session, max_bytes=self.cfg.cache_bytes,
+            ttl_ms=self.cfg.cache_ttl_ms,
+            near_tol=self.cfg.cache_near_tol,
+            cache_dir=self.cfg.cache_dir,
+            default_converge_tol=self.stream.converge_tol)
 
     # -- lifecycle --------------------------------------------------------
 
@@ -252,7 +285,8 @@ class StereoService:
                 self._scheduler = BatchScheduler(
                     self.session, resolve=self._resolve_scheduled,
                     retry=self._retry_scheduled,
-                    generation=self._generation, stream=self.stream)
+                    generation=self._generation, stream=self.stream,
+                    cache=self.cache)
                 self._heartbeat = Heartbeat("scheduler", self.session.clock)
                 sched, hb = self._scheduler, self._heartbeat
                 # Spawn + publish INSIDE the lock — the same invariant
@@ -318,6 +352,15 @@ class StereoService:
         dropped = self.stream.drop_all()
         if dropped:
             logger.info("dropped %d stream session(s) on stop", dropped)
+        # The response cache dies with the service too (graftrecall
+        # lifecycle): a restart serves cold — RAM entries must never
+        # outlive the generation that produced them (the optional
+        # RAFT_CACHE_DIR spill persists deliberately; its entries are
+        # fingerprint-keyed so a config-changed restart cannot read
+        # them).
+        dropped = self.cache.drop_all()
+        if dropped:
+            logger.info("dropped %d cached response(s) on stop", dropped)
         with self._lock:
             self._workers = [t for t in self._workers if t.is_alive()]
         # Zombie threads (generations retired by a bounce whose join
@@ -531,6 +574,31 @@ class StereoService:
                    warm=request.get("_flow_init") is not None)
         return None
 
+    def _serve_cache_hit(self, request: Dict, resp: Dict) -> Dict:
+        """Finalize one exact-tier cache hit (graftrecall): stream
+        deposit first (the entry's held low-res flow keeps a stream
+        session warm across a hit), then the normal resolution tail —
+        id, counters, trace.  ZERO device seconds by construction: no
+        invoke, no tick, no program counter, no usage nanosecond moves
+        (the PR 12 three-way reconciliation delta is exactly 0 —
+        test-pinned in tests/test_cache.py)."""
+        flow = request.pop("_cache_stream_flow", None)
+        if flow is not None:
+            request["_stream_flow"] = flow
+            request["_stream_shape"] = request.pop(
+                "_cache_stream_shape", None)
+        self.stream.deposit(request, resp)
+        if request.get("id") is not None:
+            resp["id"] = request["id"]
+        # Label-not-full keeps counting in `degraded`; the label
+        # distinguishes (the r17 converged:k stance — cache:exact IS the
+        # full-quality answer, the counter key is just mechanical).
+        if resp.get("quality") != "full":
+            self._count("degraded")
+        self._count_outcome(request, "ok")
+        self._finish_trace(request, resp)
+        return resp
+
     def _respond_once(self, request: Dict) -> Dict:
         """One serving attempt, synchronously, never raising — no
         counters, no trace finishing (the retry loop in ``_respond``
@@ -562,9 +630,17 @@ class StereoService:
                 # reported honestly instead of the stateless path's
                 # half_res route.  ROADMAP item 4's tier cascade is the
                 # planned principled home for cross-resolution demotion.
+                # graftrecall: with the near tier armed, EVERY
+                # sequential request runs the segmented composition so
+                # its 1/8-res flow is produced for the cache deposit
+                # (bit-identical to the full program by the PR 3/5
+                # composition pins; same no-half-res tradeoff as
+                # streams — DESIGN.md r18).
                 streaming = (request.get("_stream") is not None
                              or request.get("_flow_init") is not None
-                             or request.get("_converge_tol") is not None)
+                             or request.get("_converge_tol") is not None
+                             or self.cache.wants_flow)
+                cache_warm = bool(request.get("_cache_warm"))
                 with self.session.usage_riders([label]):
                     if streaming:
                         # graftstream sequential path: the segmented
@@ -582,16 +658,30 @@ class StereoService:
                             deadline=deadline, prevalidated=True,
                             trace=trace)
                         result = out.result
-                        if out.warm:
+                        if out.warm and not cache_warm:
                             # Counted where it happened (the warm
                             # prepare actually ran) — the scheduler's
-                            # accounting stance, mirrored.
+                            # accounting stance, mirrored.  Cache-seeded
+                            # rows were counted by ResponseCache.admit
+                            # and must not inflate the stream series.
                             self.stream.note_warm_join(label)
                         if request.get("_stream") is not None:
                             request["_stream_flow"] = out.flow_low
                             request["_stream_shape"] = out.padded_shape
+                        # Every computed response carries its low-res
+                        # flow for the cache deposit (the near tier
+                        # seeds future near-duplicates from it).
+                        request["_cache_flow"] = out.flow_low
+                        request["_cache_shape"] = out.padded_shape
                         if result.quality.startswith("converged:"):
-                            self.stream.note_converged(label)
+                            if cache_warm:
+                                # Honest near-tier label: the k is the
+                                # iterations actually run, same
+                                # contract as converged:k.
+                                result.quality = \
+                                    f"warm:cache:{result.iters}"
+                            else:
+                                self.stream.note_converged(label)
                     else:
                         result = self.session.infer(
                             request["left"], request["right"],
@@ -633,8 +723,12 @@ class StereoService:
         single resolution tail every sequential response goes through."""
         # Deposit the served frame's warm-start seed FIRST: a client
         # that receives this response and immediately sends the next
-        # frame must find the session warm.
+        # frame must find the session warm.  Same ordering for the
+        # response cache (graftrecall): a client that reads this
+        # response and resubmits the identical frame is guaranteed an
+        # exact hit.
         self.stream.deposit(request, resp)
+        self.cache.deposit(request, resp)
         if request.get("id") is not None:
             resp["id"] = request["id"]
         retries = request.get("_retries", 0)
@@ -801,6 +895,9 @@ class StereoService:
             self._count_outcome(request, f'rejected:{rejection["code"]}')
             self._finish_trace(request, rejection)
             return rejection
+        hit = self.cache.admit(request)
+        if hit is not None:
+            return self._serve_cache_hit(request, hit)
         return self._respond(request)
 
     def submit(self, request: Dict) -> Future:
@@ -818,6 +915,22 @@ class StereoService:
             rejection = self._draining_rejection()
         else:
             rejection = self._admit(request)
+        if rejection is None:
+            with self._lock:
+                live = self._started
+            # graftrecall: an exact cache hit resolves the Future RIGHT
+            # HERE — it never occupies a queue slot, never joins a
+            # batch, never counts toward _outstanding (nothing is in
+            # flight; the response is already in hand at zero device
+            # seconds).  Only while the service is RUNNING: submit()'s
+            # lifecycle contract is not_running otherwise, and a
+            # stopped service with a warm RAFT_CACHE_DIR must not keep
+            # answering from the grave (the started re-check under the
+            # enqueue lock below stays authoritative for the queue).
+            hit = self.cache.admit(request) if live else None
+            if hit is not None:
+                fut.set_result(self._serve_cache_hit(request, hit))
+                return fut
         if rejection is None:
             # started-check + enqueue under the lifecycle lock: stop()
             # flips _started under the same lock before draining, so a
@@ -876,8 +989,11 @@ class StereoService:
         self._mark_resolved()
         # Deposit the warm-start seed BEFORE the Future resolves (same
         # ordering argument as the flight record below): a woken caller
-        # posting its next frame must find the session warm.
+        # posting its next frame must find the session warm — and a
+        # woken caller resubmitting the identical frame must find the
+        # response cache primed (graftrecall deposit-before-resolve).
         self.stream.deposit(request, resp)
+        self.cache.deposit(request, resp)
         retries = request.get("_retries", 0)
         if retries and "retries" not in resp:
             resp["retries"] = retries
@@ -1049,7 +1165,7 @@ class StereoService:
             self._scheduler = BatchScheduler(
                 self.session, resolve=self._resolve_scheduled,
                 retry=self._retry_scheduled, generation=gen,
-                stream=self.stream)
+                stream=self.stream, cache=self.cache)
             self._heartbeat = Heartbeat("scheduler", self.session.clock)
             sched, hb = self._scheduler, self._heartbeat
             # Spawn + publish the new generation's thread INSIDE the
@@ -1190,6 +1306,9 @@ class StereoService:
             # graftstream: the bounded session table + warm/converged
             # counters (serve/stream.py).
             "stream": self.stream.status(),
+            # graftrecall: the two-tier response cache — hit/miss/near
+            # counters, byte accounting, tier config (serve/cache.py).
+            "cache": self.cache.status(),
             "supervision": self.supervision_status(),
             # The operator-plane capacity block (obs/capacity.py):
             # per-bucket theoretical requests/s from the warmed EMAs,

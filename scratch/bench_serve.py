@@ -91,8 +91,12 @@ def main() -> None:
                           max_batch=mb,
                           warmup_shapes=((h, w),),
                           warmup_segmented=True))
+        # cache_bytes=0: this mode measures COMPUTED requests/s on a
+        # cycled pair set — the graftrecall cache would (correctly)
+        # short-circuit the repeats and measure the host's hash rate
+        # instead.  The repeat-traffic mode below measures the cache.
         service = StereoService(session, ServiceConfig(
-            max_queue=max(8, 2 * mb), workers=1))
+            max_queue=max(8, 2 * mb), workers=1, cache_bytes=0))
         # Closed-loop driver with an in-flight cap under the queue bound
         # (serve_stereo.py's drain-as-you-submit discipline): the bench
         # measures serving throughput, not the rejection rate of an
@@ -159,6 +163,83 @@ def main() -> None:
         out["predicted_rps"] = (round(cap["best_rps"], 4)
                                 if cap.get("best_rps") else None)
         return out
+
+    def run_repeat(mb: int, cache_bytes: int) -> dict:
+        """graftrecall (DESIGN.md r18): the repeat-traffic third — a
+        1/3 unique + 1/3 exact-duplicate + 1/3 near-duplicate mix
+        through the real batched service, phases drained so deposits
+        provably precede their repeats.  Run twice by the caller (cache
+        off / cache on) over the IDENTICAL request stream: the rps
+        ratio is the cache multiplier on repetitive traffic.  With the
+        cache armed, exact repeats must be byte-identical cache:exact
+        and near repeats must exit warm:cache:k with honest k."""
+        session = InferenceSession(
+            params, cfg,
+            SessionConfig(valid_iters=iters, segments=segments,
+                          max_batch=mb,
+                          warmup_shapes=((h, w),),
+                          warmup_segmented=True))
+        service = StereoService(session, ServiceConfig(
+            max_queue=max(8, 2 * mb), workers=1,
+            cache_bytes=cache_bytes, cache_near_tol=6.0))
+        n_uniq = max(2, n_requests // 3)
+        rng2 = np.random.default_rng(7)
+        uniq = [(rng2.uniform(0, 255, (h, w, 3)).astype(np.float32),
+                 rng2.uniform(0, 255, (h, w, 3)).astype(np.float32))
+                for _ in range(n_uniq)]
+        near = [(np.clip(lq + rng2.normal(0, 2, lq.shape), 0, 255)
+                 .astype(np.float32), rq) for lq, rq in uniq]
+        from collections import deque
+        inflight_cap = max(2 * mb, 8)
+        with service:
+            t0 = time.perf_counter()
+
+            def phase(reqs):
+                pending: deque = deque()
+                out = []
+                for req in reqs:
+                    while len(pending) >= inflight_cap:
+                        out.append(pending.popleft().result(timeout=3600))
+                    pending.append(service.submit(req))
+                while pending:
+                    out.append(pending.popleft().result(timeout=3600))
+                return out
+
+            cold = phase([{"id": f"u{i}", "left": lq, "right": rq}
+                          for i, (lq, rq) in enumerate(uniq)])
+            dup = phase([{"id": f"d{i}", "left": lq, "right": rq}
+                         for i, (lq, rq) in enumerate(uniq)])
+            nearr = phase([{"id": f"n{i}", "left": lq, "right": rq,
+                            "converge_tol": 1e9}
+                           for i, (lq, rq) in enumerate(near)])
+            elapsed = time.perf_counter() - t0
+        responses = cold + dup + nearr
+        bad = [r for r in responses if r["status"] != "ok"]
+        if bad:
+            raise AssertionError(
+                f"repeat mode cache_bytes={cache_bytes}: {len(bad)} "
+                f"non-ok responses, first: {bad[0]}")
+        st = service.status()["cache"]
+        if cache_bytes:
+            cold_by_id = {r["id"]: r for r in cold}
+            for i, r in enumerate(dup):
+                assert r["quality"] == "cache:exact", (i, r["quality"])
+                ref = cold_by_id[f"u{r['id'][1:]}"]
+                assert r["disparity"].tobytes() == \
+                    ref["disparity"].tobytes(), (
+                    f"exact hit {r['id']} is not byte-identical to its "
+                    f"cold compute")
+            for r in nearr:
+                q = str(r["quality"])
+                assert q.startswith("warm:cache:"), (r["id"], q)
+                assert int(q.rsplit(":", 1)[1]) == r["iters"], (
+                    f"dishonest near-hit label {q} vs iters {r['iters']}")
+        n_total = len(responses)
+        return {"rps": n_total / elapsed, "elapsed_s": elapsed,
+                "n": n_total,
+                "hits": st["hits"], "near_hits": st["near_hits"],
+                "hit_ratio": ((st["hits"] + st["near_hits"]) / n_total
+                              if cache_bytes else 0.0)}
 
     def run_loopback(mb: int) -> dict:
         """The batched workload again, but over REAL loopback sockets
@@ -239,6 +320,13 @@ def main() -> None:
     seq = run_mode(1)
     bat = run_mode(max_batch)
     speedup = bat["rps"] / seq["rps"] if seq["rps"] else None
+    # graftrecall repeat-traffic third: the identical duplicate-heavy
+    # stream with the cache off, then on — the ratio is the requests/s
+    # multiplier repetitive traffic buys for zero device seconds.
+    rep_off = run_repeat(max_batch, cache_bytes=0)
+    rep_on = run_repeat(max_batch, cache_bytes=256 << 20)
+    cache_mult = (rep_on["rps"] / rep_off["rps"]
+                  if rep_off["rps"] else None)
     loopback = None
     if os.environ.get("RAFT_SERVE_BENCH_LOOPBACK", "0").strip().lower() \
             not in ("0", "false", "no", "off", ""):
@@ -259,6 +347,12 @@ def main() -> None:
         "pad_waste_ratio": bat.get("pad_waste_ratio"),
         "sat_ratio": bat.get("sat_ratio"),
         "predicted_rps": bat.get("predicted_rps"),
+        # graftrecall (DESIGN.md r18): the repeat-traffic mix.
+        "cache_hit_ratio": round(rep_on["hit_ratio"], 4),
+        "cache_rps_multiplier": (round(cache_mult, 4)
+                                 if cache_mult else None),
+        "cache_repeat_rps": round(rep_on["rps"], 4),
+        "nocache_repeat_rps": round(rep_off["rps"], 4),
         "backend": jax.default_backend(),
     }
     if loopback is not None:
@@ -282,7 +376,11 @@ def main() -> None:
                 "predicted_rps": doc["predicted_rps"],
                 "sat_ratio": doc["sat_ratio"],
                 "occupancy_mean": doc["occupancy_mean"],
-                "pad_waste_ratio": doc["pad_waste_ratio"]})
+                "pad_waste_ratio": doc["pad_waste_ratio"],
+                # graftrecall: the repeat-traffic cache numbers ride
+                # the same trajectory entry.
+                "cache_hit_ratio": doc["cache_hit_ratio"],
+                "cache_rps_multiplier": doc["cache_rps_multiplier"]})
     if loopback is not None:
         emit(doc["metric"].replace("serve_requests_per_s",
                                    "serve_loopback_requests_per_s"),

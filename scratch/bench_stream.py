@@ -211,6 +211,68 @@ def main() -> int:
     speedup = cold_mean / warm_mean if warm_mean else float("inf")
     ok = warm_mean * 2 <= cold_mean
 
+    # graftrecall near tier (ISSUE 14 acceptance): near-duplicate warm
+    # hits through the REAL ResponseCache — prime a corpus of cold
+    # full-quality entries, then serve PERTURBED duplicates through the
+    # real service admission path (near-tier signature match ->
+    # prepare_warm seed -> convergence exit, labeled warm:cache:k) and
+    # compare iterations-to-convergence against the same perturbed
+    # frames served cold at the SAME tolerance.  Iteration counts are
+    # backend-independent, so the >=2x bar gates on CPU.
+    from raft_stereo_tpu.serve import ServiceConfig, StereoService
+    from raft_stereo_tpu.serve.stream import stream_infer
+    from raft_stereo_tpu.serve.validate import validate_pair
+
+    NEAR_TOL = 6.0
+    svc = StereoService(session, ServiceConfig(
+        cache_bytes=64 << 20, cache_near_tol=NEAR_TOL,
+        converge_tol=tol))
+    cache = svc.cache
+    for left, right in frames:
+        lq, rq = validate_pair(left, right, session.cfg.admission)
+        req = {"left": lq, "right": rq}
+        cache.admit(req)
+        # Strip any near seed the admit stamped: the corpus must be
+        # COLD full-quality entries (deposit refuses warm-seeded ones).
+        req.pop("_flow_init", None)
+        req.pop("_cache_warm", None)
+        out = stream_infer(session, lq, rq, prevalidated=True)
+        req["_cache_flow"] = out.flow_low
+        req["_cache_shape"] = out.padded_shape
+        cache.deposit(req, {
+            "status": "ok", "quality": out.result.quality,
+            "disparity": out.result.disparity,
+            "iters": out.result.iters})
+    assert cache.status()["entries"] == n_frames, cache.status()
+
+    rng_q = np.random.default_rng(5)
+    queries = [(np.clip(left + rng_q.normal(0, 2, left.shape),
+                        0, 255).astype(np.float32), right)
+               for left, right in frames]
+    near_iters = []
+    near_quals = []
+    t0 = time.perf_counter()
+    for ln, rn in queries:
+        r = svc.handle({"left": ln, "right": rn, "converge_tol": tol})
+        assert r["status"] == "ok", r
+        near_iters.append(r["iters"])
+        near_quals.append(r["quality"])
+    near_s = time.perf_counter() - t0
+    for q, it in zip(near_quals, near_iters):
+        assert str(q).startswith("warm:cache:"), (
+            f"near query did not warm-start from the cache: {q}")
+        assert int(str(q).rsplit(":", 1)[1]) == it, (
+            f"dishonest near-hit label {q} vs iters {it}")
+    svc_off = StereoService(session, ServiceConfig(cache_bytes=0))
+    cold_q_iters = [svc_off.handle({"left": ln, "right": rn,
+                                    "converge_tol": tol})["iters"]
+                    for ln, rn in queries]
+    near_mean = float(np.mean(near_iters))
+    cold_q_mean = float(np.mean(cold_q_iters))
+    near_speedup = cold_q_mean / near_mean if near_mean else float("inf")
+    near_ok = near_mean * 2 <= cold_q_mean
+    ok = ok and near_ok
+
     doc = {
         "metric": "bench_stream",
         "pass": bool(ok),
@@ -226,6 +288,14 @@ def main() -> int:
         "iters_speedup": round(speedup, 3),
         "cold_fps": round(cold_fps, 3),
         "warm_fps": round(warm_fps, 3),
+        # graftrecall near tier (serve/cache.py): perturbed duplicates
+        # warm-started from the response cache vs the same frames cold.
+        "near_cache_iters": near_iters,
+        "near_cache_qualities": near_quals,
+        "near_cache_iters_mean": round(near_mean, 3),
+        "near_cache_cold_iters_mean": round(cold_q_mean, 3),
+        "near_cache_speedup": round(near_speedup, 3),
+        "near_cache_fps": round(len(queries) / near_s, 3),
         "backend": jax.default_backend(),
     }
     print(json.dumps(doc))
@@ -241,10 +311,16 @@ def main() -> int:
          source="scratch/bench_stream.py", extra=extra)
     emit("stream_cold_fps", cold_fps, "fps", backend=backend,
          source="scratch/bench_stream.py", extra=extra)
+    emit("cache_near_iters_mean", near_mean, "iters", backend=backend,
+         source="scratch/bench_stream.py",
+         extra={"tol": tol, "near_tol": NEAR_TOL,
+                "cold_iters_mean": doc["near_cache_cold_iters_mean"],
+                "near_speedup": doc["near_cache_speedup"]})
 
     if not ok:
         print(f"FAIL: warm frames averaged {warm_mean:.1f} iters vs "
-              f"cold {cold_mean:.1f} — less than the 2x bar",
+              f"cold {cold_mean:.1f} (near-cache {near_mean:.1f} vs "
+              f"{cold_q_mean:.1f}) — less than the 2x bar",
               file=sys.stderr)
         return 1
     return 0

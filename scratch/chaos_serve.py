@@ -300,6 +300,67 @@ def main() -> int:
     assert svc.stream.status()["sessions"] == 0, (
         "stream sessions survived the drain — held flows must die with "
         "the service generation")
+
+    # graftrecall deterministic scenarios (ISSUE 14, DESIGN.md r18),
+    # post-storm so their ordering is exact — the STORM itself runs with
+    # the cache off (the library default) so its fault ordinals stay
+    # byte-stable across PRs:
+    # (a) exact tier: a duplicate of a cold-served pair is answered
+    #     cache:exact and byte-identical;
+    # (b) near tier: a perturbed duplicate warm-starts from the stored
+    #     neighbor and exits warm:cache:k with an honest k;
+    # (c) churn: 200 tenants x 500 deposits against a small budget
+    #     cannot grow cache bytes past the cap or add /metrics lines.
+    from raft_stereo_tpu.serve import ServiceConfig as _SvcCfg
+    cache_session = InferenceSession(
+        params, cfg,
+        SessionConfig(valid_iters=4, segments=2, max_batch=4,
+                      batch_buckets=(1, 4), canary=False),
+        clock=FakeClock())
+    csvc = StereoService(cache_session, _SvcCfg(
+        max_queue=16, cache_bytes=32 << 20, cache_near_tol=6.0)).start()
+    cl, cr = pairs[0]
+    cold = csvc.submit({"id": "c-cold", "left": cl[None],
+                        "right": cr[None]}).result(timeout=30)
+    assert cold["status"] == "ok" and cold["quality"] == "full", cold
+    dup = csvc.submit({"id": "c-dup", "left": cl[None],
+                       "right": cr[None]}).result(timeout=30)
+    assert dup["quality"] == "cache:exact", dup
+    assert dup["disparity"].tobytes() == cold["disparity"].tobytes(), (
+        "exact cache hit is not byte-identical to its cold compute")
+    near_left = np.clip(cl + rng.normal(0, 2, cl.shape),
+                        0, 255).astype(np.float32)
+    near = csvc.submit({"id": "c-near", "left": near_left[None],
+                        "right": cr[None],
+                        "converge_tol": 1e9}).result(timeout=30)
+    assert str(near["quality"]).startswith("warm:cache:"), near
+    assert int(str(near["quality"]).rsplit(":", 1)[1]) == near["iters"], (
+        f"dishonest near-hit label {near['quality']} vs {near['iters']}")
+    ccache = csvc.cache
+    ccache.max_bytes = 256 << 10
+    ccache.per_tenant = 256 << 10
+    cache_session.usage.max_tenants = 4  # force the __other__ bound fast
+    churn_baseline = None
+    for i in range(500):
+        lj = cl[None] + np.float32(i % 251)
+        creq = {"left": lj, "right": cr[None],
+                "tenant": f"churn-{i % 200}"}
+        ccache.admit(creq)
+        ccache.deposit(creq, {
+            "status": "ok", "quality": "full",
+            "disparity": np.zeros((H, W), np.float32), "iters": 4})
+        assert ccache.status()["bytes"] <= ccache.max_bytes, (
+            "cache bytes grew past RAFT_CACHE_BYTES under churn")
+        if i == 30:
+            churn_baseline = len(csvc.metrics_text().splitlines())
+    churn_final = len(csvc.metrics_text().splitlines())
+    assert churn_final == churn_baseline, (
+        f"/metrics grew {churn_baseline} -> {churn_final} under "
+        f"200-tenant cache churn — a label leak")
+    cache_status = csvc.cache.status()
+    assert csvc.drain(), "cache-scenario service failed to drain"
+    assert csvc.cache.status()["entries"] == 0, (
+        "cache entries survived the drain")
     elapsed_real = time.monotonic() - t_real0
 
     # Invariant 1: every outcome is structured.
@@ -408,6 +469,9 @@ def main() -> int:
                       ("created", "evicted", "expired", "warm_joins",
                        "converged_exits", "deposits_dropped")},
                    "converged_responses": n_converged_resp},
+        "cache": {k: cache_status[k] for k in
+                  ("hits", "near_hits", "misses", "evictions",
+                   "deposits", "bytes")},
         "restarts": restarts,
         "watchdog_trips": {labels["kind"]: int(v) for labels, v in
                            reg.series("raft_watchdog_trips_total")},

@@ -81,21 +81,35 @@ def main() -> int:
         assert endpoint, ("no listening event from serve_stereo.py "
                           "(wedged startup killed at 600 s?)")
 
-        # Three real requests so ticks/usage/capacity have content —
-        # and, riding ONE X-Raft-Session, so the graftstream surfaces
-        # (warm joins, converged exits) light up through the live wire:
-        # frame 1 cold, frame 2 warm, frame 3 warm with a loose
-        # convergence tolerance so it exits converged:k.
+        # Four real requests so ticks/usage/capacity have content — the
+        # first three riding ONE X-Raft-Session so the graftstream
+        # surfaces (warm joins, converged exits) light up through the
+        # live wire: frame 1 cold, frames 2-3 PERTURBED (distinct bytes
+        # — the CLI arms the response cache by default, so identical
+        # bodies would exact-hit and never warm-join) with frame 3
+        # carrying a loose convergence tolerance so it exits
+        # converged:k.  Frame 4 then REPEATS frame 1's exact bytes and
+        # must be answered cache:exact at zero device seconds
+        # (graftrecall, DESIGN.md r18).
         rng = np.random.default_rng(0)
         left = rng.uniform(0, 255, (H, W, 3)).astype(np.uint8)
         right = rng.uniform(0, 255, (H, W, 3)).astype(np.uint8)
-        ct, body = wire.build_multipart(
-            {"left": wire.encode_image_png(left),
-             "right": wire.encode_image_png(right),
-             "id": b"gate-debug-0"})
+
+        def frame_body(fid, l_arr):
+            return wire.build_multipart(
+                {"left": wire.encode_image_png(l_arr),
+                 "right": wire.encode_image_png(right),
+                 "id": fid.encode()})
+
+        def perturbed(seed):
+            noise = np.random.default_rng(seed).integers(
+                -2, 3, left.shape)
+            return np.clip(left.astype(np.int16) + noise,
+                           0, 255).astype(np.uint8)
+
         from urllib.request import Request, urlopen
 
-        def post(extra_headers):
+        def post(ct, body, extra_headers):
             req = Request(
                 endpoint + "/v1/stereo", data=body, method="POST",
                 headers={"Content-Type": ct,
@@ -105,14 +119,26 @@ def main() -> int:
             with urlopen(req, timeout=300) as resp:
                 return wire.decode_response(resp.read())
 
-        served = post({})
+        ct, body1 = frame_body("gate-debug-0", left)
+        served = post(ct, body1, {})
         assert served["status"] == "ok", served
-        warm = post({})
+        ct2, body2 = frame_body("gate-debug-1", perturbed(1))
+        warm = post(ct2, body2, {})
         assert warm["status"] == "ok", warm
-        conv = post({"X-Raft-Converge-Tol": "1e9"})
+        ct3, body3 = frame_body("gate-debug-2", perturbed(2))
+        conv = post(ct3, body3, {"X-Raft-Converge-Tol": "1e9"})
         assert conv["status"] == "ok", conv
         assert str(conv["quality"]).startswith("converged:"), conv
         assert int(str(conv["quality"]).split(":")[1]) == conv["iters"]
+        # graftrecall exact tier through the live wire: identical bytes
+        # -> cache:exact, byte-identical disparity.
+        hit = post(ct, body1, {})
+        assert hit["status"] == "ok", hit
+        assert hit["quality"] == "cache:exact", hit["quality"]
+        assert hit["disparity"].tobytes() == \
+            served["disparity"].tobytes(), (
+            "cache:exact response is not byte-identical to the cold "
+            "serve")
 
         sizes = {}
         docs = {}
@@ -169,6 +195,19 @@ def main() -> int:
         assert health["stream"]["sessions"] >= 1, health["stream"]
         assert health["stream"]["warm_joins"] >= 2
 
+        # /healthz cache block + /debug/usage cache columns
+        # (graftrecall, DESIGN.md r18): the CLI arms the cache by
+        # default, and the exact repeat above must be visible as a hit
+        # on BOTH surfaces through the live wire.
+        cache_block = health["cache"]
+        assert cache_block["enabled"] is True, cache_block
+        assert cache_block["hits"] >= 1, cache_block
+        assert cache_block["entries"] >= 1, cache_block
+        assert cache_block["bytes"] > 0, cache_block
+        gate_cache = usage["by_tenant"]["gate-tenant"]["cache"]
+        assert gate_cache["hits"] >= 1, gate_cache
+        assert gate_cache["misses"] >= 1, gate_cache
+
         # /debug/stacks: bounded all-thread dump naming real threads.
         stacks = docs["/debug/stacks"]
         assert stacks["schema"] == 1 and stacks["threads"]
@@ -207,6 +246,9 @@ def main() -> int:
         "tenants": list(usage["by_tenant"]),
         "stream": {"warm_joins": gate_stream["warm_joins"],
                    "converged_exits": gate_stream["converged_exits"]},
+        "cache": {"hits": cache_block["hits"],
+                  "entries": cache_block["entries"],
+                  "tenant_hits": gate_cache["hits"]},
     }))
     return 0
 

@@ -151,6 +151,20 @@ env JAX_PLATFORMS=cpu python -m pytest tests/test_stream.py -q -m stream \
     -p no:cacheprovider -p no:xdist -p no:randomly \
     || { echo "FAIL: streaming battery"; fail=1; }
 
+# graftrecall battery (ISSUE 14, DESIGN.md r18): exact-hit bitwise
+# parity + the zero-device-seconds three-way reconciliation,
+# fingerprint-change invalidation, tenant isolation/sub-caps, TTL +
+# byte-cap accounting, near-tier warm:cache labels, the 200-tenant
+# churn-storm bound (bytes + /metrics provably flat), drain drop
+# semantics and the RAFT_CACHE_DIR disk spill.  The chaos soak above
+# runs the cache scenarios against the live service, the serve bench
+# below runs the repeat-traffic third, and check_debug_endpoints.py
+# asserts hit counters through the live CLI wire.
+step "response-cache battery (graftrecall: exact/near tiers, tenancy, bounds)"
+env JAX_PLATFORMS=cpu python -m pytest tests/test_cache.py -q -m cache \
+    -p no:cacheprovider -p no:xdist -p no:randomly \
+    || { echo "FAIL: response-cache battery"; fail=1; }
+
 backend=$(python - <<'EOF'
 import jax
 print(jax.default_backend())

@@ -141,6 +141,23 @@ def build_parser() -> argparse.ArgumentParser:
                         "on warm frames: segment-mean per-iteration "
                         "|delta_x| at 1/8 res, px (0 disables; default "
                         "RAFT_CONVERGE_TOL or 0.01)")
+    # graftrecall: content-addressed response cache (DESIGN.md r18).
+    # The CLI defaults the cache ON (the library default is off so test
+    # rigs and embedders opt in — the watchdog precedent).
+    parser.add_argument('--cache_bytes', type=int, default=None,
+                        help="host-RAM budget for the two-tier response "
+                        "cache: exact hits (sha256 of the padded pair + "
+                        "program fingerprint + tenant) serve the stored "
+                        "response bit-identically at zero device "
+                        "seconds, labeled cache:exact (0 disables; "
+                        "default RAFT_CACHE_BYTES or 256 MiB)")
+    parser.add_argument('--cache_near_tol', type=float, default=None,
+                        help="near-duplicate tier threshold (mean "
+                        "block-signature difference, gray levels): a "
+                        "close-enough stored scene seeds coords1 "
+                        "through prepare_warm and the response is "
+                        "labeled warm:cache:<iters> (0 disables; "
+                        "default RAFT_CACHE_NEAR_TOL or 0)")
     # graftwire: network ingress (DESIGN.md r14)
     parser.add_argument('--http_port', type=int, default=None,
                         help="serve POST /v1/stereo + GET /healthz "
@@ -164,6 +181,23 @@ def build_parser() -> argparse.ArgumentParser:
                         "ms/sample and caps the host path)")
     add_model_args(parser)
     return parser
+
+
+def _cli_cache_bytes(args):
+    """CLI default for the response cache: ON at 256 MiB (graftrecall,
+    DESIGN.md r18).  Precedence: --cache_bytes flag (0 disables) >
+    RAFT_CACHE_BYTES env (an explicit 0 there disables too) > 256 MiB.
+    The LIBRARY ServiceConfig default stays off — the watchdog stance:
+    the production CLI arms it, embedded rigs opt in."""
+    import os
+
+    from raft_stereo_tpu.serve.cache import (DEFAULT_CACHE_BYTES,
+                                             resolve_cache_bytes)
+    if args.cache_bytes is not None:
+        return args.cache_bytes
+    if os.environ.get("RAFT_CACHE_BYTES", "").strip():
+        return resolve_cache_bytes(None)
+    return DEFAULT_CACHE_BYTES
 
 
 def _parse_warmup(spec):
@@ -274,7 +308,9 @@ def serve(args) -> int:
         drain_grace_ms=args.drain_grace_ms,
         stream_sessions=args.stream_sessions,
         stream_ttl_ms=args.stream_ttl_ms,
-        converge_tol=args.converge_tol))
+        converge_tol=args.converge_tol,
+        cache_bytes=_cli_cache_bytes(args),
+        cache_near_tol=args.cache_near_tol))
 
     # Graceful drain on SIGTERM/SIGINT (ROADMAP open item 4): the handler
     # only sets a flag (async-signal-safe); the submit loop below flips
