@@ -370,6 +370,28 @@ def main() -> None:
     flops = row.flops_est
     mfu = (flops / dispatch_s / peaks[0]) if (flops and peaks) else None
 
+    # r19 correlation-DMA accounting: the per-iteration pyramid bytes the
+    # lookup's BlockSpecs declare, per packing mode — EXACT arithmetic
+    # over the pack plans (corr/pallas_reg.plan_dma_bytes), computable at
+    # any geometry without a compile. The int8/bf16 ratio is the
+    # acceptance number (<= 0.6 at headline); the driver's on-chip run
+    # corroborates it with the advance rows' compiler bytes_est.
+    def corr_dma(hh, ww):
+        from raft_stereo_tpu.corr.pallas_reg import (level_widths,
+                                                     plan_dma_bytes)
+        factor = cfg.downsample_factor
+        widths = level_widths(ww // factor, cfg.corr_levels)
+        npx = (hh // factor) * (ww // factor)
+        bf16_px = plan_dma_bytes(widths, True, False)
+        int8_px = plan_dma_bytes(widths, True, True)
+        return {"h": hh, "w": ww,
+                "bf16_bytes_per_iter": bf16_px * npx,
+                "int8_bytes_per_iter": int8_px * npx,
+                "int8_over_bf16": round(int8_px / bf16_px, 4)}
+
+    corr_dma_doc = {"bench": corr_dma(h, w),
+                    "headline": corr_dma(2016, 2976)}
+
     doc = {
         "metric": (f"middlebury_F_disparity_fps_per_chip_{iters}iters_"
                    f"{h}x{w}_{corr}_{'bf16' if mixed else 'fp32'}"
@@ -384,6 +406,8 @@ def main() -> None:
         "mfu": round(mfu, 4) if mfu else None,
         "peak_hbm_bytes": row.peak_hbm_bytes,
         "roofline": row.roofline(peaks),
+        "bytes": row.bytes_accessed,
+        "corr_dma": corr_dma_doc,
     }
     print(json.dumps(doc))
 
@@ -399,7 +423,9 @@ def main() -> None:
     emit(doc["metric"], fps, "frames/s",
          backend=jax.default_backend(), source="bench.py",
          extra={"mfu": doc["mfu"], "device_s": doc["device_s"],
-                "flops": flops, "bytes": row.bytes_accessed})
+                "flops": flops, "bytes": row.bytes_accessed,
+                "roofline": doc["roofline"],
+                "corr_dma": corr_dma_doc})
 
 
 if __name__ == "__main__":

@@ -30,6 +30,15 @@ ENV_KNOBS: Tuple[str, ...] = (
     "RAFT_PACKED_L2",          # packed layer2 bit-layout (models/extractor.py)
     "RAFT_CORR_TILE",          # corr gather tile size (corr/pallas_reg.py)
     "RAFT_BATCH_FUSE_PIXELS",  # batch-fusion threshold (ops/pallas_stream.py)
+    # r19 (graftresident) switches — all three shape traced programs:
+    "RAFT_FUSE_ITER",          # resident per-iteration mega-kernel
+                               # (ops/pallas_resident.py, default on)
+    "RAFT_CORR_PACK8",         # int8 quad-packed correlation containers
+                               # (corr/pallas_reg.py, default OFF —
+                               # canary-banded, not bit-identical)
+    "RAFT_STREAM_BATCH",       # B>1 engagement of the streamed scan-body
+                               # kernels (ops/pallas_stream.py, default on;
+                               # crossover from stream_batch_crossover)
 )
 
 # Serving-behavior env knobs (continuous batching, DESIGN.md r9). These are
@@ -214,7 +223,8 @@ KERNEL_ENTRY_POINTS = {
     "ops/pallas_encoder.py": KernelEntry(
         rungs=("fused_encoders", "stream_tail")),
     "ops/pallas_stream.py": KernelEntry(
-        rungs=("fuse_gru1632", "fused_update")),
-    "corr/pallas_reg.py": KernelEntry(rungs=("corr_kernel",)),
+        rungs=("fuse_gru1632", "fused_update", "stream_batch")),
+    "ops/pallas_resident.py": KernelEntry(rungs=("fuse_iter",)),
+    "corr/pallas_reg.py": KernelEntry(rungs=("corr_kernel", "corr_pack8")),
     "corr/pallas_alt.py": KernelEntry(rungs=("corr_kernel",)),
 }

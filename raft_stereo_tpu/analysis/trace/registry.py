@@ -13,7 +13,7 @@ Geometries:
   claims live — every kernel path engages, so GV102 can prove each
   breaker rung and each ENV_KNOBS entry actually changes the program.
 - ``small``: a fast shape for development loops. Kernel engagement
-  heuristics (the 200k-pixel ``_batch_worthwhile`` threshold) do NOT
+  heuristics (the ``stream_batch_crossover`` pixel threshold) do NOT
   clear at this size, so ladder/knob probes are headline-only — at small
   shapes several rungs are legitimately no-ops and GV102 would report
   false vacuity (``ladder_variants``/``knob_flips`` are empty here).
@@ -36,11 +36,18 @@ class KnobProbe:
     ``flip`` is a value different from the default; ``kind``/``batch``
     pick the serving program the knob engages on (most knobs bite the
     B=1 full forward; RAFT_BATCH_FUSE_PIXELS by construction only bites
-    batched programs — ``_batch_worthwhile`` short-circuits at B=1)."""
+    batched programs — ``_batch_worthwhile`` short-circuits at B=1).
+    ``env``: extra (key, value) pairs applied to BOTH the base and the
+    flipped trace — for a knob that only shapes programs when another
+    switch is in a given state (RAFT_CORR_TILE sizes the STANDALONE
+    lookup's grid, which the r19 resident path replaces in-kernel, so
+    its probe runs from a RAFT_FUSE_ITER=0 base; the knob still rides
+    every cache key because resident-off programs depend on it)."""
 
     flip: str
     kind: str = "full"
     batch: int = 1
+    env: Tuple[Tuple[str, str], ...] = ()
 
 
 #: Declared flip probe per registered env knob: a value provably different
@@ -53,14 +60,22 @@ KNOB_FLIP_PROBES: Dict[str, KnobProbe] = {
     "RAFT_FUSE_GRU1632": KnobProbe("0"),         # default on -> off
     "RAFT_FUSED_ENCODERS": KnobProbe("0"),       # default on -> off
     "RAFT_PACKED_L2": KnobProbe("0"),            # default on -> off
-    "RAFT_CORR_TILE": KnobProbe("1024"),         # 2048 -> half (new grid)
+    "RAFT_CORR_TILE": KnobProbe("1024",          # 2048 -> half (new grid)
+                                env=(("RAFT_FUSE_ITER", "0"),)),
     # The batch-fusion threshold is a no-op at B=1 (that is its spec:
     # _batch_worthwhile gives B=1 an unconditional pass) — probe it on the
     # continuous-batching advance program at b=2, where headline
-    # per-sample frames clear the 200k default and a never-fuse flip
+    # per-sample frames clear the crossover default and a never-fuse flip
     # provably de-fuses the kernels.
     "RAFT_BATCH_FUSE_PIXELS": KnobProbe("1000000000", kind="advance",
                                         batch=2),
+    # r19 switches: the resident mega-kernel and the int8 correlation
+    # containers both bite on the B=1 full forward at headline; the B>1
+    # stream engagement (like the crossover it replaces) is a no-op at
+    # B=1 by spec, so it probes on the batched advance program.
+    "RAFT_FUSE_ITER": KnobProbe("0"),            # default on -> off
+    "RAFT_CORR_PACK8": KnobProbe("1"),           # default OFF -> on
+    "RAFT_STREAM_BATCH": KnobProbe("0", kind="advance", batch=2),
 }
 
 GEOMETRIES: Dict[str, Dict[str, int]] = {
@@ -243,20 +258,41 @@ def default_registry(geometry: str = "headline") -> TraceRegistry:
         from raft_stereo_tpu.serve.guard import KernelCircuitBreaker
         breaker = KernelCircuitBreaker()
         names = [p.name for p in breaker.ladder]
-        ladder_variants.append(("untripped", entries[0]))
+        # The ladder walk traces a COMBINED program — the B=1 full
+        # forward AND the b=2 continuous-batching advance — because since
+        # r19 the ladder carries rungs that only bite on batched device
+        # calls (stream_batch: B=1 engagement is unconditional by spec)
+        # alongside rungs that only bite where encoders run (stream_tail
+        # etc.: the advance program has no encoder half). One combined
+        # jaxpr gives every rung a program text it provably changes, and
+        # GV102's pairwise comparison logic applies unchanged. The walk's
+        # base env additionally ARMS the opt-in corr_pack8 path
+        # (RAFT_CORR_PACK8=1): an opt-in rung can only be non-vacuous
+        # from an armed base — which is exactly the operational state the
+        # rung exists to degrade from.
+        ladder_base = resolve_env({"RAFT_CORR_PACK8": "1"}, base_env)
+
+        def ladder_build(run_cfg):
+            def build(run_cfg=run_cfg):
+                full_fn = build_program("full", run_cfg, g["iters"])
+                adv_fn = build_program("advance", run_cfg, g["seg_iters"])
+
+                def combined(p, i1, i2, state2):
+                    return full_fn(p, i1, i2), adv_fn(p, state2)
+                return combined, (params_spec(), img, img, state_spec(2))
+            return build
+
+        ladder_variants.append(("untripped", TraceEntry(
+            name="serve/full+advance@ladder:0:armed",
+            build=ladder_build(cfg_serve), env=dict(ladder_base),
+            hot_path="serve")))
         for k in range(1, len(names) + 1):
             run_cfg, env_over = breaker.apply(
                 cfg_serve, tripped=tuple(names[:k]))
-            env = resolve_env(env_over, base_env)
-
-            def build(run_cfg=run_cfg):
-                return (build_program("full", run_cfg, g["iters"]),
-                        (params_spec(), img, img))
+            env = resolve_env(env_over, ladder_base)
             ladder_variants.append((names[k - 1], TraceEntry(
-                name=f"serve/full@ladder:{k}:{names[k - 1]}",
-                build=build, env=env, hot_path="serve")))
-
-        base_key = config_fingerprint(cfg_serve, dict(base_env))
+                name=f"serve/full+advance@ladder:{k}:{names[k - 1]}",
+                build=ladder_build(run_cfg), env=env, hot_path="serve")))
 
         def probe_build(kind: str, batch: int):
             def build(kind=kind, batch=batch):
@@ -270,27 +306,30 @@ def default_registry(geometry: str = "headline") -> TraceRegistry:
                 return fn, (params_spec(), bimg, bimg)
             return build
 
-        probe_bases: Dict[Tuple[str, int], TraceEntry] = {
-            ("full", 1): entries[0]}
+        probe_bases: Dict[Tuple, TraceEntry] = {
+            ("full", 1, ()): entries[0]}
         for knob in ENV_KNOBS:
             probe = KNOB_FLIP_PROBES.get(knob)
             if probe is None:
                 knob_flips.append(KnobFlip(knob, None, entries[0], None))
                 continue
-            bk = (probe.kind, probe.batch)
+            bk = (probe.kind, probe.batch, probe.env)
+            base_probe_env = resolve_env(dict(probe.env), base_env)
             if bk not in probe_bases:
+                suffix = "".join(f"@{k}={v}" for k, v in probe.env)
                 probe_bases[bk] = TraceEntry(
-                    name=f"serve/{probe.kind}@b{probe.batch}",
-                    build=probe_build(*bk), env=dict(base_env),
-                    hot_path="serve")
-            env = resolve_env({knob: probe.flip}, base_env)
+                    name=f"serve/{probe.kind}@b{probe.batch}{suffix}",
+                    build=probe_build(probe.kind, probe.batch),
+                    env=dict(base_probe_env), hot_path="serve")
+            env = resolve_env({**dict(probe.env), knob: probe.flip},
+                              base_env)
             knob_flips.append(KnobFlip(
                 knob, probe.flip, probe_bases[bk],
                 TraceEntry(name=f"serve/{probe.kind}@b{probe.batch}"
                                 f"@knob:{knob}",
-                           build=probe_build(*bk), env=env,
-                           hot_path="serve"),
-                base_key=base_key,
+                           build=probe_build(probe.kind, probe.batch),
+                           env=env, hot_path="serve"),
+                base_key=config_fingerprint(cfg_serve, base_probe_env),
                 flipped_key=config_fingerprint(cfg_serve, env)))
 
     return TraceRegistry(geometry=geometry, entries=entries,

@@ -255,6 +255,177 @@ def gather_lerp_taps_packed_tail(vol, cl, radius: int, w2: int,
     return val[:, :k] * (1.0 - frac) + val[:, 1:k + 1] * frac
 
 
+def gather_lerp_taps_packed8(vi, cl, radius: int, w2: int, lane_base: int,
+                             scale):
+    """Quad-packed int8 gather for one level riding lanes ``[lane_base,
+    lane_base + pad_width(w2)/4)`` of the combined int8 container.
+
+    vi: (P, C) int32 view of the container (the caller bitcasts ONCE);
+    cl: (P, 1) fp32 level-scaled positions; scale: (P, 1) fp32 per-level
+    dequant scale (a per-level scalar broadcast onto the coords operand —
+    see ``make_reg_tpu_corr_fn``). Each 32-bit lane carries the four int8
+    taps at true positions (4j..4j+3), byte 0 = lowest position (XLA
+    bitcast semantics). The align walk is the packed gather's merged
+    select-scan with the level's static lane offset folded in; byte
+    extraction is two arithmetic shifts (sign-extending), selected per
+    lane by ``xpos & 3``. Out-of-range taps — including clipped reads
+    landing in another level's lanes — are zeroed by the true-width
+    bounds mask before the (linear) dequant+lerp, so zero-pad semantics
+    survive quantization exactly (symmetric scheme: q==0 <-> 0.0)."""
+    p, nlanes = vi.shape
+    k = 2 * radius + 1
+    lane = jax.lax.broadcasted_iota(jnp.int32, (p, LANE), 1)
+    i0 = jnp.floor(cl)
+    frac = cl - i0  # (P, 1)
+    base = i0.astype(jnp.int32) - radius  # first tap true position
+    xpos = base + lane  # true tap position for out lane t
+    al = lane_base + (xpos >> 2)  # absolute container lane (floor shift)
+    if nlanes > LANE:
+        nslab = nlanes // LANE
+        slab = jnp.clip((lane_base + (base >> 2)) // LANE, 0, nslab - 1)
+        win_a = vi[:, 0:LANE]
+        win_b = vi[:, LANE:2 * LANE]
+        for s in range(1, nslab):
+            sl = vi[:, s * LANE:(s + 1) * LANE]
+            win_a = jnp.where(slab == s, sl, win_a)
+            if s >= 2:
+                win_b = jnp.where(slab == s - 1, sl, win_b)
+        rel = al - slab * LANE
+        g_a = jnp.take_along_axis(win_a, jnp.clip(rel, 0, LANE - 1),
+                                  axis=-1)
+        g_b = jnp.take_along_axis(win_b, jnp.clip(rel - LANE, 0, LANE - 1),
+                                  axis=-1)
+        g = jnp.where(rel < LANE, g_a, g_b)
+    else:
+        g = jnp.take_along_axis(vi, jnp.clip(al, 0, LANE - 1), axis=-1)
+    # Sign-extending byte extract: tap byte b of lane g is (g << (3-b)*8)
+    # >> 24 with ARITHMETIC shifts (int32 in jax). b = xpos & 3 per lane.
+    b_ = xpos & 3
+    q = (g << ((3 - b_) * 8)) >> 24
+    val = jnp.where((xpos >= 0) & (xpos < w2),
+                    q.astype(jnp.float32) * scale, 0.0)
+    return val[:, :k] * (1.0 - frac) + val[:, 1:k + 1] * frac
+
+
+@jax.custom_vjp
+def quantize_pack_rows8(rows: jax.Array, scale: jax.Array) -> jax.Array:
+    """(..., Wb) bf16/fp32 rows -> (..., Wb/4) int32 container rows (four
+    symmetric-int8 taps per lane): ``q = clip(round(v / scale), -127,
+    127)``. Called once per frame at corr-fn build time, like
+    ``pack_rows``. The container (and the scale that shaped it) is an
+    opaque bit transport with zero cotangent — gradient flows through the
+    bf16 pyramid rows operand (straight-through estimator; the pack8 path
+    is serving-oriented and default-off, DESIGN.md r19)."""
+    wb = rows.shape[-1]
+    q = jnp.clip(jnp.round(rows.astype(jnp.float32) / scale),
+                 -127.0, 127.0).astype(jnp.int8)
+    # fp32 CONTAINER (bit view, like pack_rows): float operands keep the
+    # zero-cotangent custom_vjp well-typed; the kernel bitcasts back to
+    # int32 before any bit arithmetic, so no float op ever touches the
+    # (possibly NaN-patterned) container values.
+    return jax.lax.bitcast_convert_type(
+        jax.lax.bitcast_convert_type(
+            q.reshape(*rows.shape[:-1], wb // 4, 4), jnp.int32),
+        jnp.float32)
+
+
+def _qpack8_fwd(rows, scale):
+    return quantize_pack_rows8(rows, scale), None
+
+
+def _qpack8_bwd(_, g):
+    # Bit container (see pack_rows): zero cotangent for the bf16 rows and
+    # the (B, 1, 1) per-sample scales — gradient flows through the bf16
+    # pyramid operand.
+    return (jnp.zeros((*g.shape[:-1], g.shape[-1] * 4), jnp.bfloat16),
+            jnp.zeros((g.shape[0], 1, 1), jnp.float32))
+
+
+quantize_pack_rows8.defvjp(_qpack8_fwd, _qpack8_bwd)
+
+
+def level_scale8(rows: jax.Array) -> jax.Array:
+    """Per-level, PER-SAMPLE symmetric dequant scale ``max|v| / 127``
+    over each sample's (padded — zeros can't win) rows, shape (B, 1, 1),
+    floored away from zero so an all-zero level quantizes to zeros with
+    a well-defined scale.
+
+    Per-sample is load-bearing, not a refinement: a whole-batch amax
+    would let one sample's content set its batchmates' quantization grid
+    — the same request would return different bytes depending on batch
+    composition, breaking the r4 batched-rows == B=1-rows invariant and
+    the response cache's bit-identical-to-recompute contract. With
+    per-sample scales the container rows of sample i depend on sample i
+    alone, so batched pack8 quantization is row-independent by
+    construction (regression-pinned in tests/test_corr.py)."""
+    amax = jnp.max(jnp.abs(rows.astype(jnp.float32)), axis=(1, 2),
+                   keepdims=True)
+    return jnp.maximum(amax, 1e-30) / 127.0
+
+
+PACK8_ALIGN = 4 * LANE  # int8 row width multiple that quad-packs to vregs
+
+
+def corr_pack8() -> bool:
+    """``RAFT_CORR_PACK8=1`` quantizes bf16 pyramid levels to 4-per-lane
+    int8 containers with per-level symmetric scales — HALF the pair-packed
+    bf16 correlation DMA again (r19). Read at corr-fn build (trace) time
+    and registered in ENV_KNOBS, so serving programs key on it; default
+    OFF: the path is canary-banded (quantization error budget
+    ``scale/2 = amax/254`` per tap, pinned in tests/test_corr.py and
+    DESIGN.md r19), not bit-identical, so an operator opts in."""
+    return os.environ.get("RAFT_CORR_PACK8", "0").strip().lower() in (
+        "1", "true", "yes", "on")
+
+
+def pack_plan8(widths: Sequence[int]):
+    """Lane layout of the ONE combined int8 container all levels share.
+
+    Each level's quantized rows occupy ``pad_width(w)/4`` container lanes
+    (4 taps per 32-bit lane) at a static ``lane_base``; concatenating all
+    levels and padding the tail to a whole vreg gives the minimum-DMA
+    layout (at Middlebury-F: 192+96+64+32 = 384 lanes = 3 whole slabs,
+    exactly half the pair-packed bf16 bytes). Returns
+    ``([(lane_base, lane_count) per level], total_lanes)``."""
+    segs: List[Tuple[int, int]] = []
+    base = 0
+    for w in widths:
+        cnt = pad_width(w) // 4
+        segs.append((base, cnt))
+        base += cnt
+    return segs, pad_width(base)
+
+
+def plan_dma_bytes(widths: Sequence[int], bf16: bool, pack8: bool
+                   ) -> float:
+    """Per-PIXEL kernel-operand DMA bytes of one correlation lookup —
+    exactly what the BlockSpecs declare (each pixel's grid cell streams
+    every level's full operand row). This is the analytic half of the
+    r19 ledger story: the ratio ``plan_dma_bytes(int8) /
+    plan_dma_bytes(bf16)`` is computable at ANY geometry without a
+    compile, and the driver's on-chip run corroborates it with the
+    advance rows' compiler ``bytes_est``."""
+    if pack8 and bf16:
+        _, total = pack_plan8(widths)
+        # int8 container lanes (4 B each) + the per-level fp32 scales
+        # riding the coords operand.
+        return total * 4.0 + len(widths) * 4.0
+    if not bf16:
+        return float(sum(pad_width(w) * 4 for w in widths))
+    plan = pack_plan(widths, True)
+    total = 0.0
+    for w, p in zip(widths, plan):
+        if p == "packed":
+            total += pad_width(w, PACK_ALIGN) * 2  # container lanes x 4 B
+        elif isinstance(p, tuple) and p[0] == "host":
+            total += pad_width(w) * 2  # bloat-free by construction
+        elif isinstance(p, tuple) and p[0] == "tail":
+            total += pad_width(w) * 2  # rides the host container
+        else:
+            total += pad_width(w) * 2  # plain bf16 rows
+    return total
+
+
 PACK_ALIGN = 2 * LANE  # bf16 row width multiple that packs to whole vregs
 
 
@@ -435,33 +606,62 @@ def make_batch_partitioned(impl, batch_in_axes: Sequence,
     return fn
 
 
+def gather_level_taps(vol, cl, radius: int, w2: int, mode: str,
+                      lane_base: int, scale=None):
+    """One level's gather+lerp, dispatched by packing mode — THE shared
+    dispatcher of the standalone lookup kernel and the resident-iteration
+    kernel (ops/pallas_resident.py): their bit-identity contract is by
+    shared code, not parallel copies. ``vol``: the level's 2D operand
+    rows ((P, lanes); packed8 callers pass the int32 bitcast view, cast
+    once per operand); ``scale``: (P, 1) fp32 dequant column (packed8)."""
+    if mode == "plain":
+        return gather_lerp_taps(vol, cl, radius, w2)
+    if mode == "packed":
+        return gather_lerp_taps_packed(vol, cl, radius, w2)
+    if mode == "tail":
+        return gather_lerp_taps_packed_tail(vol, cl, radius, w2, lane_base)
+    if mode == "packed8":
+        return gather_lerp_taps_packed8(vol, cl, radius, w2, lane_base,
+                                        scale)
+    raise ValueError(f"unknown lookup mode {mode!r}")
+
+
 def _lookup_kernel(coords_ref, *refs, radius: int, widths: Sequence[int],
-                   spec: Tuple[Tuple[int, bool, int], ...]):
-    """``spec``: per level ``(operand_idx, packed, lane_base)`` — levels may
-    share one operand (the combined host+tail container), so operands are a
-    separate axis from pyramid levels."""
+                   spec: Tuple[Tuple[int, str, int], ...]):
+    """``spec``: per level ``(operand_idx, mode, lane_base)`` with mode in
+    ``plain | packed | tail | packed8`` — levels may share one operand
+    (the combined host+tail bf16 container; ALL levels for the int8
+    container), so operands are a separate axis from pyramid levels. The
+    coords block's column 0 is the fp32 position; under ``packed8`` the
+    per-level dequant scales ride as columns ``1 + lvl`` (broadcast
+    per-pixel — see make_reg_tpu_corr_fn)."""
     *vol_refs, out_ref = refs
     k = 2 * radius + 1
-    c = coords_ref[:]  # (TILE, 1) fp32
-    for lvl, (op, is_packed, base) in enumerate(spec):
+    c = coords_ref[:, :1]  # (TILE, 1) fp32 position
+    pack8_views = {}
+    for lvl, (op, mode, base) in enumerate(spec):
         cl = c * (1.0 / (1 << lvl))
-        if not is_packed:
-            t = gather_lerp_taps(vol_refs[op][:], cl, radius, widths[lvl])
-        elif base == 0:
-            t = gather_lerp_taps_packed(vol_refs[op][:], cl, radius,
-                                        widths[lvl])
+        if mode == "packed8":
+            if op not in pack8_views:  # bitcast the container view once
+                pack8_views[op] = jax.lax.bitcast_convert_type(
+                    vol_refs[op][:], jnp.int32)
+            vol = pack8_views[op]
+            scale = coords_ref[:, 1 + lvl:2 + lvl]
         else:
-            t = gather_lerp_taps_packed_tail(vol_refs[op][:], cl, radius,
-                                             widths[lvl], base)
+            vol = vol_refs[op][:]
+            scale = None  # no scale columns exist on non-pack8 coords
+        t = gather_level_taps(vol, cl, radius, widths[lvl], mode, base,
+                              scale)
         out_ref[:, lvl * k:(lvl + 1) * k] = t.astype(out_ref.dtype)
 
 
 def _pallas_lookup(pyramid: Sequence[jax.Array], coords_flat: jax.Array,
                    radius: int, widths: Tuple[int, ...],
-                   out_dtype, spec: Tuple[Tuple[int, bool, int], ...],
+                   out_dtype, spec: Tuple[Tuple[int, str, int], ...],
                    tile: int = _TILE_DEFAULT) -> jax.Array:
-    """pyramid: list of per-OPERAND (N, W2p) rows; coords_flat: (N, 1)."""
-    n = coords_flat.shape[0]
+    """pyramid: list of per-OPERAND (N, W2p) rows; coords_flat: (N, U)
+    (column 0 = position; packed8 scale columns ride along)."""
+    n, cw = coords_flat.shape
     k = 2 * radius + 1
     out_ch = len(spec) * k
     grid = pl.cdiv(n, tile)
@@ -471,7 +671,7 @@ def _pallas_lookup(pyramid: Sequence[jax.Array], coords_flat: jax.Array,
         kernel,
         out_shape=jax.ShapeDtypeStruct((n, out_ch), out_dtype),
         grid=(grid,),
-        in_specs=[pl.BlockSpec((tile, 1), lambda i: (i, 0),
+        in_specs=[pl.BlockSpec((tile, cw), lambda i: (i, 0),
                                memory_space=pltpu.VMEM)] +
                  [pl.BlockSpec((tile, p.shape[-1]), lambda i: (i, 0),
                                memory_space=pltpu.VMEM) for p in pyramid],
@@ -488,7 +688,7 @@ def _pallas_lookup(pyramid: Sequence[jax.Array], coords_flat: jax.Array,
 @functools.lru_cache(maxsize=None)
 def _partitioned_lookup(radius: int, widths: Tuple[int, ...], out_dtype_name,
                         nops: int,
-                        spec: Tuple[Tuple[int, bool, int], ...] = (),
+                        spec: Tuple[Tuple[int, str, int], ...] = (),
                         tile: int = _TILE_DEFAULT):
     """SPMD-partitionable 3D lookup: coords (B, N, 1) + ``nops`` row
     operands (B, N, W2p) -> (B, N, nlev*(2r+1)), independent along (B, N)
@@ -499,12 +699,12 @@ def _partitioned_lookup(radius: int, widths: Tuple[int, ...], out_dtype_name,
     coexist.
     """
     out_dtype = jnp.dtype(out_dtype_name)
-    spec = spec or tuple((i, False, 0) for i in range(len(widths)))
+    spec = spec or tuple((i, "plain", 0) for i in range(len(widths)))
 
     def impl(coords3, *pyr3):
-        b, n, _ = coords3.shape
+        b, n, cw = coords3.shape
         flat = [p.reshape(b * n, p.shape[-1]) for p in pyr3]
-        out = _pallas_lookup(flat, coords3.reshape(b * n, 1), radius,
+        out = _pallas_lookup(flat, coords3.reshape(b * n, cw), radius,
                              widths, out_dtype, spec, tile)
         return out.reshape(b, n, -1)
 
@@ -550,7 +750,7 @@ def _masked_lookup_xla(pyramid: Sequence[jax.Array], coords_flat: jax.Array,
 def _lookup(pyramid: List[jax.Array], kernel_ops: List[jax.Array],
             coords_flat: jax.Array, radius: int, widths: Tuple[int, ...],
             out_dtype=jnp.float32,
-            spec: Tuple[Tuple[int, bool, int], ...] = (),
+            spec: Tuple[Tuple[int, str, int], ...] = (),
             tile: int = _TILE_DEFAULT) -> jax.Array:
     """pyramid: per-level (B, N, W2p_l) bf16/fp32 rows — the DIFFERENTIABLE
     operand (cotangents sum linearly across the loop's 32 lookup calls);
@@ -575,8 +775,11 @@ def _lookup_fwd(pyramid, kernel_ops, coords_flat, radius, widths, out_dtype,
 
 def _lookup_bwd(radius, widths, out_dtype, spec, tile, residuals, g):
     pyramid, kernel_ops, coords_flat = residuals
+    # Column 0 is the fp32 position; packed8 scale columns (zero
+    # cotangent — they shaped only the bit containers) ride behind it.
+    cpos = coords_flat[..., :1]
     _, vjp = jax.vjp(
-        lambda p: _masked_lookup_xla(p, coords_flat, radius, widths), pyramid)
+        lambda p: _masked_lookup_xla(p, cpos, radius, widths), pyramid)
     # The oracle emits fp32; a bf16-out kernel hands back a bf16 cotangent.
     (d_pyramid,) = vjp(g.astype(jnp.float32))
     # The containers are loop-invariant bit transports: zero cotangent
@@ -635,8 +838,20 @@ def pack_plan(widths: Sequence[int], bf16: bool):
     return ["plain" if p == "odd" else p for p in plan]
 
 
-def make_reg_tpu_corr_fn(fmap1: jax.Array, fmap2: jax.Array, *,
-                         num_levels: int, radius: int, out_dtype=None):
+def build_corr_operands(fmap1: jax.Array, fmap2: jax.Array, *,
+                        num_levels: int, radius: int, out_dtype=None):
+    """Build the correlation volume + the exact operand set the lookup
+    kernel reads, WITHOUT closing over a corr_fn.
+
+    Returns a dict: ``flat`` (per-level differentiable rows), ``kernel_ops``
+    (packed containers when any level packs — empty means the kernel reads
+    ``flat``), ``spec`` (level -> (operand, mode, lane_base)), ``widths``,
+    ``scales`` (per-level fp32 dequant scalars under pack8, else None),
+    geometry and ``tile``. :func:`make_reg_tpu_corr_fn` wraps this into
+    the classic closure; the r19 resident-iteration kernel
+    (ops/pallas_resident.py) consumes the same operands directly so the
+    in-kernel gather is the SAME arithmetic on the SAME containers as the
+    standalone lookup."""
     out_dtype = jnp.float32 if out_dtype is None else out_dtype
     b, h, w1, _ = fmap1.shape
     w2 = fmap2.shape[2]
@@ -672,7 +887,8 @@ def make_reg_tpu_corr_fn(fmap1: jax.Array, fmap2: jax.Array, *,
     # sharding survive the reshape, so the partitioned lookup runs
     # per-shard under any row mesh.
     bf16 = vol.dtype == jnp.bfloat16
-    plan = pack_plan(widths, bf16)
+    pack8 = bf16 and corr_pack8()
+    plan = pack_plan(widths, bf16 and not pack8)
     any_packed = any(p != "plain" for p in plan)
     flat, containers = [], {}  # containers: lvl -> packed rows
     cur = vol.reshape(b, h * w1, -1)
@@ -695,38 +911,92 @@ def make_reg_tpu_corr_fn(fmap1: jax.Array, fmap2: jax.Array, *,
         else:
             cur = avg_pool_last(cur) if lvl + 1 < num_levels else None
 
-    # Assemble operands + the level -> (operand, packed, lane_base) spec.
-    kernel_ops, spec = [], [None] * num_levels
-    for lvl in range(num_levels):
-        p = plan[lvl]
-        if p == "plain":
-            if any_packed:
-                spec[lvl] = (len(kernel_ops), False, 0)
-                kernel_ops.append(flat[lvl])
-            else:
-                spec[lvl] = (lvl, False, 0)
-        elif p == "packed":
-            spec[lvl] = (len(kernel_ops), True, 0)
-            kernel_ops.append(containers[lvl])
-        elif isinstance(p, tuple) and p[0] == "host":
-            tail = p[1]
-            base = containers[lvl].shape[-1]
-            assert base % LANE + containers[tail].shape[-1] <= LANE, (
-                "tail level must fit one slab slot", base)
-            op = len(kernel_ops)
-            spec[lvl] = (op, True, 0)
-            spec[tail] = (op, True, base)
-            kernel_ops.append(jnp.concatenate(
-                [containers[lvl], containers[tail]], axis=-1))
-        # ("tail", host): spec written by its host above.
+    scales = None
+    if pack8:
+        # r19 narrow-lane packing: ONE combined int8 container carries
+        # every level at a static lane_base (pack_plan8); per-level
+        # symmetric scales dequant in-register at the gather. Built once
+        # per frame, outside the GRU scan, exactly like pack_rows — and
+        # the bf16 ``flat`` rows stay the differentiable operand.
+        segs, total = pack_plan8(widths)
+        scales = [level_scale8(flat[lvl]) for lvl in range(num_levels)]
+        parts = [quantize_pack_rows8(flat[lvl], scales[lvl])
+                 for lvl in range(num_levels)]
+        used = segs[-1][0] + segs[-1][1]
+        if total > used:  # pad the container tail to whole vregs
+            parts.append(jnp.zeros((b, h * w1, total - used), jnp.float32))
+        kernel_ops = [jnp.concatenate(parts, axis=-1)]
+        spec = tuple((0, "packed8", segs[lvl][0])
+                     for lvl in range(num_levels))
+        any_packed = True
+    else:
+        # Assemble operands + the level -> (operand, mode, lane_base) spec.
+        kernel_ops, spec = [], [None] * num_levels
+        for lvl in range(num_levels):
+            p = plan[lvl]
+            if p == "plain":
+                if any_packed:
+                    spec[lvl] = (len(kernel_ops), "plain", 0)
+                    kernel_ops.append(flat[lvl])
+                else:
+                    spec[lvl] = (lvl, "plain", 0)
+            elif p == "packed":
+                spec[lvl] = (len(kernel_ops), "packed", 0)
+                kernel_ops.append(containers[lvl])
+            elif isinstance(p, tuple) and p[0] == "host":
+                tail = p[1]
+                base = containers[lvl].shape[-1]
+                assert base % LANE + containers[tail].shape[-1] <= LANE, (
+                    "tail level must fit one slab slot", base)
+                op = len(kernel_ops)
+                spec[lvl] = (op, "packed", 0)
+                spec[tail] = (op, "tail", base)
+                kernel_ops.append(jnp.concatenate(
+                    [containers[lvl], containers[tail]], axis=-1))
+            # ("tail", host): spec written by its host above.
+        spec = tuple(spec)
 
     tile = corr_tile()  # env override honored per corr-fn build (trace time)
-    spec = tuple(spec)
+    return {"b": b, "h": h, "w1": w1, "widths": widths, "spec": spec,
+            "flat": flat, "kernel_ops": kernel_ops if any_packed else [],
+            "scales": scales, "out_dtype": out_dtype, "tile": tile,
+            "radius": radius, "pack8": pack8}
+
+
+def corr_coords_operand(ops, coords_x: jax.Array) -> jax.Array:
+    """The lookup's coords operand: column 0 = fp32 x position; under
+    pack8 the per-level PER-SAMPLE dequant scales ride as broadcast
+    columns (they shard like coords — ``b n u`` — so the SPMD rule is
+    untouched; +4 fp32/pixel of DMA against the halved pyramid rows)."""
+    b, n = ops["b"], ops["h"] * ops["w1"]
+    coords_flat = coords_x.astype(jnp.float32).reshape(b, n, 1)
+    if ops["scales"] is None:
+        return coords_flat
+    cols = [jnp.broadcast_to(s.reshape(b, 1, 1), (b, n, 1))
+            for s in ops["scales"]]
+    return jnp.concatenate([coords_flat] + cols, axis=-1)
+
+
+def corr_fn_from_operands(ops):
+    """The classic lookup closure over a :func:`build_corr_operands`
+    struct — shared with the resident-iteration path so building BOTH (the
+    standalone lookup for compute_mask steps, the in-kernel gather for the
+    resident scan body) costs one volume/container build; XLA DCEs
+    whichever one a given program never calls."""
+    b, h, w1 = ops["b"], ops["h"], ops["w1"]
 
     def corr_fn(coords_x: jax.Array) -> jax.Array:
-        coords_flat = coords_x.astype(jnp.float32).reshape(b, h * w1, 1)
-        out = _lookup(flat, kernel_ops if any_packed else [], coords_flat,
-                      radius, widths, out_dtype, spec, tile)
+        coords_flat = corr_coords_operand(ops, coords_x)
+        out = _lookup(ops["flat"], ops["kernel_ops"], coords_flat,
+                      ops["radius"], ops["widths"], ops["out_dtype"],
+                      ops["spec"], ops["tile"])
         return out.reshape(b, h, w1, -1)
 
     return corr_fn
+
+
+def make_reg_tpu_corr_fn(fmap1: jax.Array, fmap2: jax.Array, *,
+                         num_levels: int, radius: int, out_dtype=None):
+    return corr_fn_from_operands(
+        build_corr_operands(fmap1, fmap2, num_levels=num_levels,
+                            radius=radius, out_dtype=out_dtype))

@@ -143,10 +143,25 @@ def _refinement_closures(params: Params, cfg: RAFTStereoConfig,
     # out_dtype = compute dtype: the Pallas kernels downcast in-kernel (an
     # external astype on a custom-call output is a separate full-tensor
     # pass), so the scan body consumes corr_fn's output directly.
-    corr_fn = make_corr_fn(cfg.corr_implementation,
-                           fmap1.astype(corr_dtype), fmap2.astype(corr_dtype),
-                           num_levels=cfg.corr_levels, radius=cfg.corr_radius,
-                           out_dtype=compute_dtype)
+    # For reg_tpu the volume/container build is exposed as an operand
+    # struct: the classic lookup closure AND the r19 resident-iteration
+    # kernel (ops/pallas_resident.py) share it, so both paths cost one
+    # build and XLA DCEs whichever a given program never calls.
+    corr_ops = None
+    if cfg.corr_implementation in ("reg_tpu", "reg_cuda"):
+        from raft_stereo_tpu.corr.pallas_reg import (build_corr_operands,
+                                                     corr_fn_from_operands)
+        corr_ops = build_corr_operands(
+            fmap1.astype(corr_dtype), fmap2.astype(corr_dtype),
+            num_levels=cfg.corr_levels, radius=cfg.corr_radius,
+            out_dtype=compute_dtype)
+        corr_fn = corr_fn_from_operands(corr_ops)
+    else:
+        corr_fn = make_corr_fn(
+            cfg.corr_implementation,
+            fmap1.astype(corr_dtype), fmap2.astype(corr_dtype),
+            num_levels=cfg.corr_levels, radius=cfg.corr_radius,
+            out_dtype=compute_dtype)
 
     b, h, w, _ = fmap1.shape
     coords0 = coords_grid(b, h, w)
@@ -182,8 +197,8 @@ def _refinement_closures(params: Params, cfg: RAFTStereoConfig,
             for i in range(cfg.n_gru_layers)]
     else:
         # Training engagement (fused_train) fuses at any batch size — the
-        # 200k-pixel batch threshold is an eval heuristic (see
-        # gru_is_fusable).
+        # B>1 crossover (stream_batch_crossover) is an eval heuristic
+        # (see gru_is_fusable).
         any_batch = not test_mode and cfg.fused_train
         fused_ctx = [
             prepare_gru_context(
@@ -192,13 +207,25 @@ def _refinement_closures(params: Params, cfg: RAFTStereoConfig,
             if fuse and gru_is_fusable(net[i], any_batch=any_batch) else None
             for i in range(cfg.n_gru_layers)]
 
+    # r19 resident iteration (ops/pallas_resident.py): corr lookup +
+    # motion encoder + gru08 + FlowHead in ONE streaming kernel, engaged
+    # only in the compute_mask=False test-mode scan body (the serving
+    # advance/segment programs and the test-mode forward) — bit-identical
+    # to the serial fused composition by construction, so nothing about
+    # the segment/epilogue pins moves. Engagement needs the reg_tpu
+    # operand struct, the gru08 stream's own fusability (incl. the r19
+    # batch crossover) and no caller-supplied flow_init (the fused motion
+    # encoder's y==0 weight drop, exactly like fuse_motion below).
+    resident_ok = False
+    if (test_mode and space_mesh is None and flow_init is None
+            and corr_ops is not None and fuse):
+        from raft_stereo_tpu.ops.pallas_resident import iter_is_fusable
+        resident_ok = (fused_ctx[0] is not None
+                       and iter_is_fusable(net[0], corr_ops))
+
     def one_iteration(net, coords1, compute_mask=True):
         coords1 = lax.stop_gradient(coords1)  # truncated BPTT (:109)
-        corr = corr_fn(coords1[..., 0])  # already compute_dtype (out_dtype)
-        # Named so the fused-train remat policy saves the lookup output
-        # (its custom_vjp backward needs only the residual coords/volume,
-        # never a kernel re-run). No-op outside that policy.
-        corr = checkpoint_name(corr, "stream_kernel")
+        use_resident = resident_ok and not compute_mask
         flow = (coords1 - coords0).astype(compute_dtype)
         fuse_any_batch = not test_mode and cfg.fused_train
         if cfg.n_gru_layers == 3 and cfg.slow_fast_gru:  # low-res GRU only
@@ -214,12 +241,44 @@ def _refinement_closures(params: Params, cfg: RAFTStereoConfig,
                                      fused_ctx=fused_ctx,
                                      space_mesh=space_mesh,
                                      fuse_any_batch=fuse_any_batch)
-        net, up_mask, delta_flow = apply_update_block(
-            params["update_block"], cfg, net, inp, corr, flow,
-            iter32=cfg.n_gru_layers == 3, iter16=cfg.n_gru_layers >= 2,
-            compute_mask=compute_mask, fused_ctx=fused_ctx,
-            fuse_motion=flow_init is None, space_mesh=space_mesh,
-            fuse_any_batch=fuse_any_batch)
+        if use_resident:
+            from raft_stereo_tpu.ops.pallas_resident import fused_iter_fwd_impl
+            from raft_stereo_tpu.ops.resize import interp_align_corners
+            # Coarse GRUs first (the SAME composition apply_update_block's
+            # iter32/iter16 section runs — fused_gru1632 co-schedule
+            # included), then the resident kernel replaces the serial
+            # corr -> motion -> gru08+head chain. Splitting the call is a
+            # pure reorganization of the same ops.
+            net = apply_update_block(
+                params["update_block"], cfg, net, inp,
+                iter32=cfg.n_gru_layers == 3, iter16=cfg.n_gru_layers >= 2,
+                iter08=False, update=False, fused_ctx=fused_ctx)
+            ub = params["update_block"]
+            xs2 = ((interp_align_corners(net[1], net[0].shape[1:3]),)
+                   if cfg.n_gru_layers > 1 else ())
+            net0, delta_x = fused_iter_fwd_impl(
+                ub["encoder"], ub["gru08"], ub["flow_head"], corr_ops,
+                net[0], fused_ctx[0], coords1[..., 0], flow, *xs2)
+            net = (net0,) + tuple(net[1:])
+            # The kernel omits conv2.b[0]; adding it here keeps the
+            # fused_gru_head contract (models/update.py does the same).
+            delta_x = delta_x + ub["flow_head"]["conv2"]["b"][0]
+            delta_flow = jnp.concatenate(
+                [delta_x, jnp.zeros_like(delta_x)], axis=-1)
+            up_mask = None
+        else:
+            corr = corr_fn(coords1[..., 0])  # compute_dtype (out_dtype)
+            # Named so the fused-train remat policy saves the lookup
+            # output (its custom_vjp backward needs only the residual
+            # coords/volume, never a kernel re-run). No-op outside that
+            # policy.
+            corr = checkpoint_name(corr, "stream_kernel")
+            net, up_mask, delta_flow = apply_update_block(
+                params["update_block"], cfg, net, inp, corr, flow,
+                iter32=cfg.n_gru_layers == 3, iter16=cfg.n_gru_layers >= 2,
+                compute_mask=compute_mask, fused_ctx=fused_ctx,
+                fuse_motion=flow_init is None, space_mesh=space_mesh,
+                fuse_any_batch=fuse_any_batch)
         # Stereo: project the update onto the epipolar line (:120).
         delta_flow = delta_flow.astype(jnp.float32).at[..., 1].set(0.0)
         coords1 = coords1 + delta_flow

@@ -1,0 +1,392 @@
+"""The resident iteration (r19): corr lookup + motion encoder + finest
+ConvGRU + FlowHead co-scheduled in ONE streaming Pallas kernel.
+
+Why: after r6 the per-iteration update chain still round-trips HBM between
+its streamed kernels every one of the 32 refinement iterations — the corr
+taps (36 ch) and the motion features (128 ch) are written by one kernel
+and re-read by the next at the full 1/4-res plane, and each kernel pays
+its own pipeline ramp (the r5 profile attributes the remaining ~126 ms of
+the frame to exactly these interstitials). This module extends the
+``fused_gru1632`` co-scheduling pattern (ops/pallas_stream.py) to the
+FINE scale, where the bytes live: one grid step gathers the correlation
+taps for a row block straight from the packed pyramid containers, runs
+the motion encoder's stages at their streaming lags, and advances the
+gru08+FlowHead stream ONE ROW BLOCK behind, consuming the motion rows
+from a VMEM window — the corr and motion tensors never touch HBM.
+
+Bit-identity: every stage is the SAME arithmetic as the serial fused
+composition it replaces — the standalone lookup's gather/lerp on the same
+containers (corr/pallas_reg.py), ``_motion_kernel``'s two stages + fusion
+conv, ``_gru_kernel``'s gate convs + head — at the same fp32 accumulation
+and bf16 rounding points, so the resident advance is BITWISE equal to the
+serial kernels (test-pinned in tests/test_fused_stream.py, the
+fused_gru1632 precedent). ``RAFT_FUSE_ITER=0`` kills the path (breaker
+rung ``fuse_iter``, serve/guard.py); it is inference-only by construction
+(engaged in the ``compute_mask=False`` test-mode scan body — the serving
+advance/segment programs and the test-mode forward).
+
+Residency budget at Middlebury-F (th=8, 1/4-res 504x744, bf16): pyramid
+block 18.3 MB/buffer -> ~36.6 MB double-buffered (9.2/18.3 under
+RAFT_CORR_PACK8), motion + gru08 + head rings/windows ~25 MB,
+czrq/h/up16/coords/patches blocks ~15 MB, weights ~4 MB => ~80 MB
+against the 100 MB scoped cap (~62 with pack8). A VMEM overflow on an
+untested geometry trips the ``fuse_iter`` rung and serving falls back to
+the serial kernels — the r7 breaker contract.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from raft_stereo_tpu.corr.pallas_reg import (
+    corr_coords_operand, gather_level_taps, make_batch_partitioned)
+from raft_stereo_tpu.ops.jax_compat import compiler_params
+from raft_stereo_tpu.ops.pallas_stream import (
+    _VMEM_LIMIT, _conv_rows, _dot, _dtype_ok, _interpret, _row_mask,
+    _shift, _zeros, flow_patches, gru_weights)
+
+
+def fuse_iter_on() -> bool:
+    """``RAFT_FUSE_ITER`` kill switch (default ON). Read at trace time and
+    registered in ENV_KNOBS so serving programs key on it."""
+    return os.environ.get("RAFT_FUSE_ITER", "1").strip().lower() not in (
+        "0", "false", "no", "off")
+
+
+def resident_th(hh: int) -> int:
+    """Row block of the resident stream (0 = unsupported). Fixed at 8:
+    every /32-padded image's 1/4-res height divides it, and it bounds the
+    VMEM window set (the budget table in the module docstring); larger
+    blocks would double the pyramid block DMA buffer for marginal step
+    amortization. Must stay > the head chain's 5-row lag (the nb+2-step
+    grid covers the drain only for th >= 6)."""
+    return 8 if hh % 8 == 0 and hh >= 8 else 0
+
+
+def _corr_rows(corr_ops, coords_blk, vol_refs, th: int, width: int, dtype):
+    """The standalone lookup's per-level gather, on a row block's pixels.
+
+    coords_blk: (th*W, cw) fp32 (column 0 = position, packed8 scales
+    behind); vol_refs: the kernel refs of ``corr_ops['kernel_ops']`` (or
+    ``flat`` when nothing packs). Returns (th, W, nlev*(2r+1)) taps cast
+    to the compute dtype — exactly the bytes the standalone kernel would
+    have written to HBM (same gathers, same fp32 lerp, one cast)."""
+    radius = corr_ops["radius"]
+    widths = corr_ops["widths"]
+    spec = corr_ops["spec"]
+    k = 2 * radius + 1
+    c = coords_blk[:, :1]
+    pack8_views = {}
+    taps = []
+    for lvl, (op, mode, base) in enumerate(spec):
+        cl = c * (1.0 / (1 << lvl))
+        if mode == "packed8":
+            if op not in pack8_views:  # bitcast the container view once
+                pack8_views[op] = jax.lax.bitcast_convert_type(
+                    vol_refs[op][0], jnp.int32)
+            vol = pack8_views[op]
+            scale = coords_blk[:, 1 + lvl:2 + lvl]
+        else:
+            vol = vol_refs[op][0]
+            scale = None  # no scale columns exist on non-pack8 coords
+        # gather_level_taps is THE dispatcher the standalone lookup
+        # kernel runs — shared code, not a parallel copy, is what keeps
+        # the resident-vs-serial bitwise pin structurally safe.
+        taps.append(gather_level_taps(vol, cl, radius, widths[lvl], mode,
+                                      base, scale))
+    out = jnp.concatenate(taps, axis=-1).astype(dtype)
+    return out.reshape(th, width, len(spec) * k)
+
+
+def _resident_kernel(coords_ref, flow_ref, pat_ref, h_ref, czrq_ref,
+                     *rest, nops: int, nx2: int, th: int, nb: int,
+                     width: int, ch: int, hh: int, c1: int,
+                     corr_static: dict, coffs):
+    """One grid step = corr+motion for row block ``i`` plus gru08+head for
+    block ``i-1`` (the fused_gru1632 one-block-behind schedule)."""
+    vol_refs = rest[:nops]
+    k = nops
+    x2_refs = rest[k:k + nx2]
+    k += nx2
+    (wc1_ref, wf1_ref, b1_ref, w2_ref, b2_ref, wf_ref, bf_ref,
+     whzr_ref, whq_ref, wx_ref, w1h_ref, b1h_ref, w2h_ref) = rest[k:k + 13]
+    k += 13
+    out_ref, dx_ref = rest[k:k + 2]
+    k += 2
+    (scr_s1, scr_s2, scr_fl, w_mot,
+     scr_h, scr_rh, scr_z, scr_aqx, scr_x, scr_hn, scr_f1) = rest[k:]
+
+    i = pl.program_id(1)  # row step; program_id(0) is the batch sample
+    dtype = h_ref.dtype
+
+    @pl.when(i == 0)
+    def _init():
+        for s in (scr_s1, scr_s2, scr_fl, w_mot, scr_h, scr_rh, scr_z,
+                  scr_aqx, scr_x, scr_hn, scr_f1):
+            _zeros(s)
+
+    # ---- corr + motion stage 1 for block i (rows [i*TH, (i+1)*TH)):
+    # the gather feeds convc1 directly from registers; the flow branch's
+    # tap-major patches dot is _motion_kernel's verbatim. Shifts always
+    # run (the stream structure); placement is gated like _place/_flush.
+    for s in (scr_s1, scr_s2):
+        _shift(s, 2)
+    _shift(scr_fl, 2)
+
+    @pl.when(i < nb)
+    def _place_motion():
+        corr = _corr_rows(corr_static, coords_ref[0], vol_refs, th, width,
+                          dtype)
+        acc_c = _dot(corr, wc1_ref[...])
+        f1_rows = [jax.lax.dot_general(
+            pat_ref[:, 0, r], wf1_ref[...], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) for r in range(th)]
+        acc_f = jnp.stack(f1_rows)
+        bias1 = b1_ref[...].astype(jnp.float32)
+        s1v = jnp.concatenate(
+            [jax.nn.relu(acc_c + bias1[:, :c1]),
+             jax.nn.relu(acc_f + bias1[:, c1:])], axis=-1).astype(dtype)
+        scr_s1[2:2 + th, 1:width + 1] = s1v
+        scr_fl[2:2 + th] = flow_ref[0]
+
+    @pl.when(i >= nb)
+    def _flush_motion():
+        _zeros(scr_s1, slice(2, 2 + th))
+        _zeros(scr_fl, slice(2, 2 + th))
+
+    # Stage 2 rows [i*TH-1, ...): block-diagonal conv, out-of-range rows
+    # masked (relu(bias) stands in for zero padding otherwise).
+    s2 = jax.nn.relu(_conv_rows(scr_s1, w2_ref, th, width)
+                     + b2_ref[...].astype(jnp.float32)).astype(dtype)
+    scr_s2[2:2 + th, 1:width + 1] = _row_mask(i, -1, th, hh, s2)
+
+    # Fusion rows [i*TH-2, ...): [fused 126 | raw 2-ch flow] appended to
+    # the motion window (2*TH+2 rows) the gru08 stream consumes from.
+    acc = _conv_rows(scr_s2, wf_ref, th, width)
+    fused = jax.nn.relu(acc + bf_ref[...].astype(jnp.float32)).astype(dtype)
+    mrows = jnp.concatenate([fused, scr_fl[0:th]], axis=-1)
+    _shift(w_mot, th + 2)
+    w_mot[th + 2:2 * th + 2] = mrows
+
+    # ---- gru08 + FlowHead stream: block j = i-1, one block behind (its
+    # x window rows [j*TH-2, (j+1)*TH) are all in w_mot by now). The body
+    # is _gru_kernel's with the motion x part placed from the window
+    # instead of an HBM operand.
+    @pl.when(i >= 1)
+    def _gru_phase():
+        j = i - 1
+        _shift(scr_h, 3)
+        _shift(scr_x, 2)
+
+        @pl.when(j < nb)
+        def _place():
+            scr_h[3:3 + th, 1:width + 1] = h_ref[0]
+            # Motion rows [j*TH, (j+1)*TH): window offset 4 (the window
+            # holds [(j)*TH-4, (j+2)*TH-2) after this step's append).
+            scr_x[2:2 + th, 1:width + 1, 0:coffs[1]] = w_mot[4:4 + th]
+            for p, c0, cend in zip(x2_refs, coffs[1:-1], coffs[2:]):
+                scr_x[2:2 + th, 1:width + 1, c0:cend] = p[0]
+
+        @pl.when(j >= nb)
+        def _flush():
+            _zeros(scr_h, slice(3, 3 + th))
+            _zeros(scr_x, slice(2, 2 + th))
+
+        acc_x = _conv_rows(scr_x, wx_ref, th, width)
+        acc_x = acc_x + czrq_ref[0].astype(jnp.float32)
+        acc_h = _conv_rows(scr_h[1:], whzr_ref, th, width)
+        z_new = jax.nn.sigmoid(acc_h[..., :ch]
+                               + acc_x[..., :ch]).astype(dtype)
+        r_new = jax.nn.sigmoid(acc_h[..., ch:]
+                               + acc_x[..., ch:2 * ch]).astype(dtype)
+        rh_new = r_new * scr_h[2:2 + th, 1:width + 1]
+        _shift(scr_rh, 3)
+        scr_rh[3:3 + th, 1:width + 1] = rh_new
+        _shift(scr_z, 2)
+        scr_z[2:2 + th] = z_new
+        _shift(scr_aqx, 2)
+        scr_aqx[2:2 + th] = acc_x[..., 2 * ch:]
+        acc_q = _conv_rows(scr_rh, whq_ref, th, width, None) \
+            + scr_aqx[0:th]
+        q = jnp.tanh(acc_q).astype(dtype)
+        z = scr_z[0:th]
+        h_new = (1 - z) * scr_h[0:th, 1:width + 1] + z * q
+        out_ref[0] = h_new
+
+        # FlowHead chained on h' (rows [j*TH-4, ...) and [j*TH-5, ...)).
+        _shift(scr_hn, 2)
+        scr_hn[2:2 + th, 1:width + 1] = _row_mask(j, -3, th, hh, h_new)
+        f1 = jax.nn.relu(_conv_rows(scr_hn, w1h_ref, th, width)
+                         + b1h_ref[...].astype(jnp.float32))
+        _shift(scr_f1, 2)
+        scr_f1[2:2 + th, 1:width + 1] = _row_mask(j, -4, th, hh,
+                                                  f1.astype(dtype))
+        dx = _conv_rows(scr_f1, w2h_ref, th, width)
+        dx_ref[0] = dx[..., 0].astype(dx_ref.dtype)
+
+
+def fused_iter_fwd_impl(p_enc: dict, p_gru: dict, head_p: dict,
+                        corr_ops: dict, h, czrq, coords_x, flow, *x2_list):
+    """Resident advance of the finest scale for ONE iteration.
+
+    corr_ops: the :func:`~raft_stereo_tpu.corr.pallas_reg.
+    build_corr_operands` struct (volume containers built once per frame,
+    outside the scan). ``h``: gru08 hidden (B, H, W, ch); ``czrq``: the
+    pre-folded context from ``prepare_gru_context``; ``coords_x``: fp32
+    (B, H, W) matching x-coordinates; ``flow``: (B, H, W, 2) compute-dtype
+    flow (the motion encoder's raw input); ``x2_list``: the gru08 x parts
+    AFTER motion (the upsampled mid state, when n_gru_layers > 1).
+    Returns ``(h', delta_x)`` with delta_x fp32 (B, H, W, 1) EXCLUDING
+    ``conv2.b[0]`` — the fused_gru_head contract."""
+    b, hh, width, ch = h.shape
+    dtype = h.dtype
+    th = resident_th(hh)
+    nb = hh // th
+    grid = nb + 2
+    c1 = p_enc["convc1"]["w"].shape[-1]
+    cfused = p_enc["conv"]["w"].shape[-1]
+    cm = cfused + 2
+
+    # Motion weights — _motion_kernel's exact packing (pallas_stream).
+    from raft_stereo_tpu.ops.pallas_stream import _blockdiag3x3
+    wc1 = p_enc["convc1"]["w"].reshape(
+        p_enc["convc1"]["w"].shape[2:]).astype(dtype)
+    wf1 = p_enc["convf1"]["w"][:, :, 0].reshape(-1, c1).astype(dtype)
+    b1 = jnp.concatenate([p_enc["convc1"]["b"],
+                          p_enc["convf1"]["b"]]).reshape(1, -1)
+    w2 = _blockdiag3x3(p_enc["convc2"]["w"],
+                       p_enc["convf2"]["w"]).astype(dtype)
+    b2 = jnp.concatenate([p_enc["convc2"]["b"],
+                          p_enc["convf2"]["b"]]).reshape(1, -1)
+    wf = p_enc["conv"]["w"].astype(dtype)
+    bf = p_enc["conv"]["b"].reshape(1, -1)
+    pat = flow_patches(flow[..., 0], dtype)  # (49, B, H, W)
+
+    # gru08 + head weights — fused_conv_gru_fwd_impl's exact packing.
+    whzr, whq, wx_full = (w.astype(dtype) for w in gru_weights(p_gru, ch))
+    w1h = head_p["conv1"]["w"].astype(dtype)
+    b1h = head_p["conv1"]["b"].reshape(1, -1)
+    w2h = head_p["conv2"]["w"][..., :1].astype(dtype)
+
+    coffs = [0, cm]
+    for p in x2_list:
+        coffs.append(coffs[-1] + p.shape[-1])
+    cx = coffs[-1]
+
+    # czrq rows must cover gru blocks j in [0, nb] (prepare_gru_context's
+    # lag-5 pad gives exactly (nb+1)*TH rows for TH > 5).
+    need = (nb + 1) * th
+    if czrq.shape[1] < need:
+        czrq = jnp.pad(czrq, ((0, 0), (0, need - czrq.shape[1]),
+                              (0, 0), (0, 0)))
+
+    coords_aug = corr_coords_operand(corr_ops, coords_x)  # (B, N, cw)
+    cw = coords_aug.shape[-1]
+    vol_ops = corr_ops["kernel_ops"] or corr_ops["flat"]
+    nops = len(vol_ops)
+    pxb = th * width  # pixels per row block
+
+    def blk(bi, i):
+        return (bi, jnp.minimum(i, nb - 1), 0)
+
+    def blk4(bi, i):
+        return (bi, jnp.minimum(i, nb - 1), 0, 0)
+
+    def jblk4(bi, i):
+        return (bi, jnp.clip(i - 1, 0, nb - 1), 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, pxb, cw), blk, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, th, width, 2), blk4, memory_space=pltpu.VMEM),
+        pl.BlockSpec((49, 1, th, width),
+                     lambda bi, i: (0, bi, jnp.minimum(i, nb - 1), 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, th, width, ch), jblk4, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, th, width, 3 * ch),
+                     lambda bi, i: (bi, jnp.clip(i - 1, 0, nb), 0, 0),
+                     memory_space=pltpu.VMEM),
+    ] + [pl.BlockSpec((1, pxb, v.shape[-1]), blk, memory_space=pltpu.VMEM)
+         for v in vol_ops] \
+      + [pl.BlockSpec((1, th, width, p.shape[-1]), jblk4,
+                      memory_space=pltpu.VMEM) for p in x2_list] \
+      + [pl.BlockSpec(w.shape, lambda bi, i, nd=w.ndim: (0,) * nd,
+                      memory_space=pltpu.VMEM)
+         for w in (wc1, wf1, b1, w2, b2, wf, bf, whzr, whq, wx_full,
+                   w1h, b1h, w2h)]
+    out_specs = (
+        pl.BlockSpec((1, th, width, ch),
+                     lambda bi, i: (bi, jnp.where(i == 0, nb + 1, i - 1),
+                                    0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, th, width),
+                     lambda bi, i: (bi, jnp.where(i == 0, nb + 1, i - 1),
+                                    0),
+                     memory_space=pltpu.VMEM))
+    out_shape = (
+        jax.ShapeDtypeStruct((b, (nb + 2) * th, width, ch), dtype),
+        jax.ShapeDtypeStruct((b, (nb + 2) * th, width), jnp.float32))
+    scratch = [
+        pltpu.VMEM((th + 2, width + 2, 2 * c1), dtype),   # motion s1
+        pltpu.VMEM((th + 2, width + 2, 2 * c1), dtype),   # motion s2
+        pltpu.VMEM((th + 2, width, 2), dtype),            # raw flow ring
+        pltpu.VMEM((2 * th + 2, width, cm), dtype),       # motion window
+        pltpu.VMEM((th + 3, width + 2, ch), dtype),       # gru h window
+        pltpu.VMEM((th + 3, width + 2, ch), dtype),       # gru r*h
+        pltpu.VMEM((th + 2, width, ch), dtype),           # gru z ring
+        pltpu.VMEM((th + 2, width, ch), jnp.float32),     # gru aq_x
+        pltpu.VMEM((th + 2, width + 2, cx), dtype),       # gru x parts
+        pltpu.VMEM((th + 2, width + 2, ch), dtype),       # h' window
+        pltpu.VMEM((th + 2, width + 2, w1h.shape[-1]), dtype)]  # head f1
+
+    corr_static = {"radius": corr_ops["radius"],
+                   "widths": tuple(corr_ops["widths"]),
+                   "spec": tuple(corr_ops["spec"])}
+    kernel = functools.partial(
+        _resident_kernel, nops=nops, nx2=len(x2_list), th=th, nb=nb,
+        width=width, ch=ch, hh=hh, c1=c1,
+        corr_static=corr_static, coffs=tuple(coffs))
+    inputs = [coords_aug, flow.astype(dtype), pat, h, czrq, *vol_ops,
+              *x2_list, wc1, wf1, b1, w2, b2, wf, bf, whzr, whq, wx_full,
+              w1h, b1h, w2h]
+
+    def call(*arrs):
+        return pl.pallas_call(
+            kernel,
+            grid=(arrs[3].shape[0], grid),
+            in_specs=in_specs,
+            out_specs=out_specs,
+            out_shape=tuple(
+                jax.ShapeDtypeStruct((arrs[3].shape[0],) + o.shape[1:],
+                                     o.dtype) for o in out_shape),
+            scratch_shapes=scratch,
+            compiler_params=compiler_params(vmem_limit_bytes=_VMEM_LIMIT),
+            interpret=_interpret(),
+        )(*arrs)
+
+    # Batch rides the outer grid dim; the tap-major patches carry batch
+    # on axis 1 (the fused_motion partitioning rule).
+    axes_in = [0, 0, 1, 0, 0] + [0] * nops + [0] * len(x2_list) \
+        + [None] * 13
+    call_p = make_batch_partitioned(
+        call, axes_in, [a.ndim for a in inputs], [0, 0],
+        [o.ndim for o in out_shape])
+    h_out, dx_out = call_p(*inputs)
+    return h_out[:, 3:3 + hh], dx_out[:, 5:5 + hh][..., None]
+
+
+def iter_is_fusable(h, corr_ops, *x2_list, any_batch: bool = False) -> bool:
+    """Resident-iteration engagement: the kill switch, the gru08 stream's
+    own fusability (dtype, row block, batch policy — the r19 crossover),
+    and a reg_tpu operand struct for the in-kernel gather."""
+    from raft_stereo_tpu.ops.pallas_stream import gru_is_fusable
+    if not fuse_iter_on() or corr_ops is None:
+        return False
+    return (gru_is_fusable(h, *x2_list, any_batch=any_batch)
+            and resident_th(h.shape[1]) > 0
+            and _dtype_ok(h))

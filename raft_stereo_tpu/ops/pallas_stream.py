@@ -435,20 +435,73 @@ def _fused_gru_head_bwd(res, g):
 fused_gru_head.defvjp(_fused_gru_head_fwd, _fused_gru_head_bwd)
 
 
-def _batch_fuse_pixels() -> int:
+def stream_batch_on() -> bool:
+    """``RAFT_STREAM_BATCH`` — the r19 kill switch for B>1 engagement of
+    the streamed scan-body kernels (default ON). Off restores the pre-r19
+    serve behavior: batched device calls run the XLA twins, B=1 keeps its
+    kernels. Read at trace time and registered in ENV_KNOBS so batched
+    serving programs key on it (the stale-program discipline)."""
     import os
-    return int(os.environ.get("RAFT_BATCH_FUSE_PIXELS", 200_000))
+    return os.environ.get("RAFT_STREAM_BATCH", "1").strip().lower() not in (
+        "0", "false", "no", "off")
+
+
+# Crossover model constants (r19) — derived from the repo's own measured
+# records rather than the old one-point 200k heuristic:
+# - _STREAM_FIXED_S: per-SAMPLE fixed cost of a batched engagement — each
+#   of the ~3 streamed kernels in the scan body pays its pipeline ramp +
+#   lag-flush drain per sample (batch rides the outer grid dim, so the
+#   ramp re-runs per sample; ~2 extra grid steps/kernel at the r4-measured
+#   5-10 us/step fixed cost => ~36 us/sample).
+# - _INTERSTITIAL_BYTES_PER_PX: HBM bytes/pixel the fusion saves per
+#   iteration — the r5 profile's interstitial round-trips (gate preacts,
+#   zr, r*h, state update, motion features: ~3 full-tensor write+read
+#   pairs at 128 bf16 channels).
+# Fusing a B>1 sample wins when saved-DMA time exceeds the fixed cost:
+#   pixels * bytes_per_px / hbm_bw > fixed_s
+# On v5e (819 GB/s) the crossover lands at ~19k px/sample — engaging the
+# serve buckets (384x1248 -> 30k px at 1/4 res) the 200k heuristic fenced
+# out, while still protecting the r4 regression case (batch-16 realtime
+# 48x156 = 7.5k px: 129 -> 83 fps when force-fused).
+_STREAM_FIXED_S = 36e-6
+_INTERSTITIAL_BYTES_PER_PX = 1536.0
+
+
+def stream_batch_crossover() -> int:
+    """Pixels/sample above which B>1 engages the streamed kernels.
+
+    ``RAFT_BATCH_FUSE_PIXELS`` (explicit override, 0 = always fuse) wins;
+    otherwise the roofline crossover above, evaluated against the chip's
+    ledger HBM bandwidth (obs/ledger.py PEAK_HBM_BW — the same table the
+    MFU attribution uses; off-table hosts fall back to the v5e number,
+    which only matters for CPU tests)."""
+    import os
+    spec = os.environ.get("RAFT_BATCH_FUSE_PIXELS", "").strip()
+    if spec:
+        return int(spec)
+    bw = 819e9  # v5e default
+    try:
+        from raft_stereo_tpu.obs.ledger import chip_peaks
+        peaks = chip_peaks(jax.devices()[0].device_kind)
+        if peaks:
+            bw = peaks[1]
+    except Exception:  # noqa: BLE001 — policy heuristic, never fatal
+        pass
+    return int(_STREAM_FIXED_S * bw / _INTERSTITIAL_BYTES_PER_PX)
 
 
 def _batch_worthwhile(t) -> bool:
-    """B>1 engages the kernels only for big per-sample frames: at small
-    shapes the per-sample ring flush/fixed costs beat the fusion win —
-    measured r4: batch-16 realtime eval (48x156/sample) regressed 129 ->
-    83 fps fused, while B=1 Middlebury (504x744) is the kernels' +9%
-    headline. 200k pixels ~= half of Middlebury-F's 1/4-res plane.
-    RAFT_BATCH_FUSE_PIXELS overrides the threshold (0 = always fuse;
-    sweep table in BASELINE.md)."""
-    return t.shape[0] == 1 or t.shape[1] * t.shape[2] >= _batch_fuse_pixels()
+    """B>1 engagement policy for the streamed kernels (EVAL heuristic;
+    training's ``any_batch`` bypasses it). B=1 always engages. For B>1:
+    the ``RAFT_STREAM_BATCH`` kill switch gates the path entirely, and
+    the per-sample frame must clear :func:`stream_batch_crossover` —
+    the r19 ledger-derived replacement for the old fixed 200k-pixel
+    fence, sized so the scheduler's batch-4/8 serve buckets engage
+    Pallas instead of the XLA twins (sweep table in BASELINE.md)."""
+    if t.shape[0] == 1:
+        return True
+    return (stream_batch_on()
+            and t.shape[1] * t.shape[2] >= stream_batch_crossover())
 
 
 def gru_is_fusable(h, *x_list, any_batch: bool = False) -> bool:

@@ -70,6 +70,34 @@ class FastPath:
 # to its XLA twin, the bottom of the ladder is a genuinely kernel-free
 # forward (this is also what the parity canary compares against).
 DEFAULT_LADDER: Tuple[FastPath, ...] = (
+    # r19 rungs lead the ladder: each costs latency/DMA only — tripping
+    # fuse_iter reverts the resident mega-kernel to the serial fused
+    # kernels, corr_pack8 reverts int8 containers to bf16 pair-packing
+    # (a rung that only bites when the operator opted in), stream_batch
+    # reverts BATCHED device calls to the XLA twins while B=1 keeps its
+    # kernels.
+    FastPath(
+        name="fuse_iter",
+        description="resident per-iteration mega-kernel — corr lookup + "
+                    "motion encoder + gru08 + flow head in one stream "
+                    "(ops/pallas_resident.py)",
+        env_var="RAFT_FUSE_ITER",
+        matchers=("fuse_iter", "resident"),
+    ),
+    FastPath(
+        name="corr_pack8",
+        description="int8 quad-packed correlation containers "
+                    "(corr/pallas_reg.py RAFT_CORR_PACK8)",
+        env_var="RAFT_CORR_PACK8",
+        matchers=("pack8", "packed8"),
+    ),
+    FastPath(
+        name="stream_batch",
+        description="B>1 engagement of the streamed scan-body kernels "
+                    "(ops/pallas_stream.py RAFT_STREAM_BATCH)",
+        env_var="RAFT_STREAM_BATCH",
+        matchers=("stream_batch",),
+    ),
     FastPath(
         name="fuse_gru1632",
         description="co-scheduled gru16+32 streaming kernel "

@@ -139,6 +139,24 @@ def main() -> None:
         if dump_path():
             save_doc(ledger_doc, dump_path())
         out = {"rps": n_requests / elapsed, "elapsed_s": elapsed}
+        # r19 "measured-first" record: the per-kind ledger attribution
+        # (flops/bytes/MFU/roofline per program kind) rides the bench
+        # JSON line + trajectory extras, so kernel-ordering decisions
+        # are in the recorded trajectory rather than folklore. One row
+        # per kind — the largest batch bucket is the serve-batch story.
+        kind_rows = {}
+        for r in ledger_doc["rows"]:
+            k = r.get("kind")
+            if k and (k not in kind_rows
+                      or (r.get("b") or 0) > (kind_rows[k].get("b") or 0)):
+                kind_rows[k] = {f: r.get(f) for f in (
+                    "b", "h", "w", "iters", "flops_est", "bytes_est",
+                    "intensity", "roofline")}
+        out["ledger_kinds"] = kind_rows
+        out["ledger_attribution"] = {
+            k: {"mfu": v.get("mfu"), "roofline": v.get("roofline"),
+                "device_s": v.get("device_s")}
+            for k, v in (ledger_doc.get("attribution") or {}).items()}
         if status.get("batching"):
             b = status["batching"]
             out["occupancy_hist"] = b["occupancy_hist"]
@@ -353,6 +371,9 @@ def main() -> None:
                                  if cache_mult else None),
         "cache_repeat_rps": round(rep_on["rps"], 4),
         "nocache_repeat_rps": round(rep_off["rps"], 4),
+        # r19: per-kind compiler attribution off the batched session.
+        "ledger_kinds": bat.get("ledger_kinds"),
+        "ledger_attribution": bat.get("ledger_attribution"),
         "backend": jax.default_backend(),
     }
     if loopback is not None:
@@ -380,7 +401,11 @@ def main() -> None:
                 # graftrecall: the repeat-traffic cache numbers ride
                 # the same trajectory entry.
                 "cache_hit_ratio": doc["cache_hit_ratio"],
-                "cache_rps_multiplier": doc["cache_rps_multiplier"]})
+                "cache_rps_multiplier": doc["cache_rps_multiplier"],
+                # graftresident (r19): per-kind flops/bytes/MFU rows, so
+                # the measured-first ordering is in the trajectory.
+                "ledger_kinds": doc["ledger_kinds"],
+                "ledger_attribution": doc["ledger_attribution"]})
     if loopback is not None:
         emit(doc["metric"].replace("serve_requests_per_s",
                                    "serve_loopback_requests_per_s"),

@@ -1,0 +1,127 @@
+"""r19 engagement asserts: each new fast path PROVABLY engages — and its
+kill switch provably disengages it — at both acceptance geometries.
+
+The PR 2 "provably engages" ceremony, extended: instead of predicate
+checks alone, this traces the REAL serving programs (``build_program`` —
+the exact callables the serving cache jits) to a jaxpr at
+
+- headline geometry (2016x2976, b=1), and
+- the serve-batch bucket (384x1248 at b=4 and b=8 — the bench_serve
+  shape the old 200k-pixel ``_batch_worthwhile`` fence kept on XLA twins)
+
+and asserts kernel PRESENCE by name in the traced program text:
+``_resident_kernel`` (ops/pallas_resident.py), ``_gru1632_kernel``,
+``_lookup_kernel`` (the standalone corr gather that must return when the
+resident path is killed). Tracing executes nothing — CPU-safe, the
+graftverify precedent — and a jaxpr either contains a pallas_call to the
+named kernel or it does not: no heuristics.
+
+Also asserts the r19 acceptance ratio analytically: the int8 quad-packed
+correlation containers' per-iteration DMA at headline geometry must be
+<= 0.6x the bf16 pair-packed layout's (corr/pallas_reg.plan_dma_bytes —
+exact BlockSpec arithmetic; the driver's on-chip run corroborates with
+the advance rows' compiler bytes_est).
+
+Prints one JSON line; exit 1 on any failed check.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from raft_stereo_tpu.analysis.knobs import ENV_KNOBS
+    from raft_stereo_tpu.config import RAFTStereoConfig, with_eval_precision
+    from raft_stereo_tpu.corr.pallas_reg import (level_widths,
+                                                 plan_dma_bytes)
+    from raft_stereo_tpu.models.raft_stereo import init_raft_stereo
+    from raft_stereo_tpu.serve.session import (_env_overrides,
+                                               build_program, resolve_env)
+
+    cfg = with_eval_precision(RAFTStereoConfig(
+        corr_implementation="reg_tpu"))
+    base_env = {k: None for k in ENV_KNOBS}
+
+    @functools.lru_cache(maxsize=None)
+    def params_spec():
+        return jax.eval_shape(
+            functools.partial(init_raft_stereo, cfg=cfg),
+            jax.random.PRNGKey(0))
+
+    @functools.lru_cache(maxsize=None)
+    def state_spec(b: int, h: int, w: int):
+        prep = build_program("prepare", cfg, 0)
+        img = jax.ShapeDtypeStruct((b, h, w, 3), jnp.float32)
+        (state,) = jax.eval_shape(prep, params_spec(), img, img)
+        return state
+
+    @functools.lru_cache(maxsize=None)
+    def advance_text(b: int, h: int, w: int, env_items) -> str:
+        env = resolve_env(dict(env_items), base_env)
+        with _env_overrides(env):
+            fn = build_program("advance", cfg, 8)
+            jaxpr = jax.make_jaxpr(fn)(params_spec(), state_spec(b, h, w))
+        return str(jaxpr)
+
+    checks = {}
+
+    def check(name: str, ok: bool) -> None:
+        checks[name] = bool(ok)
+        print(f"{'ok' if ok else 'FAIL'}: {name}", file=sys.stderr)
+
+    # -- headline b=1 ------------------------------------------------------
+    t = advance_text(1, 2016, 2976, ())
+    check("headline_b1_resident_engages", "_resident_kernel" in t)
+    check("headline_b1_gru1632_engages", "_gru1632_kernel" in t)
+    t_off = advance_text(1, 2016, 2976, (("RAFT_FUSE_ITER", "0"),))
+    check("fuse_iter_off_disengages_resident",
+          "_resident_kernel" not in t_off)
+    check("fuse_iter_off_restores_standalone_lookup",
+          "_lookup_kernel" in t_off and "_gru_kernel" in t_off)
+    t_p8 = advance_text(1, 2016, 2976, (("RAFT_CORR_PACK8", "1"),))
+    check("pack8_changes_headline_program", t_p8 != t)
+    check("pack8_resident_still_engaged", "_resident_kernel" in t_p8)
+
+    # -- serve-batch bucket b=4/8 -----------------------------------------
+    for b in (4, 8):
+        tb = advance_text(b, 384, 1248, ())
+        check(f"serve_b{b}_resident_engages", "_resident_kernel" in tb)
+        tb_off = advance_text(b, 384, 1248, (("RAFT_STREAM_BATCH", "0"),))
+        check(f"serve_b{b}_stream_batch_off_runs_xla_twins",
+              "_resident_kernel" not in tb_off
+              and "_gru_kernel" not in tb_off
+              and "_gru1632_kernel" not in tb_off)
+        check(f"serve_b{b}_corr_kernel_stays_engaged_when_off",
+              "_lookup_kernel" in tb_off)
+
+    # -- int8 correlation DMA ratio at headline (analytic, exact) ---------
+    factor = cfg.downsample_factor
+    widths = level_widths(2976 // factor, cfg.corr_levels)
+    bf16_px = plan_dma_bytes(widths, True, False)
+    int8_px = plan_dma_bytes(widths, True, True)
+    ratio = int8_px / bf16_px
+    check("headline_int8_corr_dma_ratio_le_0.6", ratio <= 0.6)
+
+    ok = all(checks.values())
+    print(json.dumps({
+        "ok": ok,
+        "checks": checks,
+        "corr_dma_ratio_headline": round(ratio, 4),
+        "corr_dma_bf16_bytes_per_px": bf16_px,
+        "corr_dma_int8_bytes_per_px": int8_px,
+    }))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

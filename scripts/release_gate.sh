@@ -165,6 +165,33 @@ env JAX_PLATFORMS=cpu python -m pytest tests/test_cache.py -q -m cache \
     -p no:cacheprovider -p no:xdist -p no:randomly \
     || { echo "FAIL: response-cache battery"; fail=1; }
 
+# graftresident battery (ISSUE 15, DESIGN.md r19): the resident-iteration
+# bitwise pins (mega-kernel vs the serial fused composition), the int8
+# packed-correlation error-budget pins, and the B>1 streamed-kernel
+# parity battery (batch 4/8 bitwise vs the per-sample serial loop, odd
+# shapes, any_batch grads) — interpret mode on CPU, compiled twins via
+# RAFT_TEST_ONCHIP=1 in the on-chip battery below.
+step "resident-iteration battery (graftresident: resident/pack8/stream-batch pins)"
+env JAX_PLATFORMS=cpu python -m pytest tests/test_fused_stream.py \
+    tests/test_corr.py tests/test_batch_serve.py -q \
+    -k "resident or pack8 or stream_batch" \
+    -p no:cacheprovider -p no:xdist -p no:randomly \
+    || { echo "FAIL: resident-iteration battery"; fail=1; }
+
+# r19 engagement asserts (the PR 2 "provably engages" ceremony,
+# extended): trace the REAL serving advance program to a jaxpr at
+# headline (2016x2976 b=1) AND the serve-batch bucket (b=4/8) and assert
+# each new kernel is present by name — plus its kill switch provably
+# disengaging it — and the int8 correlation DMA ratio <= 0.6x bf16 at
+# headline (exact BlockSpec arithmetic; CPU-safe, nothing executes).
+step "r19 engagement asserts (resident/pack8/stream-batch at both geometries)"
+if env JAX_PLATFORMS=cpu python scratch/check_engagement.py > engagement.json; then
+    cat engagement.json
+else
+    echo "--- engagement.json ---"; cat engagement.json
+    echo "FAIL: r19 engagement asserts"; fail=1
+fi
+
 backend=$(python - <<'EOF'
 import jax
 print(jax.default_backend())

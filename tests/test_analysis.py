@@ -940,7 +940,7 @@ def test_real_tree_checks_are_not_vacuous():
     files = collect_files([str(PACKAGE)], base=str(REPO))
     proj = Project(files)
     ladder = proj.ladder()
-    assert ladder is not None and len(ladder) == 6
+    assert ladder is not None and len(ladder) == 9
     assert {r.name for r in ladder} >= {"corr_kernel", "fused_update"}
     fields = proj.config_fields()
     assert fields is not None and "corr_implementation" in fields
@@ -953,6 +953,7 @@ def test_real_tree_checks_are_not_vacuous():
         "raft_stereo_tpu/corr/pallas_alt.py",
         "raft_stereo_tpu/corr/pallas_reg.py",
         "raft_stereo_tpu/ops/pallas_encoder.py",
+        "raft_stereo_tpu/ops/pallas_resident.py",
         "raft_stereo_tpu/ops/pallas_stream.py",
     ]
 

@@ -508,3 +508,67 @@ def test_batched_service_stop_resolves_every_future(bsession, pairs):
         assert r["status"] in ("ok", "rejected")
         if r["status"] == "rejected":
             assert r["code"] in ("service_stopped", "not_running")
+
+
+# ---------------------------------------------------------------------------
+# r19 (graftresident): the scheduler's batched device calls ENGAGE the
+# streamed kernels (previously fenced to XLA twins by the 200k-pixel
+# heuristic) with responses unchanged vs the sequential path.
+
+
+def _drive_scheduler(session, pairs_, n):
+    out = []
+    sched = BatchScheduler(session,
+                           resolve=lambda req, resp: out.append(resp))
+    for i, p in enumerate(pairs_[:n]):
+        sched.submit(make_request(p, rid=i))
+    wait_uploaded(sched)
+    drive(sched, out, n)
+    return {r["id"]: r for r in out}
+
+
+def test_stream_batch_engaged_scheduler_parity(tiny_params, pairs,
+                                               monkeypatch):
+    """Batch-4 device calls with the streamed kernels ENGAGED (bf16 +
+    reg_tpu + the always-fuse override): the resident mega-kernel's
+    scheduler responses must be BITWISE identical to the serial fused
+    kernels' at the SAME batch bucket (the r19 bit-identity contract at
+    the serving layer — strict on every host: same-batch-width programs
+    share every XLA stage, so only the kernels differ and they are
+    pinned bitwise).
+
+    Cross-BATCH-SIZE comparisons (engaged b=4 vs sequential b=1) are NOT
+    pinned here in bf16: the b=1 and b=4 PREPARE programs differ at the
+    last bf16 ulp in container XLA:CPU builds and a random-init GRU
+    amplifies that chaotically per iteration (measured: the XLA twins
+    drift MORE than the engaged kernels) — the existing fp32 batch-parity
+    pins above stay the cross-batch-size contract, and they are
+    untouched by engagement (fp32 never fuses)."""
+    import jax.numpy as jnp
+
+    import raft_stereo_tpu.ops.pallas_stream as ps
+
+    monkeypatch.setenv("RAFT_BATCH_FUSE_PIXELS", "0")  # engage at tiny
+    cfg = RAFTStereoConfig(**{**TINY, "corr_implementation": "reg_tpu",
+                              "mixed_precision": True})
+    # Non-vacuity: at the padded 1/4-res geometry (64x64 -> 16x16) the
+    # batched hidden state must clear the engagement policy — otherwise
+    # this would compare two XLA-twin runs and prove nothing.
+    class _T:
+        shape = (4, 16, 16, TINY["hidden_dims"][2])
+        dtype = jnp.bfloat16
+    assert ps._batch_worthwhile(_T)
+    assert ps.gru_is_fusable(_T)
+
+    resident = _drive_scheduler(
+        make_session(tiny_params, cfg, max_batch=4), pairs, 3)
+    monkeypatch.setenv("RAFT_FUSE_ITER", "0")
+    serial = _drive_scheduler(
+        make_session(tiny_params, cfg, max_batch=4), pairs, 3)
+    for i in range(3):
+        assert resident[i]["status"] == "ok"
+        assert resident[i]["quality"] == "full"
+        assert resident[i]["disparity"].tobytes() == \
+            serial[i]["disparity"].tobytes(), (
+            f"request {i}: resident scheduler response differs from the "
+            "serial fused kernels at the same batch bucket")

@@ -451,3 +451,143 @@ def test_reg_tpu_packed_deep_level_grads_flow(rng):
         scale = np.abs(b_).max() + 1e-8
         assert np.abs(a_ - b_).max() / scale < 0.05, \
             np.abs(a_ - b_).max() / scale
+
+
+# ---------------------------------------------------------------------------
+# r19: int8 quad-packed correlation containers (RAFT_CORR_PACK8).
+
+
+def _pack8_case(rng, w=40, d=16, h=6, b=1):
+    f1 = jnp.asarray(rng.standard_normal((b, h, w, d)), jnp.bfloat16)
+    f2 = jnp.asarray(rng.standard_normal((b, h, w, d)), jnp.bfloat16)
+    coords_x = jnp.asarray(
+        rng.uniform(-4, w + 3, size=(b, h, w)).astype(np.float32))
+    return f1, f2, coords_x
+
+
+def test_pack8_error_budget_pinned(rng, monkeypatch):
+    """The r19 quantization error budget, pinned: per level the int8
+    lookup may differ from the exact bf16 lookup by at most ``scale/2``
+    (symmetric scheme, scale = amax/127 — the DESIGN.md r19 budget; the
+    lerp is a convex combination, so per-tap rounding error cannot
+    amplify)."""
+    from raft_stereo_tpu.corr.pallas_reg import (build_corr_operands,
+                                                 corr_fn_from_operands)
+    f1, f2, coords_x = _pack8_case(rng)
+    ref = make_corr_fn("reg_tpu", f1, f2, num_levels=LEVELS, radius=RADIUS,
+                       out_dtype=jnp.float32)(coords_x)
+    monkeypatch.setenv("RAFT_CORR_PACK8", "1")
+    ops = build_corr_operands(f1, f2, num_levels=LEVELS, radius=RADIUS,
+                              out_dtype=jnp.float32)
+    assert ops["pack8"] and ops["scales"] is not None
+    got = corr_fn_from_operands(ops)(coords_x)
+    k = 2 * RADIUS + 1
+    for lvl in range(LEVELS):
+        # Per-SAMPLE scales (B, 1, 1): each sample's error is bounded by
+        # its own scale/2; the per-sample max bound is exact.
+        scale = np.asarray(ops["scales"][lvl]).reshape(-1)
+        err = np.asarray(jnp.max(jnp.abs(
+            got[..., lvl * k:(lvl + 1) * k]
+            - ref[..., lvl * k:(lvl + 1) * k]), axis=(1, 2, 3)))
+        assert (err <= 0.5 * scale * (1 + 1e-4)).all(), (lvl, err, scale)
+    # Zero-pad semantics survive quantization exactly: far-out-of-range
+    # coords produce EXACT zeros (symmetric scheme: q==0 <-> 0.0).
+    far = jnp.full_like(coords_x, -1000.0)
+    assert float(jnp.max(jnp.abs(
+        corr_fn_from_operands(ops)(far)[..., :k]))) == 0.0
+
+
+def test_pack8_plan_layout_and_dma_ratio():
+    """pack_plan8's lane math: per-level segments at cumulative
+    pad128(w)/4 bases, container padded to whole vregs; the headline
+    int8/bf16 DMA ratio is the <= 0.6x acceptance number."""
+    from raft_stereo_tpu.corr.pallas_reg import (level_widths, pack_plan8,
+                                                 plan_dma_bytes)
+    widths = level_widths(744, 4)  # Middlebury-F 1/4-res
+    segs, total = pack_plan8(widths)
+    assert segs == [(0, 192), (192, 96), (288, 64), (352, 32)]
+    assert total == 384  # 3 whole slabs, zero pad bloat
+    ratio = plan_dma_bytes(widths, True, True) \
+        / plan_dma_bytes(widths, True, False)
+    assert ratio <= 0.6, ratio
+
+
+def test_pack8_gradients_identical_to_unpacked(rng, monkeypatch):
+    """STE backward: the containers carry zero cotangent and the shared
+    XLA-oracle backward reads the SAME bf16 rows — so fmap gradients are
+    bitwise identical with pack8 on vs off."""
+    f1, f2, coords_x = _pack8_case(rng)
+
+    def loss(a, bm):
+        fn = make_corr_fn("reg_tpu", a, bm, num_levels=LEVELS,
+                          radius=RADIUS, out_dtype=jnp.float32)
+        return jnp.sum(fn(coords_x))
+
+    g_off = jax.grad(loss, argnums=(0, 1))(f1, f2)
+    monkeypatch.setenv("RAFT_CORR_PACK8", "1")
+    g_on = jax.grad(loss, argnums=(0, 1))(f1, f2)
+    for a, b_ in zip(g_off, g_on):
+        assert np.asarray(a).tobytes() == np.asarray(b_).tobytes()
+
+
+def test_pack8_default_off_and_fp32_inert(rng, monkeypatch):
+    """Default env leaves the bf16 pair-pack plan untouched (bitwise),
+    and fp32 volumes never pack regardless of the switch."""
+    from raft_stereo_tpu.corr.pallas_reg import build_corr_operands
+    f1, f2, coords_x = _pack8_case(rng)
+    ops = build_corr_operands(f1, f2, num_levels=LEVELS, radius=RADIUS,
+                              out_dtype=jnp.float32)
+    assert not ops["pack8"]
+    monkeypatch.setenv("RAFT_CORR_PACK8", "1")
+    f1_32 = f1.astype(jnp.float32)
+    f2_32 = f2.astype(jnp.float32)
+    ops32 = build_corr_operands(f1_32, f2_32, num_levels=LEVELS,
+                                radius=RADIUS, out_dtype=jnp.float32)
+    assert not ops32["pack8"] and ops32["scales"] is None
+
+
+def test_pack8_odd_width_and_shallow_pyramid(rng, monkeypatch):
+    """Budget pin at an odd width (non-128-multiple padding, straddling
+    tap windows) and a 2-level pyramid — the pack plan must stay exact
+    for every lane layout."""
+    from raft_stereo_tpu.corr.pallas_reg import (build_corr_operands,
+                                                 corr_fn_from_operands)
+    f1, f2, coords_x = _pack8_case(rng, w=37, b=2)
+    ref = make_corr_fn("reg_tpu", f1, f2, num_levels=2, radius=3,
+                       out_dtype=jnp.float32)(coords_x)
+    monkeypatch.setenv("RAFT_CORR_PACK8", "1")
+    ops = build_corr_operands(f1, f2, num_levels=2, radius=3,
+                              out_dtype=jnp.float32)
+    got = corr_fn_from_operands(ops)(coords_x)
+    k = 7
+    for lvl in range(2):
+        scale = np.asarray(ops["scales"][lvl]).reshape(-1)
+        err = np.asarray(jnp.max(jnp.abs(
+            got[..., lvl * k:(lvl + 1) * k]
+            - ref[..., lvl * k:(lvl + 1) * k]), axis=(1, 2, 3)))
+        assert (err <= 0.5 * scale * (1 + 1e-4)).all(), (lvl, err, scale)
+
+
+def test_pack8_batched_rows_independent(rng, monkeypatch):
+    """Per-sample quantization scales: sample i's pack8 correlation must
+    be BITWISE independent of its batchmates (a whole-batch amax would
+    let one sample's content set another's quantization grid — breaking
+    the batched-rows == B=1 invariant and the response cache's
+    bit-identical-to-recompute contract; the review-round regression)."""
+    from raft_stereo_tpu.corr.pallas_reg import (build_corr_operands,
+                                                 corr_fn_from_operands)
+    monkeypatch.setenv("RAFT_CORR_PACK8", "1")
+    f1, f2, coords_x = _pack8_case(rng, b=2)
+    # Make sample 1 much higher-contrast so a batch-global amax would
+    # provably change sample 0's grid.
+    f1 = f1.at[1].multiply(17.0)
+    f2 = f2.at[1].multiply(17.0)
+    batched = corr_fn_from_operands(build_corr_operands(
+        f1, f2, num_levels=LEVELS, radius=RADIUS,
+        out_dtype=jnp.float32))(coords_x)
+    for i in range(2):
+        solo = corr_fn_from_operands(build_corr_operands(
+            f1[i:i + 1], f2[i:i + 1], num_levels=LEVELS, radius=RADIUS,
+            out_dtype=jnp.float32))(coords_x[i:i + 1])
+        assert np.asarray(batched[i:i + 1]).tobytes() == \
+            np.asarray(solo).tobytes(), f"row {i}"

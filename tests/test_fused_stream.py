@@ -632,3 +632,203 @@ def test_fused_train_grads_match_xla():
             continue  # insignificant leaf: noise-dominated
         cos = float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-12))
         assert cos > 0.98, (key, cos)
+
+
+# ---------------------------------------------------------------------------
+# r19: the resident iteration (ops/pallas_resident.py) + the B>1
+# stream-batch engagement policy.
+
+
+def _resident_case(key, B, hh, ww, ch, d, dtype, levels=4, radius=4):
+    from raft_stereo_tpu.corr.pallas_reg import build_corr_operands
+    cfg = RAFTStereoConfig(corr_levels=levels, corr_radius=radius)
+    ks = jax.random.split(key, 12)
+    f1 = jax.random.normal(ks[0], (B, hh, ww, d), dtype)
+    f2 = jax.random.normal(ks[1], (B, hh, ww, d), dtype)
+    ops = build_corr_operands(f1, f2, num_levels=levels, radius=radius,
+                              out_dtype=dtype)
+    coords_x = jax.random.uniform(ks[2], (B, hh, ww), jnp.float32) * ww
+    flow = jnp.concatenate(
+        [jax.random.normal(ks[3], (B, hh, ww, 1), dtype),
+         jnp.zeros((B, hh, ww, 1), dtype)], -1)
+    penc = init_motion_encoder(ks[4], cfg)
+    pgru = init_conv_gru(ks[5], ch, 128 + ch)
+    phead = init_flow_head(ks[6], ch, 64, 2)
+    h = jax.random.normal(ks[7], (B, hh, ww, ch), dtype) * 0.5
+    up = jax.random.normal(ks[8], (B, hh, ww, ch), dtype)
+    ctx = tuple(jax.random.normal(k, (B, hh, ww, ch), dtype) * 0.3
+                for k in ks[9:12])
+    czrq = prepare_gru_context(pgru, ctx, dtype)
+    return ops, coords_x, flow, penc, pgru, phead, h, up, czrq
+
+
+@pytest.mark.parametrize("B,hh,ww,pack8", [
+    (1, 16, 24, False),
+    (2, 8, 20, False),
+    (1, 8, 20, True),
+    (2, 16, 18, True),  # odd-ish width: straddling tap windows
+])
+def test_resident_iter_bitwise_vs_serial_composition(B, hh, ww, pack8,
+                                                     monkeypatch):
+    """The r19 acceptance pin: the resident mega-kernel is BITWISE equal
+    to the serial fused composition it replaces — standalone corr gather
+    -> fused_motion -> fused_gru_head — on the same containers (bf16
+    pair-packed and, when armed, int8 quad-packed)."""
+    from raft_stereo_tpu.corr.pallas_reg import corr_fn_from_operands
+    from raft_stereo_tpu.ops.pallas_resident import fused_iter_fwd_impl
+    if pack8:
+        monkeypatch.setenv("RAFT_CORR_PACK8", "1")
+    dtype = jnp.bfloat16
+    (ops, coords_x, flow, penc, pgru, phead, h, up,
+     czrq) = _resident_case(jax.random.PRNGKey(0), B, hh, ww, 32, 16,
+                            dtype)
+    assert ops["pack8"] == pack8
+    corr = corr_fn_from_operands(ops)(coords_x)
+    motion = fused_motion_fwd_impl(penc, flow, corr)
+    h_ref, dx_ref = fused_conv_gru_fwd_impl(pgru, h, czrq, motion, up,
+                                            head_p=phead)
+    h_got, dx_got = fused_iter_fwd_impl(penc, pgru, phead, ops, h, czrq,
+                                        coords_x, flow, up)
+    assert np.asarray(h_got).tobytes() == np.asarray(h_ref).tobytes()
+    assert np.asarray(dx_got).tobytes() == np.asarray(dx_ref).tobytes()
+
+
+def test_resident_batched_rows_match_per_sample(monkeypatch):
+    """B>1 resident runs restart cleanly per sample: batched rows are
+    BIT-equal to B=1 runs of the same rows (the r4 batched-kernel
+    invariant, extended to the mega-kernel)."""
+    from raft_stereo_tpu.ops.pallas_resident import fused_iter_fwd_impl
+    dtype = jnp.bfloat16
+    B = 4
+    (ops, coords_x, flow, penc, pgru, phead, h, up,
+     czrq) = _resident_case(jax.random.PRNGKey(1), B, 8, 20, 32, 16,
+                            dtype)
+    h_b, dx_b = fused_iter_fwd_impl(penc, pgru, phead, ops, h, czrq,
+                                    coords_x, flow, up)
+    for i in range(B):
+        # Per-sample operands by slicing the batch axis (rows of the
+        # volume operands are per-sample by construction).
+        sliced = dict(ops)
+        sliced["flat"] = [f[i:i + 1] for f in ops["flat"]]
+        sliced["kernel_ops"] = [kop[i:i + 1] for kop in ops["kernel_ops"]]
+        sliced["b"] = 1
+        h_1, dx_1 = fused_iter_fwd_impl(
+            penc, pgru, phead, sliced, h[i:i + 1], czrq[i:i + 1],
+            coords_x[i:i + 1], flow[i:i + 1], up[i:i + 1])
+        assert np.asarray(h_b[i:i + 1]).tobytes() == \
+            np.asarray(h_1).tobytes(), f"row {i}"
+        assert np.asarray(dx_b[i:i + 1]).tobytes() == \
+            np.asarray(dx_1).tobytes(), f"row {i}"
+
+
+def test_resident_forward_bitwise_vs_serial(monkeypatch):
+    """End-to-end: the test-mode forward with the resident iteration
+    engaged is bitwise equal to RAFT_FUSE_ITER=0 (the serial fused scan
+    body) — segment/epilogue pins cannot move."""
+    from raft_stereo_tpu.models import raft_stereo_forward
+    cfg = RAFTStereoConfig(corr_implementation="reg_tpu",
+                           mixed_precision=True)
+    params = init_raft_stereo(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(0)
+    i1 = jnp.asarray(rng.uniform(0, 255, (1, 64, 96, 3)), jnp.float32)
+    i2 = jnp.asarray(rng.uniform(0, 255, (1, 64, 96, 3)), jnp.float32)
+    monkeypatch.setenv("RAFT_FUSE_ITER", "0")
+    lo0, up0 = raft_stereo_forward(params, cfg, i1, i2, iters=2,
+                                   test_mode=True)
+    monkeypatch.setenv("RAFT_FUSE_ITER", "1")
+    lo1, up1 = raft_stereo_forward(params, cfg, i1, i2, iters=2,
+                                   test_mode=True)
+    assert np.asarray(lo0).tobytes() == np.asarray(lo1).tobytes()
+    assert np.asarray(up0).tobytes() == np.asarray(up1).tobytes()
+
+
+def test_stream_batch_policy(monkeypatch):
+    """The r19 engagement policy: B=1 unconditional; B>1 gated by the
+    kill switch and the ledger-derived crossover;
+    RAFT_BATCH_FUSE_PIXELS stays the explicit override."""
+    import raft_stereo_tpu.ops.pallas_stream as ps
+
+    class T:
+        def __init__(self, b, h, w):
+            self.shape = (b, h, w, 32)
+
+    monkeypatch.delenv("RAFT_BATCH_FUSE_PIXELS", raising=False)
+    monkeypatch.delenv("RAFT_STREAM_BATCH", raising=False)
+    xo = ps.stream_batch_crossover()
+    assert xo > 0
+    assert ps._batch_worthwhile(T(1, 2, 2))          # B=1 always
+    assert ps._batch_worthwhile(T(4, 96, 312))       # serve bucket 1/4-res
+    assert not ps._batch_worthwhile(T(16, 48, 156))  # r4 regression case
+    monkeypatch.setenv("RAFT_STREAM_BATCH", "0")     # kill switch
+    assert not ps._batch_worthwhile(T(4, 96, 312))
+    assert ps._batch_worthwhile(T(1, 2, 2))
+    monkeypatch.setenv("RAFT_STREAM_BATCH", "1")
+    monkeypatch.setenv("RAFT_BATCH_FUSE_PIXELS", "0")
+    assert ps._batch_worthwhile(T(16, 2, 2))         # explicit always-fuse
+    monkeypatch.setenv("RAFT_BATCH_FUSE_PIXELS", "1000000000")
+    assert not ps._batch_worthwhile(T(2, 504, 744))  # explicit never
+
+
+@pytest.mark.parametrize("B,h_,w_", [(4, 16, 24), (8, 8, 13)])
+def test_stream_batch_parity_b4_b8(B, h_, w_):
+    """Serve-batch geometry parity battery: B=4/8 streamed-kernel runs
+    are BIT-equal to the per-sample serial loop (odd widths included) —
+    what makes engaging the scheduler's device batches safe."""
+    ch = 32
+    key = jax.random.PRNGKey(2)
+    p = init_conv_gru(key, ch, 2 * ch)
+    hp = init_flow_head(jax.random.PRNGKey(9), ch, 64, 2)
+    ks = jax.random.split(key, 8)
+    h = jax.random.normal(ks[0], (B, h_, w_, ch)) * 0.5
+    xs = [jax.random.normal(k, (B, h_, w_, ch)) for k in ks[1:3]]
+    ctx = tuple(jax.random.normal(k, (B, h_, w_, ch)) * 0.3
+                for k in ks[3:6])
+    czrq = prepare_gru_context(p, ctx, jnp.float32)
+    got, dx = fused_conv_gru_fwd_impl(p, h, czrq, *xs, head_p=hp)
+    for b in range(B):
+        g1, d1 = fused_conv_gru_fwd_impl(
+            p, h[b:b + 1], czrq[b:b + 1], *[x[b:b + 1] for x in xs],
+            head_p=hp)
+        assert np.asarray(got[b:b + 1]).tobytes() == \
+            np.asarray(g1).tobytes(), f"row {b}"
+        assert np.asarray(dx[b:b + 1]).tobytes() == \
+            np.asarray(d1).tobytes(), f"row {b}"
+    cfg = RAFTStereoConfig()
+    pm = init_motion_encoder(key, cfg)
+    corr = jax.random.normal(key, (B, h_, w_, cfg.cor_planes))
+    flow = jax.random.normal(key, (B, h_, w_, 2)).at[..., 1].set(0.0)
+    gotm = fused_motion_fwd_impl(pm, flow, corr)
+    for b in range(B):
+        m1 = fused_motion_fwd_impl(pm, flow[b:b + 1], corr[b:b + 1])
+        assert np.asarray(gotm[b:b + 1]).tobytes() == \
+            np.asarray(m1).tobytes(), f"row {b}"
+
+
+def test_stream_batch_any_batch_grads_match_oracle():
+    """The any_batch TRAINING path at serve-like batch: custom_vjp grads
+    of the batched fused GRU equal the XLA oracle's (the backward IS the
+    oracle, so equality is exact up to dtype casts)."""
+    from raft_stereo_tpu.ops.pallas_stream import fused_conv_gru
+    ch, B = 16, 4
+    key = jax.random.PRNGKey(3)
+    p = init_conv_gru(key, ch, ch)
+    ks = jax.random.split(key, 6)
+    h = jax.random.normal(ks[0], (B, 16, 12, ch)) * 0.5
+    x = jax.random.normal(ks[1], (B, 16, 12, ch))
+    ctx = tuple(jax.random.normal(k, (B, 16, 12, ch)) * 0.3
+                for k in ks[2:5])
+    czrq = prepare_gru_context(p, ctx, jnp.float32)
+
+    def loss_fused(h, x):
+        return jnp.sum(fused_conv_gru(p, h, czrq, ctx, x) ** 2)
+
+    def loss_oracle(h, x):
+        return jnp.sum(apply_conv_gru(p, h, ctx, x) ** 2)
+
+    gf = jax.grad(loss_fused, argnums=(0, 1))(h, x)
+    # The fused forward is numerically equal (fp32 interpret) so the
+    # oracle-backward gradients must be tightly close to the pure-XLA
+    # gradient chain.
+    go = jax.grad(loss_oracle, argnums=(0, 1))(h, x)
+    for a, b_ in zip(gf, go):
+        assert float(jnp.max(jnp.abs(a - b_))) < 1e-3

@@ -288,7 +288,8 @@ def test_concurrent_first_requests_compile_once(tiny_params, tiny_cfg, pair):
 # Circuit breaker.
 
 
-LADDER_NAMES = ("fuse_gru1632", "stream_tail", "packed_l2", "corr_kernel",
+LADDER_NAMES = ("fuse_iter", "corr_pack8", "stream_batch", "fuse_gru1632",
+                "stream_tail", "packed_l2", "corr_kernel",
                 "fused_encoders", "fused_update")
 
 
@@ -296,8 +297,8 @@ def test_breaker_walks_ladder_to_plain_xla(tiny_params, pair):
     """Repeated unattributable compile failures trip every rung in order;
     the plain-XLA rebuild serves the request that triggered the walk."""
     cfg = RAFTStereoConfig(**{**TINY, "corr_implementation": "reg_tpu"})
-    plan = ServeFaultPlan(compile_errors={0: "oom", 1: "oom", 2: "oom",
-                                          3: "mosaic", 4: "oom", 5: "oom"})
+    plan = ServeFaultPlan(compile_errors={
+        i: ("mosaic" if i == 3 else "oom") for i in range(len(LADDER_NAMES))})
     sess = make_session(tiny_params, cfg, plan=plan)
     res = sess.infer(*pair)
     assert res.quality == "full"
@@ -306,10 +307,12 @@ def test_breaker_walks_ladder_to_plain_xla(tiny_params, pair):
     assert sess._run_cfg.corr_implementation == "reg"  # XLA twin
     assert sess._run_cfg.fused_update is False
     # every env-switched rung is exported off for subsequent traces
-    assert sess._env == {"RAFT_FUSE_GRU1632": "0", "RAFT_STREAM_TAIL": "0",
+    assert sess._env == {"RAFT_FUSE_ITER": "0", "RAFT_CORR_PACK8": "0",
+                         "RAFT_STREAM_BATCH": "0",
+                         "RAFT_FUSE_GRU1632": "0", "RAFT_STREAM_TAIL": "0",
                          "RAFT_PACKED_L2": "0", "RAFT_FUSED_ENCODERS": "0"}
     st = sess.breaker.status()
-    assert st["trip_count"] == 6 and st["exhausted"]
+    assert st["trip_count"] == len(LADDER_NAMES) and st["exhausted"]
     assert all(r["reason"] == "compile_failure"
                for r in st["tripped"].values())
 
@@ -323,10 +326,10 @@ def test_breaker_matcher_targets_rung(tiny_params, tiny_cfg, pair):
 
 def test_breaker_exhaustion_is_structured(tiny_params, tiny_cfg, pair):
     """Failures past the bottom rung surface as ladder_exhausted."""
-    # ordinals 0-5 trip the six rungs; ordinal 6 fails the plain-XLA
-    # build itself -> ladder_exhausted. Ordinal 7+ is clean.
+    # ordinals 0..len-1 trip every rung; the next ordinal fails the
+    # plain-XLA build itself -> ladder_exhausted. Later ordinals are clean.
     plan = ServeFaultPlan(
-        compile_errors={i: "oom" for i in range(7)})
+        compile_errors={i: "oom" for i in range(len(LADDER_NAMES) + 1)})
     sess = make_session(tiny_params, tiny_cfg, plan=plan)
     with pytest.raises(InferenceFailed) as ei:
         sess.infer(*pair)
@@ -344,8 +347,10 @@ def test_canary_catches_corrupted_kernel_output(tiny_params, tiny_cfg):
                         canary_shape=(32, 48), canary_iters=2)
     assert sess._canary_state == {
         "enabled": True, "ran": True, "passed": True, "attempts": 2}
-    assert sess.breaker.tripped_names == ("fuse_gru1632",)
-    assert sess.breaker.status()["tripped"]["fuse_gru1632"]["reason"] == \
+    # An unattributable canary mismatch trips the FIRST untripped rung
+    # in ladder order — fuse_iter since r19 led the ladder.
+    assert sess.breaker.tripped_names == ("fuse_iter",)
+    assert sess.breaker.status()["tripped"]["fuse_iter"]["reason"] == \
         "canary_mismatch"
 
 
@@ -579,9 +584,9 @@ def test_fault_storm(tiny_params):
     cfg = RAFTStereoConfig(**{**TINY, "corr_implementation": "reg_tpu"})
     clk = FakeClock()
     plan = ServeFaultPlan(
-        # builds 0-5: the first request's program walks the whole ladder
-        compile_errors={0: "oom", 1: "mosaic", 2: "oom", 3: "oom",
-                        4: "oom", 5: "oom"},
+        # first builds: the first request's program walks the whole ladder
+        compile_errors={i: ("mosaic" if i == 1 else "oom")
+                        for i in range(len(LADDER_NAMES))},
         # ordinal 0: request 1's forward; 1-3: request 3's prepare/segments
         slow_forwards={2: 100.0},
     )
@@ -640,7 +645,7 @@ def test_fault_storm(tiny_params):
     st = svc.status()
     assert st["requests"]["ok"] == 4
     assert st["requests"]["degraded"] == 1
-    assert st["session"]["breaker"]["trip_count"] == 6
+    assert st["session"]["breaker"]["trip_count"] == len(LADDER_NAMES)
     assert st["session"]["counts"]["requests_ok"] == 4
 
 
