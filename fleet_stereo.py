@@ -1,0 +1,166 @@
+#!/usr/bin/env python
+"""graftfleet CLI — N supervised ``serve_stereo`` instances behind one
+router (DESIGN.md "Fleet operations (r20)").
+
+Usage:
+
+    # four instances, shared warm-state dir, fleet port 8080
+    python fleet_stereo.py --instances 4 --fleet_port 8080 \
+        --cache_dir /var/tmp/raft-cache -- \
+        --restore_ckpt ckpt.npz --max_batch 8 --warmup 544x960
+
+Everything after ``--`` is passed verbatim to every instance's
+``serve_stereo.py`` launch (the per-instance model/serving recipe); the
+flags before it shape the FLEET.  Each instance binds ``--http_port 0``
+and hands its port back through the ``RAFT_HTTP_PORT=<n>`` stdout
+handshake; clients talk only to the fleet port:
+
+    POST /v1/stereo      — routed to the healthiest instance
+                           (headroom-weighted; X-Raft-Session pinned)
+    GET  /fleet/healthz  — aggregated fleet health + the router's books
+    GET  /fleet/metrics  — raft_fleet_* counters (Prometheus text)
+
+Operations:
+
+- SIGHUP triggers a zero-downtime rolling deploy (relaunch every slot
+  on the current recipe — the upgrade path after swapping a checkpoint
+  file or env);
+- SIGTERM/SIGINT drains every instance under RAFT_DRAIN_GRACE_MS and
+  exits 0 (second signal: default disposition, immediate);
+- a killed/crashed/hung instance is replaced automatically under
+  RAFT_FLEET_RESTART_BUDGET per slot.
+
+Event lines on stdout are single JSON objects (the serve_stereo.py
+convention), plus this CLI's own ``RAFT_FLEET_PORT=<n>`` handshake for
+supervisors-of-supervisors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        description="fleet supervisor for serve_stereo instances",
+        epilog="arguments after -- are passed to every instance's "
+               "serve_stereo.py")
+    parser.add_argument("--instances", type=int, default=None,
+                        help="fleet width (default RAFT_FLEET_INSTANCES "
+                        "or 2)")
+    parser.add_argument("--fleet_port", type=int, default=0,
+                        help="fleet ingress port (default 0 = "
+                        "ephemeral, reported via RAFT_FLEET_PORT=<n>)")
+    parser.add_argument("--fleet_host", default="127.0.0.1",
+                        help="fleet ingress bind address (default "
+                        "loopback; widen to 0.0.0.0 deliberately)")
+    parser.add_argument("--cache_dir", default=None,
+                        help="shared RAFT_CACHE_DIR handed to every "
+                        "instance (incl. replacements) so the disk-"
+                        "spilled exact tier survives instance deaths")
+    parser.add_argument("--restart_budget", type=int, default=None,
+                        help="per-slot launch retries + replacements "
+                        "per generation (default "
+                        "RAFT_FLEET_RESTART_BUDGET or 3)")
+    parser.add_argument("--probe_ms", type=float, default=None,
+                        help="health-probe period, ms (default "
+                        "RAFT_FLEET_PROBE_MS or 500)")
+    parser.add_argument("--warmup_timeout_ms", type=float, default=None,
+                        help="per-launch readiness deadline, ms "
+                        "(default RAFT_FLEET_WARMUP_TIMEOUT_MS or "
+                        "600 s)")
+    parser.add_argument("--drain_grace_ms", type=float, default=None,
+                        help="SIGTERM drain grace per retiring "
+                        "instance (default RAFT_DRAIN_GRACE_MS or "
+                        "10 s; overrun escalates to SIGKILL, counted)")
+    return parser
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--" in argv:
+        split = argv.index("--")
+        fleet_argv, instance_args = argv[:split], argv[split + 1:]
+    else:
+        fleet_argv, instance_args = argv, []
+    args = build_parser().parse_args(fleet_argv)
+
+    from raft_stereo_tpu.serve.fleet import (FleetConfig, FleetFrontend,
+                                             FleetSupervisor)
+
+    supervisor = FleetSupervisor(FleetConfig(
+        instances=args.instances,
+        restart_budget=args.restart_budget,
+        probe_ms=args.probe_ms,
+        warmup_timeout_ms=args.warmup_timeout_ms,
+        drain_grace_ms=args.drain_grace_ms,
+        cache_dir=args.cache_dir,
+        instance_args=tuple(instance_args)))
+
+    stop_requested = threading.Event()
+    roll_requested = threading.Event()
+
+    def _request_stop(signum, frame):  # noqa: ARG001 — signal signature
+        if stop_requested.is_set():
+            signal.signal(signum, signal.SIG_DFL)
+            os.kill(os.getpid(), signum)
+            return
+        stop_requested.set()
+
+    def _request_roll(signum, frame):  # noqa: ARG001 — signal signature
+        roll_requested.set()
+
+    prev = {}
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            prev[sig] = signal.signal(sig, _request_stop)
+        except ValueError:
+            pass
+    try:
+        signal.signal(signal.SIGHUP, _request_roll)
+    except (ValueError, AttributeError):
+        pass
+
+    print(json.dumps({"event": "fleet_starting",
+                      "instances": supervisor.n,
+                      "instance_args": instance_args}), flush=True)
+    supervisor.start()
+    frontend = FleetFrontend(supervisor, host=args.fleet_host,
+                             port=args.fleet_port).start()
+    try:
+        print(json.dumps({
+            "event": "fleet_listening",
+            "endpoint": f"http://{frontend.host}:{frontend.port}",
+            "routes": ["POST /v1/stereo", "GET /fleet/healthz",
+                       "GET /fleet/metrics"],
+            "ready": int(supervisor.registry.value("raft_fleet_ready")),
+        }), flush=True)
+        print(f"RAFT_FLEET_PORT={frontend.port}", flush=True)
+        while not stop_requested.wait(0.2):
+            if roll_requested.is_set():
+                roll_requested.clear()
+                print(json.dumps({"event": "rolling_deploy",
+                                  "reason": "SIGHUP"}), flush=True)
+                report = supervisor.deploy()
+                print(json.dumps({"event": "rolled", **report}),
+                      flush=True)
+        print(json.dumps({"event": "fleet_draining",
+                          "reason": "signal received"}), flush=True)
+    finally:
+        frontend.stop()
+        supervisor.stop()
+        for sig, handler in prev.items():
+            signal.signal(sig, handler)
+    print(json.dumps({"event": "fleet_stopped",
+                      "status": supervisor.status()}, default=str),
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

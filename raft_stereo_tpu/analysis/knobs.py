@@ -196,6 +196,24 @@ HOST_ENV_KNOBS: Tuple[str, ...] = (
     "RAFT_CACHE_DIR",       # optional disk-spill directory for evicted
                             # exact-tier entries (serve/cache.py
                             # resolve_cache_dir, read at construction)
+    # graftfleet knobs (DESIGN.md r20, serve/fleet.py) — pure fleet
+    # topology read by the SUPERVISOR process: they size and pace a tree
+    # of subprocesses and never exist inside an instance, let alone a
+    # trace.  Instance-side behavior keeps riding its own knobs
+    # (RAFT_DRAIN_GRACE_MS, RAFT_CACHE_DIR ... forwarded verbatim).
+    "RAFT_FLEET_INSTANCES",  # fleet width (serve/fleet.py
+                            # resolve_fleet_instances, default 2)
+    "RAFT_FLEET_RESTART_BUDGET",  # per-slot launch retries +
+                            # death replacements per deploy generation
+                            # before the slot degrades (serve/fleet.py
+                            # resolve_fleet_restart_budget, default 3)
+    "RAFT_FLEET_PROBE_MS",  # health-probe period, ms; <= 0 disables the
+                            # background prober (serve/fleet.py
+                            # resolve_fleet_probe_ms, default 500)
+    "RAFT_FLEET_WARMUP_TIMEOUT_MS",  # readiness-handshake deadline per
+                            # launch attempt (serve/fleet.py
+                            # resolve_fleet_warmup_timeout_ms,
+                            # default 600 s)
 )
 
 

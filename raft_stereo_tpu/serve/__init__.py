@@ -40,12 +40,22 @@ Layers, bottom up:
 - ``http``      — graftwire frontend: stdlib HTTP/1.1 listener with
                   per-read socket timeouts, a hard content-length cap,
                   decode offload, per-tenant token-bucket quotas and
-                  real /healthz + /metrics endpoints.
+                  real /healthz + /metrics endpoints;
+- ``fleet``     — graftfleet: the fleet supervisor — N serve_stereo
+                  subprocess instances behind one router (headroom-
+                  weighted placement, session affinity with drain
+                  handoff, preemption-proof replacement, zero-downtime
+                  rolling deploys, /fleet/healthz + /fleet/metrics).
 
 Everything is CPU-testable with deterministic injected faults
 (``raft_stereo_tpu.faults.ServeFaultPlan``).
 """
 
+from raft_stereo_tpu.serve.fleet import (  # noqa: F401
+    FleetConfig,
+    FleetFrontend,
+    FleetSupervisor,
+)
 from raft_stereo_tpu.serve.guard import (  # noqa: F401
     DEFAULT_LADDER,
     FastPath,

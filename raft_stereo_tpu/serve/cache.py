@@ -61,6 +61,7 @@ Stdlib + numpy only, no jax — the cache is pure host state.
 from __future__ import annotations
 
 import hashlib
+import itertools
 import json
 import logging
 import os
@@ -618,6 +619,19 @@ class ResponseCache:
 
     # -- disk spill (RAFT_CACHE_DIR) ---------------------------------------
 
+    #: Per-process monotonic suffix for spill temp files.  Two caches
+    #: sharing one RAFT_CACHE_DIR (a fleet of instances, or two caches
+    #: in one process) may spill the SAME key concurrently; a fixed
+    #: "<path>.tmp" name would let writer B's open() truncate the file
+    #: writer A is mid-np.savez on, and A's os.replace would then
+    #: publish B's torn bytes under the final name.  pid + counter makes
+    #: every tmp name unique, so each os.replace publishes only its own
+    #: complete payload (last full write wins — both are valid entries
+    #: for the same key).  Deliberately NOT ending in ".npz": the disk
+    #: accounting scans and _prune_disk must never count or load an
+    #: in-progress tmp.
+    _TMP_SEQ = itertools.count()
+
     def _path_for(self, key: Tuple) -> str:
         name = hashlib.sha256(repr(key).encode()).hexdigest()
         return os.path.join(self.dir, f"{name}.npz")
@@ -628,7 +642,7 @@ class ResponseCache:
         failures disable nothing — the entry is simply gone, a miss."""
         path = self._path_for(entry.key)
         try:
-            tmp = path + ".tmp"
+            tmp = f"{path}.{os.getpid()}.{next(self._TMP_SEQ)}.tmp"
             payload: Dict[str, np.ndarray] = {
                 "disparity": entry.disparity,
                 "sig": entry.sig,

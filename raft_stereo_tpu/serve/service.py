@@ -182,6 +182,11 @@ class StereoService:
             "max_queue)")
         self._lock = threading.Lock()
         self._started = False
+        # graftfleet: /healthz carries generation identity + age at the
+        # top level so a fleet router can detect deploy-generation
+        # membership and restarts from the ONE endpoint it already
+        # polls (fingerprint otherwise lives only on /debug/config).
+        self._born = self.session.clock.now()
         # graftguard (serve/supervise.py): generation counter, drain
         # flag, retry budget, watchdog config. The scheduler generation
         # is bounced (fresh scheduler + thread, rows re-admitted) by the
@@ -1294,6 +1299,11 @@ class StereoService:
             return None if v is None else v * 1e3
 
         return {
+            # graftfleet: generation identity + age, top-level — the
+            # fleet router keys rolling deploys on fingerprint_id and
+            # detects silent restarts from uptime_s going backwards.
+            "fingerprint_id": self.session.fingerprint_id(),
+            "uptime_s": self.session.clock.now() - self._born,
             "queue": {"depth": self._queue.qsize(),
                       "max": self.cfg.max_queue,
                       "workers": (1 if self._batched

@@ -1,0 +1,311 @@
+"""Release-gate fleet chaos storm (graftfleet, DESIGN.md r20) against
+REAL ``serve_stereo.py`` subprocesses.
+
+Stands up a 2-instance fleet (tiny random-weight model — wiring, not
+quality) behind the in-process :class:`FleetSupervisor` +
+:class:`FleetFrontend`, then pushes mixed traffic through the fleet
+ingress while the two advertised failure drills fire mid-storm:
+
+1. ``kill -9`` of the instance currently PINNED to the storm's stream
+   session — the very next frames must be answered structurally (the
+   router's one-peer retry), the session must resume WARM on the
+   surviving instance, and the supervisor must replace the corpse under
+   the restart budget;
+2. a rolling deploy that changes ``--valid_iters`` (and therefore the
+   run fingerprint) WHILE a background traffic thread keeps posting —
+   generation must advance with zero unstructured responses and the
+   stream session must hand off to the new generation warm.
+
+The storm then quiesces and settles the books: for every live instance,
+the fleet router's ``answered`` count for that uid must EXACTLY equal
+the instance's own ``raft_requests_total`` disposition sum from its
+``/healthz`` (the ``degraded`` key is excluded — service._count rides
+it ALONGSIDE the disposition key for any non-"full" quality answer, so
+summing it would double-count cache hits and warm frames).
+
+One JSON line on stdout (bench.py's contract), exit 0/1.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+H, W = 40, 60
+
+#: Tiny random-weight instance recipe (same as check_debug_endpoints).
+INSTANCE_ARGS = (
+    "--no_canary", "--max_batch", "2",
+    "--valid_iters", "2", "--segments", "2",
+    "--n_gru_layers", "1", "--hidden_dims", "32", "32", "32",
+    "--corr_levels", "2", "--corr_radius", "2",
+    "--corr_implementation", "reg",
+)
+#: The rolling-deploy recipe: --corr_radius 2 -> 1 is a MODEL-config
+#: change, so it lands in config_fingerprint (valid_iters would not —
+#: per-request iteration counts ride the cache key, not the
+#: fingerprint) and the roll is visible as a fingerprint_id flip on
+#: /healthz.
+ROLLED_ARGS = tuple("1" if INSTANCE_ARGS[i - 1] == "--corr_radius"
+                    else a for i, a in enumerate(INSTANCE_ARGS))
+
+STREAM_SESSION = "storm-cam"
+
+
+def main() -> int:
+    import numpy as np
+
+    from raft_stereo_tpu.serve import wire
+    from raft_stereo_tpu.serve.fleet import (FleetConfig, FleetFrontend,
+                                             FleetSupervisor)
+
+    rng = np.random.default_rng(0)
+    left = rng.uniform(0, 255, (H, W, 3)).astype(np.uint8)
+    right = rng.uniform(0, 255, (H, W, 3)).astype(np.uint8)
+    seq = iter(range(1, 1 << 20))
+
+    def frame_body(fid, l_arr):
+        return wire.build_multipart(
+            {"left": wire.encode_image_png(l_arr),
+             "right": wire.encode_image_png(right),
+             "id": fid.encode()})
+
+    def perturbed():
+        # Every perturbed frame is DISTINCT bytes (instances share one
+        # RAFT_CACHE_DIR — a repeated body would exact-hit instead of
+        # exercising the warm-join path).
+        noise = np.random.default_rng(1000 + next(seq)).integers(
+            -2, 3, left.shape)
+        return np.clip(left.astype(np.int16) + noise,
+                       0, 255).astype(np.uint8)
+
+    ledger = []
+    ledger_lock = threading.Lock()
+
+    cache_dir = tempfile.mkdtemp(prefix="chaos-fleet-cache-")
+    cfg = FleetConfig(
+        instances=2, restart_budget=3, probe_ms=200.0,
+        warmup_timeout_ms=600_000.0, drain_grace_ms=120_000.0,
+        instance_args=INSTANCE_ARGS,
+        instance_env={"JAX_PLATFORMS": "cpu"},
+        cache_dir=cache_dir)
+
+    with FleetSupervisor(cfg) as sup, FleetFrontend(sup) as fe:
+        endpoint = f"http://{fe.host}:{fe.port}"
+
+        from urllib.error import HTTPError
+        from urllib.request import Request, urlopen
+
+        def post(kind, body_pair, session=None):
+            """One storm request through the fleet ingress.  Returns the
+            decoded response and appends a ledger row; an UNstructured
+            response (non-JSON error body, dangling socket) is the storm
+            failure the gate exists to catch."""
+            ct, body = body_pair
+            headers = {"Content-Type": ct, "X-Raft-Tenant": "storm"}
+            if session:
+                headers["X-Raft-Session"] = session
+            req = Request(endpoint + "/v1/stereo", data=body,
+                          method="POST", headers=headers)
+            structured, doc, status = False, None, None
+            try:
+                try:
+                    with urlopen(req, timeout=600) as resp:
+                        status, raw = resp.status, resp.read()
+                except HTTPError as e:
+                    status, raw = e.code, e.read()
+                if status == 200:
+                    doc = wire.decode_response(raw)
+                    structured = doc.get("status") == "ok"
+                else:
+                    doc = json.loads(raw.decode())
+                    structured = (doc.get("status") in
+                                  ("rejected", "error")
+                                  and "code" in doc)
+            except Exception as e:  # noqa: BLE001 — the ledger judges
+                doc = {"transport_error": repr(e)}
+            with ledger_lock:
+                ledger.append({"kind": kind, "status": status,
+                               "structured": structured,
+                               "ok": bool(doc) and
+                               doc.get("status") == "ok"})
+            return doc
+
+        def pinned_instance():
+            # Internal read on purpose: the gate pins the HANDOFF
+            # contract, which lives in the affinity table.
+            uid = sup._affinity.get(STREAM_SESSION)
+            for inst in sup._slots:
+                if inst is not None and inst.uid == uid:
+                    return inst
+            return None
+
+        def settle(want_ready, timeout_s=600.0):
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                sup.poke()
+                doc = sup.status()
+                if (doc["states"].get("ready", 0) == want_ready
+                        and doc["degraded_slots"] == 0):
+                    return doc
+                time.sleep(0.2)
+            raise AssertionError(
+                f"fleet did not settle to {want_ready} ready instances")
+
+        # -- phase 1: mixed warm traffic ------------------------------
+        cold0 = frame_body("storm-cold-0", perturbed())
+        assert post("cold", cold0)["status"] == "ok"
+        assert post("cold", frame_body("storm-cold-1",
+                                       perturbed()))["status"] == "ok"
+        for i in range(3):
+            r = post("stream", frame_body(f"storm-s{i}", perturbed()),
+                     session=STREAM_SESSION)
+            assert r["status"] == "ok", r
+        # dup: exact repeat of cold0's bytes (the graftrecall tier rides
+        # the shared cache dir; the gate only requires a structured ok).
+        assert post("dup", cold0)["status"] == "ok"
+
+        doc = settle(want_ready=2)
+        fp_before = doc["fingerprints"]
+        assert len(fp_before) == 1, fp_before
+
+        # -- phase 2: kill -9 the pinned instance ---------------------
+        victim = pinned_instance()
+        assert victim is not None, "stream session never pinned"
+        # Raw SIGKILL on the child — NOT FleetInstance.kill(), which
+        # would tidy the supervisor's own state first.  The router must
+        # discover the corpse the hard way (connection refused).
+        victim.proc.kill()
+        victim.proc.wait(timeout=30)
+        # The very next frames must be answered structurally through
+        # the surviving peer (router retry), session re-pinned.
+        for i in range(2):
+            r = post("stream", frame_body(f"storm-k{i}", perturbed()),
+                     session=STREAM_SESSION)
+            assert r["status"] == "ok", r
+        assert post("cold", frame_body("storm-cold-2",
+                                       perturbed()))["status"] == "ok"
+        survivor = pinned_instance()
+        assert survivor is not None and survivor.uid != victim.uid
+        # Zero dropped sessions: the session resumed WARM on the peer.
+        sup.poke()
+        sdoc = survivor.last_doc
+        assert sdoc["stream"]["sessions"] >= 1, sdoc["stream"]
+        assert sdoc["stream"]["warm_joins"] >= 1, sdoc["stream"]
+        # The supervisor replaces the corpse under the restart budget.
+        doc = settle(want_ready=2)
+        assert doc["counters"]["restarts_total"] >= 1, doc["counters"]
+
+        # -- phase 3: rolling deploy under live traffic ---------------
+        stop = threading.Event()
+
+        def background_traffic():
+            i = 0
+            while not stop.is_set():
+                post("roll-cold", frame_body(f"storm-r{next(seq)}",
+                                             perturbed()))
+                if i % 2 == 0:
+                    post("roll-stream",
+                         frame_body(f"storm-rs{next(seq)}", perturbed()),
+                         session=STREAM_SESSION)
+                i += 1
+
+        storm = threading.Thread(target=background_traffic,
+                                 name="chaos-storm-traffic")
+        storm.start()
+        try:
+            report = sup.deploy(instance_args=ROLLED_ARGS)
+        finally:
+            stop.set()
+            storm.join(timeout=600)
+        assert not storm.is_alive(), "storm traffic thread wedged"
+        assert report["completed"], report
+        assert report["generation"] == 2, report
+        assert all(s["rolled"] for s in report["slots"]), report
+
+        # Fingerprint flipped fleet-wide; old generation fully drained.
+        doc = settle(want_ready=2)
+        fp_after = doc["fingerprints"]
+        assert len(fp_after) == 1, fp_after
+        assert fp_after != fp_before, (fp_before, fp_after)
+        assert doc["counters"]["draining_total"] >= 2, doc["counters"]
+
+        # The stream session survived the roll too — warm on gen 2.
+        for i in range(2):
+            r = post("stream", frame_body(f"storm-g2-{i}", perturbed()),
+                     session=STREAM_SESSION)
+            assert r["status"] == "ok", r
+        sup.poke()
+        pinned = pinned_instance()
+        assert pinned is not None, "session lost across the roll"
+        pdoc = pinned.last_doc
+        assert pdoc["fingerprint_id"] == fp_after[0], pdoc
+        assert pdoc["stream"]["warm_joins"] >= 1, pdoc["stream"]
+
+        # -- phase 4: quiesce and settle the books --------------------
+        # Every storm response was read to completion above, so the
+        # router's `answered` is final; one probe pass refreshes each
+        # instance's own /healthz document.
+        sup.poke()
+        final = sup.status()
+        books = final["books"]
+        reconciliation = {}
+        for row in final["by_instance"]:
+            if row["state"] != "ready":
+                continue
+            reqs = row.get("requests", {})
+            # `degraded` rides ALONGSIDE the disposition key (see
+            # module docstring) — exclude it from the disposition sum.
+            instance_total = sum(n for k, n in reqs.items()
+                                 if k != "degraded")
+            fleet_answered = books[row["uid"]]["answered"]
+            reconciliation[row["uid"]] = {
+                "instance_requests_total": instance_total,
+                "fleet_answered": fleet_answered}
+            assert instance_total == fleet_answered, (
+                f"books for {row['uid']} do not reconcile: instance "
+                f"counted {instance_total}, router answered "
+                f"{fleet_answered}")
+        assert reconciliation, "no live instances to reconcile"
+
+        with ledger_lock:
+            total = len(ledger)
+            unstructured = [r for r in ledger if not r["structured"]]
+            ok = sum(1 for r in ledger if r["ok"])
+        assert total >= 12, f"storm too small ({total} requests)"
+        assert not unstructured, (
+            f"{len(unstructured)}/{total} responses were not "
+            f"structured: {unstructured[:5]}")
+
+        counters = final["counters"]
+
+    print(json.dumps({
+        "metric": "chaos_fleet",
+        "pass": True,
+        "requests": {"total": total, "ok": ok,
+                     "structured": total - len(unstructured)},
+        "kill": {"victim": victim.uid,
+                 "restarts_total": counters["restarts_total"]},
+        "deploy": {"completed": True, "generation": 2,
+                   "fingerprint_before": fp_before[0],
+                   "fingerprint_after": fp_after[0]},
+        "reconciliation": reconciliation,
+        "counters": counters,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        raise SystemExit(main())
+    except AssertionError as e:
+        print(json.dumps({"metric": "chaos_fleet", "pass": False,
+                          "error": str(e)}))
+        raise SystemExit(1)

@@ -227,6 +227,18 @@ def main() -> int:
         health = docs["/healthz"]
         assert "capacity" in health and "by_bucket" in health["capacity"]
 
+        # /healthz identity block (graftfleet, DESIGN.md r20): the fleet
+        # supervisor routes on these two top-level fields, so they are
+        # pinned at the live wire — fingerprint_id must be the same id
+        # /debug/config reports (a rolling deploy is detected by this
+        # value changing across instances) and uptime_s must be a fresh
+        # nonnegative monotonic age.
+        assert health["fingerprint_id"] == config["fingerprint"], health
+        assert isinstance(health["fingerprint_id"], str)
+        assert len(health["fingerprint_id"]) == 12
+        assert isinstance(health["uptime_s"], float)
+        assert health["uptime_s"] >= 0.0, health
+
         proc.send_signal(signal.SIGTERM)
         # communicate(), not wait(): the CLI prints its final /healthz
         # status document on drain, and an unread pipe could wedge it.

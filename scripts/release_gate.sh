@@ -192,6 +192,35 @@ else
     echo "FAIL: r19 engagement asserts"; fail=1
 fi
 
+# graftfleet battery (ISSUE 16, DESIGN.md r20): the fleet supervisor
+# lifecycle against stub instances (tests/fleet_stub.py speaks the
+# handshake + /healthz schema in milliseconds) — launch/probe/route
+# discipline, headroom weights + saturation backpressure, session
+# handoff, kill -9 failover under the restart budget, warmup-death
+# retries, budget-exhaustion degradation, SIGKILL drain escalation,
+# rolling deploys (and the abort-keeps-old path), the /fleet rollup
+# rules, and the RAFT_FLEET_* knob contract.
+step "fleet battery (graftfleet: supervisor, routing, deploys, budgets)"
+env JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q -m fleet \
+    -p no:cacheprovider -p no:xdist -p no:randomly \
+    || { echo "FAIL: fleet battery"; fail=1; }
+
+# Fleet chaos storm (ISSUE 16 acceptance): 2 REAL serve_stereo.py
+# subprocess instances (tiny model) behind the fleet router under mixed
+# cold/stream/dup traffic, with a kill -9 of the stream-pinned instance
+# AND a fingerprint-changing rolling deploy mid-storm. Asserts: 100%
+# structured responses, zero dropped stream sessions (warm joins resume
+# on the surviving/new instance), generation + fingerprint advanced,
+# and the router's per-instance books reconciling EXACTLY with each
+# instance's own raft_requests_total. One JSON verdict line.
+step "fleet chaos storm (kill -9 + rolling deploy over real instances)"
+if env JAX_PLATFORMS=cpu python scratch/chaos_fleet.py > chaos_fleet.json; then
+    cat chaos_fleet.json
+else
+    echo "--- chaos_fleet.json ---"; cat chaos_fleet.json
+    echo "FAIL: fleet chaos storm"; fail=1
+fi
+
 backend=$(python - <<'EOF'
 import jax
 print(jax.default_backend())

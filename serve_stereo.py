@@ -179,6 +179,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "acceptor threads; batch mode prefetches file "
                         "decode ahead of admission (decode is ~33 "
                         "ms/sample and caps the host path)")
+    # graftfleet: supervisor readiness handshake (DESIGN.md r20)
+    parser.add_argument('--ready_fd', type=int, default=None,
+                        help="inherited file descriptor to write the "
+                        "RAFT_HTTP_PORT=<n> readiness handshake to "
+                        "(then closed) — lets a fleet supervisor await "
+                        "readiness via a pipe instead of parsing "
+                        "stdout; the same line always goes to stdout "
+                        "too (HTTP mode only)")
     add_model_args(parser)
     return parser
 
@@ -366,6 +374,24 @@ def serve(args) -> int:
             "endpoint": f"http://{frontend.host}:{frontend.port}",
             "routes": ["POST /v1/stereo", "GET /healthz", "GET /metrics"],
         }), flush=True)
+        # graftfleet readiness handshake: ONE machine-parseable line on
+        # stdout, printed only here — after warmup compiles, after the
+        # listener is accepting — so a supervisor that reads it can
+        # route traffic immediately.  --ready_fd gets the same line on
+        # an inherited pipe (write+close; EOF doubles as a liveness
+        # signal), sparing the supervisor a stdout parse.  flush=True
+        # everywhere: a block-buffered pipe would hold the handshake
+        # hostage until the 4 KiB stdio buffer fills.
+        handshake = f"RAFT_HTTP_PORT={frontend.port}\n"
+        print(handshake, end="", flush=True)
+        if args.ready_fd is not None:
+            try:
+                os.write(args.ready_fd, handshake.encode())
+                os.close(args.ready_fd)
+            except OSError:
+                # A supervisor that died between fork and handshake is
+                # its problem; the instance serves regardless.
+                pass
         try:
             while not stop_requested.wait(0.2):
                 pass

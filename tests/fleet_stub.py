@@ -1,0 +1,139 @@
+"""Stub serve instance for the graftfleet tier-1 battery.
+
+Speaks exactly the two surfaces the fleet supervisor consumes — the
+``RAFT_HTTP_PORT=<n>`` stdout readiness handshake and the ``/healthz``
+health-document schema — in milliseconds instead of the real
+``serve_stereo.py``'s model-compile seconds, so the supervisor's whole
+lifecycle (launch, probe, route, drain, replace, roll) is testable
+inside the tier-1 budget.  Only the release gate
+(``scratch/chaos_fleet.py``) pays for real instances.
+
+Behaviors are argv-driven (the fleet's ``FleetConfig.command`` factory
+builds per-slot/per-generation argv, so tests steer each launch):
+
+    --fingerprint <id>       fingerprint_id reported on /healthz
+    --headroom <rps>         capacity headroom_rps advertised
+    --saturation <ratio>     capacity saturation ratio advertised
+    --die-before-ready <f>   countdown file: while its integer is > 0,
+                             decrement and exit(3) BEFORE the handshake
+                             (the died-during-warmup satellite case —
+                             the count survives relaunches)
+    --ignore-term            mask SIGTERM (forces the supervisor's
+                             SIGKILL drain escalation)
+    --sick-after <n>         after n served requests, report the
+                             scheduler heartbeat dead (the PR 9
+                             watchdog surface of a hung instance)
+    --warmup-s <s>           sleep before the handshake
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--fingerprint", default="stub-fp")
+    parser.add_argument("--headroom", type=float, default=10.0)
+    parser.add_argument("--saturation", type=float, default=0.0)
+    parser.add_argument("--die-before-ready", default=None)
+    parser.add_argument("--ignore-term", action="store_true")
+    parser.add_argument("--sick-after", type=int, default=None)
+    parser.add_argument("--warmup-s", type=float, default=0.0)
+    args = parser.parse_args()
+
+    if args.die_before_ready:
+        try:
+            with open(args.die_before_ready) as f:
+                remaining = int(f.read().strip() or "0")
+        except OSError:
+            remaining = 0
+        if remaining > 0:
+            with open(args.die_before_ready, "w") as f:
+                f.write(str(remaining - 1))
+            return 3
+
+    if args.ignore_term:
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)
+
+    born = time.monotonic()
+    state = {"ok": 0}
+    lock = threading.Lock()
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *a):  # noqa: A003 — stdlib signature
+            pass
+
+        def _send(self, status, doc):
+            body = json.dumps(doc).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 — stdlib handler naming
+            if self.path.split("?", 1)[0] != "/healthz":
+                return self._send(404, {"status": "rejected",
+                                        "code": "not_found"})
+            with lock:
+                served = state["ok"]
+            sick = (args.sick_after is not None
+                    and served >= args.sick_after)
+            self._send(200, {
+                "fingerprint_id": args.fingerprint,
+                "uptime_s": time.monotonic() - born,
+                "requests": {"ok": served},
+                "stream": {"sessions": 0},
+                "cache": {"entries": 0},
+                "supervision": {"heartbeats": {
+                    "scheduler_alive": not sick,
+                    "scheduler_died": ("stub sick" if sick else None),
+                }},
+                "capacity": {
+                    "by_bucket": {"64x64": {
+                        "headroom_rps": args.headroom}},
+                    "saturation": {"ratio": args.saturation},
+                },
+            })
+
+        def do_POST(self):  # noqa: N802 — stdlib handler naming
+            length = int(self.headers.get("Content-Length", "0"))
+            body = self.rfile.read(length)
+            if self.path.split("?", 1)[0] != "/v1/stereo":
+                return self._send(404, {"status": "rejected",
+                                        "code": "not_found"})
+            with lock:
+                state["ok"] += 1
+            self._send(200, {
+                "status": "ok",
+                "fingerprint_id": args.fingerprint,
+                "session": self.headers.get("X-Raft-Session"),
+                "bytes_in": len(body),
+            })
+
+    class Server(ThreadingHTTPServer):
+        daemon_threads = True
+        allow_reuse_address = True
+
+    server = Server(("127.0.0.1", 0), Handler)
+    if args.warmup_s > 0:
+        time.sleep(args.warmup_s)
+    print(f"RAFT_HTTP_PORT={server.server_address[1]}", flush=True)
+    try:
+        server.serve_forever(poll_interval=0.05)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

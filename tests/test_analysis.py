@@ -1042,3 +1042,20 @@ def test_lint_sh_clean_and_injected_violation(tmp_path):
 def test_release_gate_runs_lint_step():
     gate = (REPO / "scripts" / "release_gate.sh").read_text()
     assert "lint.sh" in gate and "graftlint" in gate
+
+
+def test_gl002_real_tree_fleet_knob_registered():
+    # RAFT_FLEET_RESTART_BUDGET (serve/fleet.py
+    # resolve_fleet_restart_budget, the per-slot replacement allowance)
+    # is covered by HOST_ENV_KNOBS; drop it and GL002 must fire at the
+    # read site — the r20 fleet-supervisor knobs cannot silently drift
+    # out of the registry (the drop leaves RAFT_FLEET_INSTANCES /
+    # RAFT_FLEET_PROBE_MS / RAFT_FLEET_WARMUP_TIMEOUT_MS covered so the
+    # hit is unambiguous).
+    files = collect_files([str(PACKAGE)], base=str(REPO))
+    reduced = tuple(k for k in knobs.SERVE_ENV_KNOBS + knobs.HOST_ENV_KNOBS
+                    if k != "RAFT_FLEET_RESTART_BUDGET")
+    rep = run_checkers(Project(files, serve_knobs=reduced))
+    hits = [f for f in rep.findings if f.code == "GL002"]
+    assert hits and "RAFT_FLEET_RESTART_BUDGET" in hits[0].message
+    assert hits[0].path.endswith("serve/fleet.py")

@@ -664,3 +664,118 @@ def test_gl002_sensitivity_env_reads_are_literal():
     for knob in ("RAFT_CACHE_BYTES", "RAFT_CACHE_TTL_MS",
                  "RAFT_CACHE_NEAR_TOL", "RAFT_CACHE_DIR"):
         assert f'os.environ.get("{knob}"' in src, knob
+
+
+# ---------------------------------------------------------------------------
+# Concurrent-writer safety (graftfleet r20): two instances sharing one
+# RAFT_CACHE_DIR must never publish a torn entry.
+# ---------------------------------------------------------------------------
+
+
+def test_spill_tmp_names_unique_per_writer(tiny_params, tiny_cfg,
+                                           tmp_path, monkeypatch):
+    """The atomic tmp+rename path must use a UNIQUE tmp name per writer:
+    with the old fixed "<path>.tmp" suffix, two caches spilling the same
+    key concurrently would open the SAME tmp file — writer B's open()
+    truncates the bytes writer A is mid-np.savez on, and A's os.replace
+    then publishes B's torn prefix under the final name.  Also pinned:
+    tmp names never end in ".npz", so the disk accounting scans and the
+    prune can never count or load an in-progress write."""
+    import os as os_mod
+
+    from raft_stereo_tpu.serve.cache import CacheEntry
+
+    spill = str(tmp_path / "spill")
+    svc = make_service(tiny_params, tiny_cfg, cache_dir=spill)
+    c1 = svc.cache
+    c2 = ResponseCache(svc.session, max_bytes=64 << 20, cache_dir=spill)
+
+    recorded = []
+    real_replace = os_mod.replace
+
+    def spy(src, dst, *a, **kw):
+        recorded.append((src, dst))
+        return real_replace(src, dst, *a, **kw)
+
+    monkeypatch.setattr("os.replace", spy)
+
+    key = ("exact", "contested", 1)
+    sig = np.zeros(64, np.float32)
+
+    def entry(cache, fill):
+        return CacheEntry(key, "default", "default", sig,
+                          np.full((H, W), fill, np.float32), None,
+                          None, 4, 0.0)
+
+    c1._spill(entry(c1, 1.0))
+    c2._spill(entry(c2, 2.0))
+    spill_writes = [(s, d) for s, d in recorded
+                    if d.startswith(spill)]
+    assert len(spill_writes) == 2
+    (src1, dst1), (src2, dst2) = spill_writes
+    assert dst1 == dst2, "same key must target the same final path"
+    assert src1 != src2, (
+        "two writers shared one tmp path — the torn-entry race")
+    for src in (src1, src2):
+        assert not src.endswith(".npz"), (
+            "a tmp name ending in .npz is visible to the disk scans")
+    leftovers = [f for f in os_mod.listdir(spill) if ".tmp" in f]
+    assert leftovers == [], leftovers
+
+
+def test_two_caches_racing_deposits_never_serve_torn(tiny_params,
+                                                     tiny_cfg,
+                                                     tmp_path):
+    """Two ResponseCache objects hammer the SAME key's spill path from
+    concurrent threads; whatever write wins, the published file must
+    always load as a COMPLETE entry (one writer's payload, never an
+    interleaving) and the promote path must serve it."""
+    import threading as threading_mod
+
+    from raft_stereo_tpu.serve.cache import CacheEntry
+
+    spill = str(tmp_path / "spill")
+    svc = make_service(tiny_params, tiny_cfg, cache_dir=spill)
+    caches = [svc.cache,
+              ResponseCache(svc.session, max_bytes=64 << 20,
+                            cache_dir=spill)]
+    key = ("exact", "contested", 2)
+    sig = np.zeros(64, np.float32)
+    fills = {0: 10.0, 1: 20.0}
+    errors = []
+
+    def writer(idx):
+        cache = caches[idx]
+        try:
+            for _ in range(25):
+                cache._spill(CacheEntry(
+                    key, "default", "default", sig,
+                    np.full((H, W), fills[idx], np.float32), None,
+                    None, 4, 0.0))
+        except Exception as e:  # noqa: BLE001 — fail the test with it
+            errors.append(e)
+
+    threads = [threading_mod.Thread(target=writer, args=(i,))
+               for i in (0, 1) for _ in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+
+    # The published file is ONE complete payload — loadable, correct
+    # key, disparity uniformly one writer's fill value.
+    path = caches[0]._path_for(key)
+    with np.load(path) as z:
+        import json as json_mod
+        meta = json_mod.loads(bytes(z["meta"]).decode())
+        assert meta["key"] == repr(key)
+        disp = np.array(z["disparity"])
+    assert disp.shape == (H, W)
+    assert disp.min() == disp.max() and disp.min() in fills.values(), (
+        "torn spill: interleaved bytes from two writers")
+    # and the promote path serves it
+    entry = caches[1]._disk_lookup(key, "default", "default", now=1.0)
+    assert entry is not None and entry.iters == 4
+    assert [f for f in (tmp_path / "spill").iterdir()
+            if ".tmp" in f.name] == []

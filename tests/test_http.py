@@ -708,6 +708,13 @@ def test_healthz_and_metrics_are_real_endpoints(frontend):
     assert status == 200 and doc["ingress"]["endpoint"].endswith(
         str(frontend.port))
     assert doc["ingress"]["quota"]["limit"] is None
+    # graftfleet (r20): generation identity + age are TOP-LEVEL fields —
+    # the fleet router keys rolling deploys on fingerprint_id from the
+    # one endpoint it already polls (not /debug/config) and reads
+    # restarts off uptime_s.
+    assert doc["fingerprint_id"] == \
+        frontend.service.session.fingerprint_id()
+    assert isinstance(doc["uptime_s"], float) and doc["uptime_s"] >= 0
     status, headers, body = get(frontend, "/metrics")
     assert status == 200
     assert headers["Content-Type"].startswith("text/plain")
@@ -1051,3 +1058,79 @@ def test_iter_decoded_pairs_bounded_lookahead():
         fut.result(timeout=30)
         n += 1
     assert n == 48 and started[0] == 96
+
+
+def test_cli_ready_handshake_stdout_and_fd(tmp_path):
+    """graftfleet satellite (r20): the live CLI's readiness handshake.
+
+    ``--http_port 0`` must print exactly one machine-parseable
+    ``RAFT_HTTP_PORT=<n>`` line to stdout AFTER the listening event
+    (i.e. after warmup — a supervisor that reads it can route
+    immediately), and ``--ready_fd`` must deliver the same line over an
+    inherited pipe followed by EOF.  The advertised port must actually
+    serve /healthz carrying the top-level fingerprint_id/uptime_s
+    fields the fleet router consumes.  One real subprocess (~15 s tiny
+    CPU model) — the price of pinning the contract on the production
+    entry point rather than a refactored fragment of it.
+    """
+    import os
+    import signal
+    import subprocess
+    import sys
+
+    r_fd, w_fd = os.pipe()
+    proc = subprocess.Popen(
+        [sys.executable, "serve_stereo.py",
+         "--http_port", "0", "--no_canary", "--ready_fd", str(w_fd),
+         "--valid_iters", "2", "--segments", "2",
+         "--n_gru_layers", "1", "--hidden_dims", "32", "32", "32",
+         "--corr_levels", "2", "--corr_radius", "2",
+         "--corr_implementation", "reg"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        pass_fds=(w_fd,), cwd=os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))),
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    os.close(w_fd)
+    try:
+        timer = threading.Timer(240.0, proc.kill)
+        timer.start()
+        seen_listening = False
+        port = None
+        try:
+            for line in proc.stdout:
+                line = line.strip()
+                if line.startswith("{"):
+                    doc = json.loads(line)
+                    if doc.get("event") == "listening":
+                        seen_listening = True
+                    continue
+                if line.startswith("RAFT_HTTP_PORT="):
+                    assert seen_listening, (
+                        "handshake printed before the listening event")
+                    port = int(line.split("=", 1)[1])
+                    break
+        finally:
+            timer.cancel()
+        assert port is not None, "no RAFT_HTTP_PORT handshake on stdout"
+        # --ready_fd: same line over the inherited pipe, then EOF.
+        with os.fdopen(r_fd, "r") as ready_pipe:
+            r_fd = None
+            assert ready_pipe.read() == f"RAFT_HTTP_PORT={port}\n"
+        # The advertised port serves, and /healthz carries the fleet
+        # router's generation-identity fields at the top level.
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=30) as resp:
+            assert resp.status == 200
+            health = json.loads(resp.read())
+        assert isinstance(health["fingerprint_id"], str)
+        assert len(health["fingerprint_id"]) == 12
+        assert health["uptime_s"] >= 0
+        proc.send_signal(signal.SIGTERM)
+        proc.communicate(timeout=120)
+        assert proc.returncode == 0
+    finally:
+        if r_fd is not None:
+            os.close(r_fd)
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
