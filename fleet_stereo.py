@@ -78,6 +78,15 @@ def build_parser() -> argparse.ArgumentParser:
                         help="SIGTERM drain grace per retiring "
                         "instance (default RAFT_DRAIN_GRACE_MS or "
                         "10 s; overrun escalates to SIGKILL, counted)")
+    # graftpod: forwarded to every instance (incl. replacements) so a
+    # rolling deploy can widen/narrow the per-instance mesh in one
+    # place; equivalent to putting --mesh_data N after --.
+    parser.add_argument("--mesh_data", type=int, default=None,
+                        help="per-instance data-mesh width: each "
+                        "serve_stereo instance shards its device batch "
+                        "over this many chips and advertises N-chip "
+                        "headroom to the router (default: whatever the "
+                        "instance recipe / RAFT_SERVE_MESH_DATA says)")
     return parser
 
 
@@ -89,6 +98,9 @@ def main(argv=None) -> int:
     else:
         fleet_argv, instance_args = argv, []
     args = build_parser().parse_args(fleet_argv)
+    if args.mesh_data is not None:
+        instance_args = instance_args + ["--mesh_data",
+                                         str(args.mesh_data)]
 
     from raft_stereo_tpu.serve.fleet import (FleetConfig, FleetFrontend,
                                              FleetSupervisor)

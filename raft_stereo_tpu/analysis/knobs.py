@@ -214,6 +214,26 @@ HOST_ENV_KNOBS: Tuple[str, ...] = (
                             # launch attempt (serve/fleet.py
                             # resolve_fleet_warmup_timeout_ms,
                             # default 600 s)
+    # graftpod knobs (DESIGN.md r21, serve/session.py) — the explicit
+    # fingerprint-vs-key call, made here on purpose: the mesh extent DOES
+    # change the compiled program (sharded lowering — the PR 3
+    # stale-program class), so it MUST re-key cached programs.  But it
+    # re-keys the way the batch bucket ``b`` does — as an explicit
+    # trailing cache-key component (("mesh", n_data, epoch), appended in
+    # InferenceSession.cache_key), NOT via the config fingerprint.
+    # ``fingerprint_id()`` stays mesh-independent by design: the PR 14
+    # response cache keys on the fingerprint and must remain ONE
+    # host-side cache above all N chips (DESIGN r18) — folding the mesh
+    # into the fingerprint would shard the response cache per mesh shape
+    # for no correctness gain.  Hence HOST_ENV_KNOBS, not ENV_KNOBS.
+    "RAFT_SERVE_MESH_DATA",  # data-mesh extent (chips one session
+                            # drives; serve/session.py
+                            # resolve_serve_mesh_data, default 1 =
+                            # single-device, byte-identical keys)
+    "RAFT_SERVE_MESH_FALLBACK",  # pod kill switch: force n_data=1
+                            # regardless of config/env (serve/session.py
+                            # resolve_mesh_fallback) — the operator
+                            # escape every kill switch here honors
 )
 
 

@@ -219,6 +219,12 @@ class ChaosPlan(ServeFaultPlan):
         strand its joiners' Futures forever.
     crash_ticks: scheduler work-tick ordinals (0-based count of ticks
         that did work) AFTER which the tick-loop thread crashes.
+    hang_chips: mesh chip ordinals whose post-bounce health probe
+        (``InferenceSession.probe_chips`` -> ``on_chip_probe``) parks —
+        the injected form of ONE chip of a data mesh staying wedged
+        while its siblings answer, so the chip-local quarantine path is
+        CPU-testable.  Parked probes ride the same release
+        epoch/real-time cap as ``hang_invokes``.
     hang_cap_s: real-seconds safety cap on any injected hang, so a test
         that never bounces cannot deadlock the suite.
     """
@@ -227,6 +233,7 @@ class ChaosPlan(ServeFaultPlan):
         default_factory=dict)
     crash_uploads: Tuple[int, ...] = ()
     crash_ticks: Tuple[int, ...] = ()
+    hang_chips: Tuple[int, ...] = ()
     hang_cap_s: float = 30.0
 
 
@@ -318,6 +325,27 @@ class ServeFaults:
             while self._hang_epoch == epoch and time.monotonic() < cap:
                 self._hang_cv.wait(0.05)
         return n
+
+    def on_chip_probe(self, chip: int) -> None:
+        """Fire inside each mesh chip-health probe thread
+        (``InferenceSession.probe_chips``); parks the probe for a chip in
+        the plan's ``hang_chips`` — modeling a chip that stays wedged
+        after the bounce freed the invoke-level hang.  Parked probes use
+        the SAME epoch condition as ``on_invoke`` hangs, so they respect
+        ``release_hangs`` and the real-time cap; a probe that parks past
+        its caller's join timeout reads as a hung chip, which is the
+        point."""
+        if chip not in getattr(self.plan, "hang_chips", ()):
+            return
+        with self._hang_cv:
+            epoch = self._hang_epoch
+        import time
+        cap = time.monotonic() + getattr(self.plan, "hang_cap_s", 30.0)
+        with self._hang_cv:
+            self.hangs_entered += 1
+            self._hang_cv.notify_all()
+            while self._hang_epoch == epoch and time.monotonic() < cap:
+                self._hang_cv.wait(0.05)
 
     def release_hangs(self) -> None:
         """Unpark every currently-hung invocation (the generation bounce

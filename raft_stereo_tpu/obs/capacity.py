@@ -167,3 +167,46 @@ def saturation(deck_rows: List[Dict], *, now: float,
     covered = min(window_s, max(1e-12, now - max(earliest, w0)))
     return {"ratio": min(1.0, busy / covered), "busy_s": busy,
             "window_s": window_s, "covered_s": covered}
+
+
+def saturation_per_chip(deck_rows: List[Dict], n_chips: int, *, now: float,
+                        window_s: float = DEFAULT_WINDOW_S) -> List[Dict]:
+    """Per-chip device-busy fractions over the sliding window (graftpod).
+
+    A mesh invocation's device window covers ALL of its chips at once
+    (one wall interval, the PR 12 reconciliation contract — never
+    multiplied by the span), so each record's ``device_s + warm_s``
+    counts toward chips ``0 .. chips-1``: the mesh always packs the
+    leading chips of the device list, so a 2-chip record busies chips 0
+    and 1 while chips 2+ idle.  Same window-edge clipping and covered
+    denominator as :func:`saturation`; a chip with no history reports
+    ``ratio: None`` — absence, never a fabricated 0.
+    """
+    w0 = now - window_s
+    busy = [0.0] * max(1, int(n_chips))
+    earliest = [None] * max(1, int(n_chips))
+    for t in deck_rows:
+        t1 = t.get("t_end")
+        if t1 is None or t1 <= w0:
+            continue
+        t0 = min(t["t_start"], t1)
+        span = t1 - t0
+        frac = 1.0
+        if span > 0:
+            frac = max(0.0, min(t1, now) - max(t0, w0)) / span
+        dt = (t.get("device_s", 0.0) + t.get("warm_s", 0.0)) * frac
+        for chip in range(min(len(busy), max(1, int(t.get("chips", 1))))):
+            busy[chip] += dt
+            if earliest[chip] is None or t0 < earliest[chip]:
+                earliest[chip] = t0
+    out: List[Dict] = []
+    for chip in range(len(busy)):
+        if earliest[chip] is None:
+            out.append({"chip": chip, "ratio": None, "busy_s": 0.0})
+            continue
+        covered = min(window_s,
+                      max(1e-12, now - max(earliest[chip], w0)))
+        out.append({"chip": chip,
+                    "ratio": min(1.0, busy[chip] / covered),
+                    "busy_s": busy[chip]})
+    return out

@@ -111,6 +111,11 @@ class TickRecord:
     iters: int = 0                 # refinement iters this tick advanced
     program: Optional[str] = None  # advance program's ledger id
     invocations: int = 0           # device calls inside this record
+    chips: int = 1                 # mesh chips the device calls spanned
+    #                                (graftpod; device_s stays the ONE
+    #                                wall interval per invoke, never
+    #                                multiplied by chips — the per-chip
+    #                                view divides, obs/capacity.py)
     host_s: float = 0.0
     device_s: float = 0.0
     warm_s: float = 0.0
@@ -172,15 +177,20 @@ class TickDeck:
 
     def note_invocation(self, *, kind: str, program: str, b: int, h: int,
                         w: int, t0: float, t1: float, host_s: float,
-                        device_s: float, warming: bool) -> Optional[int]:
+                        device_s: float, warming: bool,
+                        chips: int = 1) -> Optional[int]:
         """One device invocation's timing.  Inside an open tick (the
         scheduler thread) it accumulates; outside (sequential workers,
         direct ``session.infer``) it records a standalone row and
         returns its seq so the caller can stamp ``tick=<seq>`` on the
-        matching trace span."""
+        matching trace span.  ``chips`` is the mesh span of THIS
+        invocation; an open tick takes the max (all of one tick's calls
+        ride one mesh, but a quarantine between programs must surface
+        the wider span, never hide it)."""
         open_tick = getattr(self._tl, "open", None)
         if open_tick is not None:
             open_tick.invocations += 1
+            open_tick.chips = max(open_tick.chips, int(chips))
             if warming:
                 open_tick.warm_s += host_s + device_s
             else:
@@ -189,7 +199,8 @@ class TickDeck:
             return None
         rec = TickRecord(seq=self._next_seq(), kind=kind, t_start=t0,
                          t_end=t1, bucket=f"{h}x{w}", batch=b,
-                         occupancy=b, program=program, invocations=1)
+                         occupancy=b, program=program, invocations=1,
+                         chips=int(chips))
         if warming:
             rec.warm_s = host_s + device_s
         else:
@@ -357,6 +368,13 @@ def report(doc: Dict, out=None) -> Dict:
     if not waste:
         print("  (no advancing ticks recorded)", file=out)
 
+    # Mesh span (graftpod): ticks whose device calls rode a >1-chip mesh.
+    mesh_ticks = [t for t in ticks if int(t.get("chips", 1)) > 1]
+    if mesh_ticks:
+        print(f"mesh ticks: {len(mesh_ticks)} of {len(ticks)} spanned "
+              f"{max(int(t.get('chips', 1)) for t in mesh_ticks)} chip(s)",
+              file=out)
+
     # Response-cache hit rate over the ring window (graftrecall):
     # cache_hits is cumulative at tick start, so last - first is the
     # hits served while these ticks ran.
@@ -392,6 +410,7 @@ def report(doc: Dict, out=None) -> Dict:
             "occupancy_mean": occ_mean,
             "pad_waste": {b: (p / r if r else 0.0)
                           for b, (p, r) in waste.items()},
+            "mesh_ticks": len(mesh_ticks),
             "cache_hits_window": cache_window,
             "idle_gaps": {"n": len(gaps), "total_s": sum(gaps),
                           "busy_s": busy}}

@@ -66,6 +66,12 @@ def rollup(rows: List[Dict]) -> Dict:
     stream_sessions = 0
     cache_entries = 0
     uptime_min: Optional[float] = None
+    # graftpod: chips SUM across instances (each instance's mesh drives
+    # its own devices — a 4-instance fleet of 2-chip meshes advertises
+    # an 8-chip pod) and quarantined chips sum the same way.
+    chips = 0
+    chips_seen = False
+    chips_quarantined = 0
     per_instance = []
     for row in rows:
         state = str(row.get("state", "unknown"))
@@ -109,6 +115,14 @@ def rollup(rows: List[Dict]) -> Dict:
                 _num(doc, "stream", "sessions", default=0) or 0)
             cache_entries += int(
                 _num(doc, "cache", "entries", default=0) or 0)
+            n_chips = _num(doc, "capacity", "chips", "n_data")
+            if n_chips is not None:
+                chips += int(n_chips)
+                chips_seen = True
+                entry["chips"] = int(n_chips)
+                q = _num(doc, "capacity", "chips", "quarantined",
+                         default=()) or ()
+                chips_quarantined += len(q)
         per_instance.append(entry)
     return {
         "schema": FLEET_SCHEMA,
@@ -119,6 +133,8 @@ def rollup(rows: List[Dict]) -> Dict:
         "rolling": len(fingerprints) > 1,
         "headroom_rps": headroom if headroom_seen else None,
         "saturation": saturation,
+        "chips": chips if chips_seen else None,
+        "chips_quarantined": chips_quarantined if chips_seen else None,
         "stream_sessions": stream_sessions,
         "cache_entries": cache_entries,
         "uptime_min_s": uptime_min,

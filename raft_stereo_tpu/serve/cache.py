@@ -50,6 +50,15 @@ never served to tenant B, even for bit-identical uploads — a response
 cache that leaked across tenants would be a data-exfiltration oracle
 (upload a guessed image, observe the hit).
 
+Pod serving (graftpod, DESIGN.md r21): this cache stays ONE host-side
+store ABOVE all N chips of a data mesh.  The keys fold in the program
+FINGERPRINT, which is deliberately mesh-independent (the mesh extent
+re-keys compiled programs via a trailing cache-key component, like the
+batch bucket ``b`` — analysis/knobs.py HOST_ENV_KNOBS rationale), so a
+hit deposited by a 1-chip serve answers an 8-chip serve and vice versa:
+sharding the batch dim never changes the response bytes' contract, and
+splitting the cache per chip would only divide its hit rate by N.
+
 Memory bound: one full-res (2016x2976) entry holds the float32 disparity
 (~24 MiB) + the 1/8-res seed (~0.4 MiB) + a 1 KiB signature, so the
 default 256 MiB budget holds ~10 full-res scenes or thousands of

@@ -353,6 +353,17 @@ class FleetInstance:
         sat = (doc.get("capacity") or {}).get("saturation") or {}
         return sat.get("ratio")
 
+    def chips(self) -> Optional[int]:
+        """graftpod: this instance's live data-mesh width from its last
+        health document (None = single-device or never probed).  The
+        per-bucket headroom the router weighs by already reflects the
+        whole mesh's throughput — this accessor exists so the fleet
+        rollup and /fleet/healthz advertise N-chip capacity per slot."""
+        doc = self.last_doc or {}
+        chips = (doc.get("capacity") or {}).get("chips") or {}
+        n = chips.get("n_data")
+        return int(n) if n is not None else None
+
     # -- teardown ----------------------------------------------------------
 
     def begin_drain(self) -> None:
@@ -850,10 +861,19 @@ class FleetSupervisor:
                                  "state": "degraded", "doc": None})
                     continue
                 rows.append({"uid": inst.uid, "slot": slot,
-                             "state": inst.state, "doc": inst.last_doc})
+                             "state": inst.state, "doc": inst.last_doc,
+                             "chips": inst.chips()})
             draining = len(self._retired)
             affinity = len(self._affinity)
         doc = rollup(rows)
+        # graftpod: advertise the pod's summed chip count as a gauge so
+        # an operator scraping /fleet/metrics sees capacity shrink when
+        # an instance quarantines a chip.
+        if doc.get("chips") is not None:
+            self.registry.gauge(
+                "raft_fleet_chips",
+                "data-mesh chips advertised across the fleet"
+            ).set(doc["chips"])
         doc.update({
             "generation": self._generation,
             "degraded_slots": degraded,

@@ -460,6 +460,19 @@ class BatchScheduler:
             # and cold rows batch together from here on.
             cold = [r for r in joiners if r.dev_flow is None]
             warm = [r for r in joiners if r.dev_flow is not None]
+            # graftpod chip affinity: within each group, stable-sort by
+            # the stream session's assigned chip (StreamManager stamps
+            # ``_chip`` at admission) so a stream's rows keep landing on
+            # the same mesh shard tick after tick — the data sharding
+            # splits the leading batch dim contiguously, so adjacent
+            # rows share a chip.  Chip-less rows (-1) sort first; the
+            # sort is stable, so FIFO order within a chip is preserved
+            # and the single-device path (every key -1) is a no-op.
+            def _chip_key(r: _Row) -> int:
+                c = r.request.get("_chip")
+                return c if isinstance(c, int) else -1
+            cold.sort(key=_chip_key)
+            warm.sort(key=_chip_key)
             # Reorder the published join group to match the carry concat
             # order below (same membership, so harvest coverage is
             # unchanged; appends already happened).

@@ -1195,6 +1195,26 @@ class StereoService:
         for t in old_threads:
             t.join(timeout=5.0)
         self._zombies.extend(t for t in old_threads if t.is_alive())
+        # graftpod: a device_hang bounce on a live mesh probes every
+        # chip and quarantines only the hung ones — the mesh shrinks to
+        # the largest divisor of its base extent that fits the
+        # survivors, the epoch bump re-keys the mesh programs, and the
+        # OTHER chips keep serving.  Stream sessions pinned to a
+        # quarantined chip migrate (their held seed is host-side, so
+        # they stay warm — the bounce-warm pin extended to chips).
+        quarantined: list = []
+        if kind == "device_hang" and self.session.mesh_active:
+            hung = self.session.probe_chips()
+            for chip in hung:
+                if self.session.quarantine_chip(chip):
+                    quarantined.append(chip)
+            if quarantined:
+                migrated = self.stream.migrate_off_chips(
+                    quarantined, self.session.mesh_chips)
+                logger.warning(
+                    "quarantined chip(s) %s after device_hang — mesh "
+                    "now %d-wide, %d stream session(s) migrated",
+                    quarantined, self.session.mesh_chips, migrated)
         self.registry.counter(
             "raft_sched_restarts_total",
             "scheduler generation bounces by watchdog reason",
@@ -1239,6 +1259,9 @@ class StereoService:
             "generation": {"from": gen - 1, "to": gen},
             "requests": {"harvested": len(harvested),
                          "requeued": requeued, "failed": failed},
+            "mesh": ({"quarantined": quarantined,
+                      "n_data": self.session.mesh_chips}
+                     if quarantined else None),
             "breaker": self.session.breaker.status(),
             "metrics": self.registry.snapshot(),
         }, trace_id=f"bounce-g{gen}")

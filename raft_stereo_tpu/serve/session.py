@@ -58,7 +58,7 @@ from raft_stereo_tpu.obs.usage import DEFAULT_TENANT, UsageAccountant
 from raft_stereo_tpu.ops.padder import InputPadder
 from raft_stereo_tpu.serve.guard import (KernelCircuitBreaker, CANARY_ATOL,
                                          CANARY_RTOL, is_kernel_failure)
-from raft_stereo_tpu.serve.supervise import InvocationWatch
+from raft_stereo_tpu.serve.supervise import InvocationWatch, _parse_number
 from raft_stereo_tpu.serve.validate import AdmissionConfig, validate_pair
 
 logger = logging.getLogger(__name__)
@@ -90,6 +90,42 @@ class InferenceFailed(SessionError):
 class DeadlineExceeded(SessionError):
     def __init__(self, message: str):
         super().__init__("deadline_exceeded", message)
+
+
+# -- pod-scale serving knobs (graftpod) -------------------------------------
+#
+# The data-mesh extent is resolved HERE, once per session, and then rides
+# the program-cache KEY as an explicit trailing component (like the batch
+# bucket ``b``) — NOT the config fingerprint.  Mesh shape changes the
+# compiled program (the PR 3 stale-program class), so it must re-key; but
+# ``fingerprint_id()`` deliberately stays mesh-independent so the PR 14
+# response cache (fingerprint-keyed, host-side) remains ONE cache above
+# all chips (DESIGN r18/r21).
+
+def resolve_serve_mesh_data(value: Optional[int] = None) -> int:
+    """Effective ``data``-mesh extent (chips one session drives): explicit
+    config wins, else ``RAFT_SERVE_MESH_DATA``, else 1 (single-device, the
+    pre-pod behavior, byte-identical keys)."""
+    if value is not None:
+        n = int(value)
+    else:
+        raw = os.environ.get("RAFT_SERVE_MESH_DATA", "").strip()
+        if not raw:
+            return 1
+        n = _parse_number("RAFT_SERVE_MESH_DATA", raw, int)
+    if n < 1:
+        raise ValueError(f"RAFT_SERVE_MESH_DATA must be >= 1, got {n}")
+    return n
+
+
+def resolve_mesh_fallback() -> bool:
+    """The mesh kill switch: ``RAFT_SERVE_MESH_FALLBACK=1`` forces a
+    session back to n_data=1 regardless of config/env — the same
+    operator-escape contract every kernel kill switch honors.  Host-side
+    only (it selects whether mesh-keyed programs exist at all, it never
+    changes what any one compiled program computes)."""
+    raw = os.environ.get("RAFT_SERVE_MESH_FALLBACK", "").strip()
+    return raw not in ("", "0", "false", "False")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -125,6 +161,13 @@ class SessionConfig:
         carries). Empty = the RAFT_BATCH_BUCKETS env override if set, else
         powers of two up to ``max_batch``. Bounding the bucket set bounds
         the compile count exactly like shape bucketing does.
+    mesh_data: chips this session drives over the ``data`` mesh axis
+        (graftpod). None = the RAFT_SERVE_MESH_DATA env override, else 1
+        (single-device, the pre-pod path). With n_data > 1 the batched
+        programs compile under ``parallel/mesh.make_mesh`` with the
+        leading batch dim sharded; batch buckets round up to multiples of
+        n_data (the pad rows land in the existing dead-carry accounting).
+        RAFT_SERVE_MESH_FALLBACK=1 forces 1 (the pod kill switch).
     """
 
     valid_iters: int = 32
@@ -139,6 +182,7 @@ class SessionConfig:
     allow_half_res: bool = True
     max_batch: int = 1
     batch_buckets: Tuple[int, ...] = ()
+    mesh_data: Optional[int] = None
     admission: AdmissionConfig = dataclasses.field(
         default_factory=AdmissionConfig)
 
@@ -158,6 +202,9 @@ class SessionConfig:
                 raise ValueError(
                     f"batch_buckets must be strictly increasing positive "
                     f"ints, got {bb}")
+        if self.mesh_data is not None and self.mesh_data < 1:
+            raise ValueError(
+                f"mesh_data must be >= 1, got {self.mesh_data}")
 
 
 @dataclasses.dataclass
@@ -186,7 +233,7 @@ class _Program:
     plain jit dispatch (``fn``)."""
 
     __slots__ = ("key", "fn", "kind", "env", "warmed", "lock", "compiled",
-                 "ledger_id")
+                 "ledger_id", "mesh")
 
     def __init__(self, key, fn, kind, env):
         self.key = key
@@ -197,6 +244,11 @@ class _Program:
         self.lock = threading.Lock()
         self.compiled = None
         self.ledger_id = ledger_id(key)
+        # graftpod: mesh-sharded programs carry a trailing
+        # ("mesh", n_data, epoch) key component (see cache_key) — parsed
+        # once here so invoke() can pick shardings without re-inspecting
+        # the tuple shape on every call.  None = single-device program.
+        self.mesh = key[6] if len(key) > 6 else None
 
 
 @contextlib.contextmanager
@@ -459,6 +511,41 @@ class InferenceSession:
         # means a new session (or tripping the breaker).
         self._env_base: Dict[str, Optional[str]] = {
             k: os.environ.get(k) for k in _ENV_KNOBS}
+        # graftpod: the data-mesh plane.  n_data is resolved ONCE here
+        # (kill switch > explicit config > RAFT_SERVE_MESH_DATA > 1) and
+        # rides the program-cache KEY as a trailing component, never the
+        # config fingerprint — see resolve_serve_mesh_data's rationale.
+        # ``_mesh_base_n`` is the construction-time extent; quarantining a
+        # hung chip shrinks the live mesh to the largest divisor of the
+        # base extent that fits the surviving chips (divisors of the base
+        # still divide every rounded batch bucket) and bumps the epoch,
+        # re-keying the mesh programs (old ones age out of the LRU — the
+        # PR 3 stale-program discipline).
+        self._mesh = None
+        self._mesh_n = 1
+        self._mesh_epoch = 0
+        self._mesh_devices: list = []
+        self._quarantined: set = set()
+        self._mesh_shardings: Dict[int, Tuple] = {}
+        self._mesh_params: Dict[int, object] = {}
+        # RLock: quarantine_chip rebuilds the mesh while holding it, and
+        # _build_mesh re-takes it so every mutation site is guarded.
+        self._mesh_lock = threading.RLock()
+        self._mesh_base_n = (1 if resolve_mesh_fallback()
+                             else resolve_serve_mesh_data(self.cfg.mesh_data))
+        if self._mesh_base_n > 1:
+            devices = list(jax.devices())
+            if self._mesh_base_n > len(devices):
+                raise ValueError(
+                    f"mesh_data {self._mesh_base_n} exceeds the "
+                    f"{len(devices)} available {self._backend} device(s)")
+            # The POD is the first base_n devices — probes, per-chip
+            # capacity rows and the quarantine shrink all index into this
+            # list, so it must be exactly the chips the mesh spans, not
+            # every device the host can see (a spare chip is a deliberate
+            # redeploy, not a silent failover target).
+            self._mesh_devices = devices[:self._mesh_base_n]
+            self._build_mesh(self._mesh_devices, self._mesh_base_n)
         # Batch-bucket ladder for continuous batching, resolved ONCE here
         # (SessionConfig value > RAFT_BATCH_BUCKETS env > powers of two up
         # to max_batch). Batch size is an EXPLICIT cache-key component, so
@@ -589,7 +676,16 @@ class InferenceSession:
         capped = tuple(b for b in buckets if b < self.cfg.max_batch)
         covering = min((b for b in buckets if b >= self.cfg.max_batch),
                        default=self.cfg.max_batch)
-        return capped + (covering,)
+        buckets = capped + (covering,)
+        if self._mesh_n > 1:
+            # graftpod: every batch bucket rounds UP to a multiple of the
+            # mesh extent so the leading dim always shards evenly (the
+            # `local_batch_rows` divisibility rule); the extra rows are
+            # ordinary dead-carry pads and land in the scheduler's
+            # existing `pad_rows` accounting, never in occupancy.
+            n = self._mesh_n
+            buckets = tuple(sorted({-(-b // n) * n for b in buckets}))
+        return buckets
 
     @property
     def batch_buckets(self) -> Tuple[int, ...]:
@@ -603,6 +699,161 @@ class InferenceSession:
         raise ValueError(
             f"batch of {n} exceeds the largest batch bucket "
             f"{self._batch_buckets[-1]} (max_batch={self.cfg.max_batch})")
+
+    # -- pod mesh (graftpod) ----------------------------------------------
+
+    def _build_mesh(self, devices, n: int) -> None:
+        """(Re)build the live data mesh over ``devices`` at extent ``n``
+        for the CURRENT epoch, replicate params onto it, and cache the
+        epoch's shardings.  Single-device programs keep riding the
+        original ``self._params`` so the n_data=1 path stays byte-for-byte
+        the pre-pod path."""
+        from raft_stereo_tpu.parallel.mesh import (batch_sharding,
+                                                   make_mesh, replicated)
+        mesh = make_mesh(n, 1, devices)
+        rep = replicated(mesh)
+        with self._mesh_lock:  # reentrant from quarantine_chip
+            self._mesh = mesh
+            self._mesh_n = n
+            self._mesh_shardings[self._mesh_epoch] = (batch_sharding(mesh),
+                                                      rep)
+            # Params replicated per mesh epoch: an old epoch's in-flight
+            # invocation still finds its own params/shardings (bounded —
+            # the epoch only bumps on a chip quarantine).
+            self._mesh_params[self._mesh_epoch] = self._jax.device_put(
+                self._params, rep)
+
+    @property
+    def mesh_active(self) -> bool:
+        return self._mesh is not None
+
+    @property
+    def mesh_chips(self) -> int:
+        """Chips the live mesh spans (1 = single-device serving)."""
+        return self._mesh_n if self._mesh is not None else 1
+
+    def probe_chips(self, timeout_s: float = 2.0) -> Tuple[int, ...]:
+        """Probe every non-quarantined chip of the base mesh with a tiny
+        transfer + ``block_until_ready`` on a daemon thread each; a chip
+        whose probe does not complete within ``timeout_s`` is hung.
+        Returns the hung chip ordinals (indices into the construction-time
+        device list).  The ``faults.on_chip_probe`` hook runs INSIDE each
+        probe thread so chaos plans can park exactly one chip's probe the
+        way ``on_invoke`` parks a device call."""
+        if self._mesh is None and not self._mesh_devices:
+            return ()
+        done: Dict[int, bool] = {}
+
+        def _probe(i: int, dev) -> None:
+            try:
+                self.faults.on_chip_probe(i)
+                x = self._jax.device_put(np.zeros((), np.float32), dev)
+                x.block_until_ready()
+                done[i] = True
+            except Exception:  # noqa: BLE001 — a failed probe IS a hang
+                done[i] = False
+
+        threads = []
+        for i, dev in enumerate(self._mesh_devices):
+            if i in self._quarantined:
+                continue
+            t = threading.Thread(target=_probe, args=(i, dev),
+                                 name=f"chip-probe-{i}", daemon=True)
+            t.start()
+            threads.append((i, t))
+        deadline = self.clock.now() + timeout_s
+        for i, t in threads:
+            t.join(timeout=max(0.05, deadline - self.clock.now()))
+        return tuple(i for i, t in threads
+                     if t.is_alive() or not done.get(i, False))
+
+    def quarantine_chip(self, chip: int) -> bool:
+        """Take one hung chip out of the live mesh: shrink the mesh to
+        the largest divisor of the base extent that fits the surviving
+        chips (divisors keep every rounded batch bucket evenly sharded)
+        and bump the mesh epoch, re-keying the mesh programs.  Returns
+        False when the chip was already quarantined / out of range."""
+        with self._mesh_lock:
+            if chip in self._quarantined or \
+                    not (0 <= chip < len(self._mesh_devices)):
+                return False
+            self._quarantined.add(chip)
+            healthy = [d for i, d in enumerate(self._mesh_devices)
+                       if i not in self._quarantined]
+            new_n = max((d for d in range(1, self._mesh_base_n + 1)
+                         if self._mesh_base_n % d == 0
+                         and d <= len(healthy)), default=1)
+            self._mesh_epoch += 1
+            if not healthy:
+                # Every chip gone: serving will fail loudly downstream —
+                # never silently route onto a quarantined chip.
+                self._mesh = None
+                self._mesh_n = 1
+                logger.error("all %d mesh chips quarantined",
+                             len(self._mesh_devices))
+                return True
+            # Even a 1-chip remainder keeps a (1,1) mesh: placement must
+            # land on a HEALTHY chip, and the default device might be the
+            # quarantined one.
+            self._build_mesh(healthy[:new_n], new_n)
+            logger.warning(
+                "quarantined chip %d; mesh now %d chip(s) (epoch %d, "
+                "quarantined=%s)", chip, new_n, self._mesh_epoch,
+                sorted(self._quarantined))
+            self.registry.counter(
+                "raft_mesh_chips_quarantined_total",
+                "chips removed from the live data mesh").inc()
+            self.registry.gauge(
+                "raft_mesh_chips",
+                "chips the live data mesh spans").set(new_n)
+            return True
+
+    def mesh_status(self) -> Dict:
+        """The /healthz + /debug/config ``mesh`` block (bounded: one row
+        per construction-time chip)."""
+        with self._mesh_lock:
+            return {
+                "enabled": self._mesh is not None,
+                "n_data": self.mesh_chips,
+                "base_n_data": self._mesh_base_n,
+                "epoch": self._mesh_epoch,
+                "quarantined": sorted(self._quarantined),
+                "devices": [
+                    {"chip": i, "kind": getattr(d, "device_kind", None),
+                     "quarantined": i in self._quarantined}
+                    for i, d in enumerate(self._mesh_devices)],
+            }
+
+    def _shard_args(self, prog: _Program, args):
+        """Canonically re-``device_put`` a mesh program's operands every
+        call: leading-dim-``b`` leaves onto the batch sharding, everything
+        else replicated.  AOT ``Compiled`` executables require their exact
+        input shardings, and the scheduler's host-side gathers between
+        ticks (np carries, fresh uploads) arrive unsharded — a
+        ``device_put`` onto an array already holding the target sharding
+        is a no-op, so the steady path pays nothing."""
+        shardings = self._mesh_shardings.get(prog.mesh[2])
+        if shardings is None:  # epoch retired mid-flight: run as keyed
+            return args
+        batch_sh, rep = shardings
+        b = prog.key[1]
+        put = self._jax.device_put
+
+        def _place(x):
+            if getattr(x, "ndim", 0) >= 1 and x.shape[0] == b:
+                return put(x, batch_sh)
+            return put(x, rep)
+
+        return tuple(self._jax.tree.map(_place, a) for a in args)
+
+    def _params_for(self, prog: _Program):
+        """The params copy a program must see: the epoch-replicated set
+        for mesh programs, the original single-device set otherwise."""
+        if prog.mesh is not None:
+            p = self._mesh_params.get(prog.mesh[2])
+            if p is not None:
+                return p
+        return self._params
 
     # -- program cache ----------------------------------------------------
 
@@ -623,7 +874,21 @@ class InferenceSession:
         # would dodge the warmed flag and corrupt the latency EMA (batched
         # segments have batch-dependent cost) — so batch is part of the
         # key and callers always pad rows up to a registered bucket.
-        return (kind, b, h, w, iters, self._fingerprint(cfg, env))
+        key = (kind, b, h, w, iters, self._fingerprint(cfg, env))
+        if self._mesh is not None and b % self._mesh_n == 0:
+            # graftpod: the mesh extent changes the compiled program
+            # (sharded lowering — the PR 3 stale-program class), so it
+            # re-keys — as a TRAILING component, appended only when the
+            # mesh is live and the bucket shards evenly, so single-device
+            # keys stay byte-identical and every positional consumer of
+            # key[:6] (ledger ids, capacity's k[5] fingerprint filter,
+            # the status render) is untouched.  The epoch rides along so
+            # a post-quarantine mesh can never be served a pre-quarantine
+            # program.  The config FINGERPRINT stays mesh-independent on
+            # purpose: the PR 14 response cache keys on it and must stay
+            # ONE host-side cache above all chips (DESIGN r18/r21).
+            key = key + (("mesh", self._mesh_n, self._mesh_epoch),)
+        return key
 
     def fingerprint_id(self) -> str:
         """Short stable hash of the CURRENT run fingerprint (config
@@ -724,7 +989,7 @@ class InferenceSession:
             prog = self._cache.get(key)
         return prog is not None and prog.warmed
 
-    def _aot_compile(self, prog: _Program, args):
+    def _aot_compile(self, prog: _Program, args, params=None):
         """Lower + compile one program ahead of time and record its
         compiler-derived account (cost_analysis / memory_analysis) in the
         program ledger.  MUST run inside the caller's trace lock with the
@@ -748,7 +1013,9 @@ class InferenceSession:
                 device_kind=self._device_kind)
 
         try:
-            compiled = prog.fn.lower(self._params, *args).compile()
+            compiled = prog.fn.lower(
+                params if params is not None else self._params,
+                *args).compile()
         except (TypeError, AttributeError, NotImplementedError) as e:
             logger.warning(
                 "AOT compile unavailable for %s (%s: %s) — using jit "
@@ -807,6 +1074,16 @@ class InferenceSession:
                                  est=self.estimate(prog.key))
         try:
             self.faults.on_invoke()
+            params = self._params_for(prog)
+            if prog.mesh is not None:
+                # graftpod: mesh programs get their operands canonically
+                # re-placed every call (leading-dim rows over the data
+                # axis, the rest replicated) — the AOT executable requires
+                # its exact input shardings, and the placement cost rides
+                # the host_s side of the split (it happens before
+                # t_disp), so device seconds stay the dispatch-to-fetch
+                # wall interval — counted ONCE per invoke, never x chips.
+                args = self._shard_args(prog, args)
             if not prog.warmed:
                 with prog.lock:
                     with _TRACE_LOCK, _env_overrides(prog.env):
@@ -814,15 +1091,15 @@ class InferenceSession:
                         # one compile the first jit call would pay, but
                         # the Compiled handle stays in hand so its
                         # cost/memory analyses feed the program ledger.
-                        fn = self._aot_compile(prog, args)
-                        raw = fn(self._params, *args)
+                        fn = self._aot_compile(prog, args, params)
+                        raw = fn(params, *args)
                         t_disp = self.clock.now()
                         out = fetch(raw)
                     prog.warmed = True
                 self._refresh_cache_hbm()
             else:
                 raw = (prog.compiled if prog.compiled is not None
-                       else prog.fn)(self._params, *args)
+                       else prog.fn)(params, *args)
                 t_disp = self.clock.now()
                 out = fetch(raw)
         except Exception as e:
@@ -840,6 +1117,9 @@ class InferenceSession:
         host_s = max(0.0, t_disp - t0)
         device_s = max(0.0, t_end - t_disp)
         _, b_key, h_key, w_key = prog.key[:4]
+        # Chips this invocation spanned — from the program's OWN key (a
+        # quarantine between compile and invoke must not relabel it).
+        chips = prog.mesh[1] if prog.mesh is not None else 1
         self.registry.counter(
             "raft_program_calls_total",
             "device-program invocations by kind", kind=prog.kind).inc()
@@ -896,7 +1176,7 @@ class InferenceSession:
             tick_seq = self.deck.note_invocation(
                 kind=prog.kind, program=prog.ledger_id, b=b_key,
                 h=h_key, w=w_key, t0=t0, t1=t_end, host_s=host_s,
-                device_s=device_s, warming=False)
+                device_s=device_s, warming=False, chips=chips)
             attrs = {"program": prog.ledger_id}
             if tick_seq is not None:
                 # Standalone (sequential) deck row: the span links to it
@@ -911,7 +1191,7 @@ class InferenceSession:
             self.deck.note_invocation(
                 kind=prog.kind, program=prog.ledger_id, b=b_key,
                 h=h_key, w=w_key, t0=t0, t1=t_end, host_s=host_s,
-                device_s=device_s, warming=True)
+                device_s=device_s, warming=True, chips=chips)
             trace.add_span(prog.kind, t0, t_end, warming=True,
                            program=prog.ledger_id)
         if self.faults.poisoned(ordinal):
@@ -1321,6 +1601,33 @@ class InferenceSession:
                 "estimated remaining requests/s by shape bucket "
                 "(theoretical rps x (1 - saturation))",
                 bucket=bucket).set(headroom)
+        if self._mesh_base_n > 1:
+            # graftpod: the admission plane goes per-chip.  A mesh
+            # invocation's device window covers all its chips at once, so
+            # each chip's busy fraction counts the windows whose chip span
+            # included it; occupancy and headroom divide by the chip count
+            # (rows shard evenly by construction, pads excluded).
+            mesh = self.mesh_status()
+            per_chip = cap.saturation_per_chip(
+                self.deck.snapshot(), len(self._mesh_devices),
+                now=self.clock.now(), window_s=self._capacity_window_s)
+            best = max((m.get("headroom_rps") or 0.0
+                        for m in doc["by_bucket"].values()), default=None)
+            for row in per_chip:
+                chip = row["chip"]
+                row["quarantined"] = chip in self._quarantined
+                row["headroom_rps"] = (
+                    0.0 if row["quarantined"] else
+                    None if best is None else best / max(1, self.mesh_chips))
+                self.registry.gauge(
+                    "raft_capacity_chip_saturation",
+                    "device-busy fraction over the capacity window, "
+                    "per mesh chip", chip=str(chip)).set(
+                        row["ratio"] if row["ratio"] is not None else 0.0)
+            doc["chips"] = {"n_data": mesh["n_data"],
+                            "base_n_data": mesh["base_n_data"],
+                            "quarantined": mesh["quarantined"],
+                            "per_chip": per_chip}
         return doc
 
     # -- debug introspection (GET /debug/config) ---------------------------
@@ -1345,6 +1652,7 @@ class InferenceSession:
             "batch_buckets": list(self._batch_buckets),
             "max_programs": self._max_programs,
             "programs": programs,
+            "mesh": self.mesh_status(),
             "deck": self.deck.status(),
             "capacity_window_s": self._capacity_window_s,
         }
@@ -1373,6 +1681,7 @@ class InferenceSession:
     def status(self) -> Dict:
         with self._cache_lock:
             cached = [f"{k[0]}@b{k[1]}:{k[2]}x{k[3]}/it{k[4]}"
+                      + (f"/mesh{k[6][1]}" if len(k) > 6 else "")
                       for k in self._cache]
         return {
             "bucket": self.cfg.bucket,
@@ -1380,6 +1689,7 @@ class InferenceSession:
             "segments": self.cfg.segments,
             "max_batch": self.cfg.max_batch,
             "batch_buckets": list(self._batch_buckets),
+            "mesh": self.mesh_status(),
             "programs": {"cached": cached,
                          "capacity": self._max_programs,
                          **{k: v for k, v in self.metrics().items()

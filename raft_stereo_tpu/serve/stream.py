@@ -142,9 +142,10 @@ class StreamSession:
     once deposited (requests read it, never write it)."""
 
     __slots__ = ("key", "tenant", "flow", "padded_shape", "frames",
-                 "warm_frames", "created", "last_seen")
+                 "warm_frames", "created", "last_seen", "chip")
 
-    def __init__(self, key: Tuple[str, str], now: float):
+    def __init__(self, key: Tuple[str, str], now: float,
+                 chip: Optional[int] = None):
         self.key = key
         self.tenant = key[0]
         self.flow: Optional[np.ndarray] = None   # (1, H/f, W/f, 1) fp32
@@ -153,6 +154,13 @@ class StreamSession:
         self.warm_frames = 0
         self.created = now
         self.last_seen = now
+        # graftpod chip affinity: on a live mesh the session is pinned
+        # to one data-shard ordinal (round-robin at creation) so its
+        # frames keep landing on the same chip's rows; None on a
+        # single-device service.  Host-side placement hint only — the
+        # held flow is host memory, so a migrate (chip quarantined)
+        # keeps the stream warm.
+        self.chip = chip
 
 
 class StreamManager:
@@ -196,6 +204,9 @@ class StreamManager:
         self._table: "OrderedDict[Tuple[str, str], StreamSession]" = \
             OrderedDict()
         self._per_tenant: Dict[str, int] = {}
+        # graftpod: round-robin cursor for chip-affinity placement of
+        # new sessions (mutated under self._lock with the table).
+        self._rr_chip = 0
         reg = self.registry
         self._g_sessions = reg.gauge(
             "raft_stream_sessions", "live stream sessions (LRU+TTL "
@@ -256,7 +267,15 @@ class StreamManager:
             victim = next(iter(self._table))
             self._drop(victim)
             self._c_evicted.inc()
-        sess = self._table[key] = StreamSession(key, now)
+        # Chip-affinity placement (graftpod): spread new sessions round-
+        # robin over the mesh's data shards; None off-mesh so the stamp
+        # stays absent on a single-device service.
+        chip = None
+        n_chips = getattr(self.session, "mesh_chips", 1)
+        if getattr(self.session, "mesh_active", False) and n_chips > 1:
+            chip = self._rr_chip % n_chips
+            self._rr_chip += 1
+        sess = self._table[key] = StreamSession(key, now, chip=chip)
         self._per_tenant[tenant] = self._per_tenant.get(tenant, 0) + 1
         self._c_created.inc()
         return sess
@@ -277,6 +296,24 @@ class StreamManager:
         self._table.clear()
         self._per_tenant.clear()
         return n
+
+    def _migrate_locked(self, bad: set, n_chips: int) -> int:
+        # Caller holds self._lock.  Reassign sessions pinned to a bad
+        # chip — or to an ordinal past the shrunken mesh — round-robin
+        # over the survivors (None when the mesh is 1-wide).
+        migrated = 0
+        for sess in self._table.values():
+            if sess.chip is None:
+                continue
+            if sess.chip in bad or sess.chip >= max(1, n_chips):
+                if n_chips > 1:
+                    nxt = self._rr_chip % n_chips
+                    self._rr_chip += 1
+                    sess.chip = nxt
+                else:
+                    sess.chip = None
+                migrated += 1
+        return migrated
 
     # -- the request protocol ----------------------------------------------
 
@@ -306,6 +343,11 @@ class StreamManager:
             sess.last_seen = now
             sess.frames += 1
             request["_stream"] = key
+            if sess.chip is not None:
+                # graftpod: the scheduler's join phase stable-sorts by
+                # this stamp so same-chip stream rows pack together in
+                # the pod-wide batch (adjacent rows share a data shard).
+                request["_chip"] = sess.chip
             if sess.flow is not None and sess.padded_shape == padded:
                 # Warm frame: hand out the held seed.  A shape change
                 # (client reconfigured the camera) goes cold — the held
@@ -357,6 +399,23 @@ class StreamManager:
         self._c_converged.inc()
         self.session.usage.note_stream(tenant_label, converged=True)
 
+    def migrate_off_chips(self, quarantined, n_chips: int) -> int:
+        """graftpod migrate-on-bounce: reassign every session pinned to a
+        quarantined chip — or to an ordinal past the shrunken mesh — onto
+        a surviving data shard (round-robin).  The held flow is HOST
+        memory, so a migrated stream stays warm: its next frame rides
+        prepare_warm on the new chip (pinned in tests/test_mesh_serve.py).
+        Returns the number of sessions migrated."""
+        with self._lock:
+            migrated = self._migrate_locked(
+                set(int(c) for c in quarantined), int(n_chips))
+        if migrated:
+            self.registry.counter(
+                "raft_stream_migrations_total",
+                "stream sessions migrated off quarantined chips"
+            ).inc(migrated)
+        return migrated
+
     # -- lifecycle ---------------------------------------------------------
 
     def drop_all(self) -> int:
@@ -375,8 +434,16 @@ class StreamManager:
         with self._lock:
             per_tenant = dict(sorted(self._per_tenant.items()))
             n = len(self._table)
+            by_chip: Dict[str, int] = {}
+            for sess in self._table.values():
+                if sess.chip is not None:
+                    k = str(sess.chip)
+                    by_chip[k] = by_chip.get(k, 0) + 1
         return {
             "sessions": n,
+            # graftpod: live sessions per pinned data shard (empty on a
+            # single-device service — no fabricated zeros).
+            "by_chip": by_chip,
             "max_sessions": self.max_sessions,
             "per_tenant_cap": self.per_tenant,
             "per_tenant": per_tenant,

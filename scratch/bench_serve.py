@@ -30,6 +30,18 @@ Env overrides (RAFT_SERVE_BENCH_*):
                  (HTTP parse, multipart, PNG decode in the offload pool,
                  JSON+b64 response). Folded into the one JSON line as
                  ``loopback_rps`` plus its own TRAJECTORY entry.
+  MESH_SWEEP     graftpod ``--mesh`` sweep list  (default "1,2,4,8")
+
+``--mesh`` (graftpod, DESIGN.md r21): instead of the seq/batched/repeat
+battery, run the SAME closed-loop batched workload once per data-mesh
+width n in MESH_SWEEP — one service per n, ``SessionConfig.mesh_data=n``
+— and emit ``rps_per_chip`` + ``mesh_scaling_efficiency`` (rps(n) /
+(n * rps(1))) per width into the JSON line and trajectory extras.  Off
+chip this self-arms ``--xla_force_host_platform_device_count=8`` so the
+sweep runs anywhere; the fake devices share one physical CPU, so the
+off-chip efficiency is a WIRING number (the gate asserts emission, not
+the >=0.75 linear-scaling bar — that lands with the on-chip run, like
+every BASELINE.md device number).
 """
 
 from __future__ import annotations
@@ -48,6 +60,17 @@ def _env_int(name: str, default: int) -> int:
 
 
 def main() -> None:
+    mesh_mode = "--mesh" in sys.argv[1:]
+    if mesh_mode:
+        # Fake devices must exist BEFORE jax initializes: arm the flag
+        # here (a no-op on a real pod — the host platform is not the
+        # default backend there, and an operator-set XLA_FLAGS wins).
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+
     import jax
     import numpy as np
 
@@ -84,11 +107,11 @@ def main() -> None:
         for _ in range(min(n_requests, 4))  # cycle a few distinct frames
     ]
 
-    def run_mode(mb: int) -> dict:
+    def run_mode(mb: int, mesh: int = None) -> dict:
         session = InferenceSession(
             params, cfg,
             SessionConfig(valid_iters=iters, segments=segments,
-                          max_batch=mb,
+                          max_batch=mb, mesh_data=mesh,
                           warmup_shapes=((h, w),),
                           warmup_segmented=True))
         # cache_bytes=0: this mode measures COMPUTED requests/s on a
@@ -331,6 +354,69 @@ def main() -> None:
                 f"loopback mode: {len(bad)} non-200 responses, "
                 f"first: {bad[0]}")
         return {"rps": n_requests / elapsed, "elapsed_s": elapsed}
+
+    # -- graftpod mesh sweep (--mesh): one closed-loop batched run per
+    # data-mesh width, separate sessions (the mesh extent re-keys every
+    # batched program), scaling normalized against n_data=1. -----------
+    if mesh_mode:
+        sweep_raw = os.environ.get("RAFT_SERVE_BENCH_MESH_SWEEP",
+                                   "1,2,4,8")
+        sweep = sorted({int(s) for s in sweep_raw.split(",") if s.strip()})
+        n_dev = len(jax.devices())
+        skipped = [n for n in sweep if n > n_dev]
+        sweep = [n for n in sweep if n <= n_dev]
+        if skipped:
+            # No silent caps: a 4-device host drops the 8-wide point
+            # visibly, never pretends it ran.
+            print(json.dumps({"event": "mesh_sweep_skipped",
+                              "skipped": skipped, "devices": n_dev}),
+                  file=sys.stderr)
+        if 1 not in sweep:
+            sweep.insert(0, 1)  # the scaling baseline is mandatory
+        per_n = {}
+        for n in sweep:
+            r = run_mode(max_batch, mesh=n)
+            per_n[n] = r
+        base_rps = per_n[1]["rps"]
+        mesh_doc = {}
+        for n, r in per_n.items():
+            mesh_doc[str(n)] = {
+                "rps": round(r["rps"], 4),
+                "rps_per_chip": round(r["rps"] / n, 4),
+                "mesh_scaling_efficiency": (
+                    round(r["rps"] / (n * base_rps), 4)
+                    if base_rps else None),
+                "pad_waste": r.get("pad_waste"),
+                "occupancy_mean": r.get("occupancy_mean"),
+            }
+        top = max(per_n)
+        doc = {
+            "metric": (f"serve_mesh_scaling_{h}x{w}_i{iters}_{corr}"
+                       f"_b{max_batch}{'_tiny' if tiny else ''}"),
+            "value": mesh_doc[str(top)]["mesh_scaling_efficiency"],
+            "unit": "x-linear",
+            "n_data_max": top,
+            "devices": n_dev,
+            "rps_per_chip": mesh_doc[str(top)]["rps_per_chip"],
+            "mesh_scaling_efficiency":
+                mesh_doc[str(top)]["mesh_scaling_efficiency"],
+            "by_n_data": mesh_doc,
+            "n_requests": n_requests,
+            "max_batch": max_batch,
+            "backend": jax.default_backend(),
+        }
+        print(json.dumps(doc))
+        from raft_stereo_tpu.obs.trajectory import emit
+        emit(doc["metric"],
+             doc["value"] if doc["value"] is not None else 0.0,
+             "x-linear", backend=jax.default_backend(),
+             source="scratch/bench_serve.py",
+             extra={"by_n_data": mesh_doc,
+                    "rps_per_chip": doc["rps_per_chip"],
+                    "mesh_scaling_efficiency":
+                        doc["mesh_scaling_efficiency"],
+                    "devices": n_dev, "n_data_max": top})
+        return
 
     # Sequential first (its warmup also proves the shape compiles), then
     # batched. Separate sessions: programs differ by batch bucket anyway,

@@ -3,6 +3,12 @@ DESIGN.md r13) — the release-gate proof that self-healing actually heals.
 ``--wire`` switches to the graftwire network storm (DESIGN.md r14): the
 same seeded-determinism stance, but the faults are HOSTILE CLIENTS over
 real loopback sockets and the server side is unmodified production code.
+``--mesh`` switches to the graftpod one-chip-hang scenario (DESIGN.md
+r21): a 2-chip data mesh on fake CPU devices takes an injected device
+hang whose post-bounce health probe parks on exactly ONE chip — the
+bounce must quarantine that chip alone, shrink the mesh, migrate the
+chip-pinned stream sessions (held seeds are host-side, so they stay
+warm), keep serving on the survivors, and reconcile the books.
 
 Drives N seeded requests through the REAL ``StereoService`` (continuous
 batching, retry budget, watchdog supervision armed) under a composite
@@ -856,13 +862,249 @@ def main_wire() -> int:
     return 0
 
 
+# ---------------------------------------------------------------------------
+# Pod mesh storm (graftpod, DESIGN.md r21): one chip of a 2-chip data mesh
+# hangs; the bounce must quarantine it ALONE and the survivors keep serving.
+# ---------------------------------------------------------------------------
+
+#: Hard real-time bound on the mesh storm (CPU fake devices, tiny model;
+#: each chip-probe sweep with a parked probe costs ~2 s real).
+MESH_BOUND_S = 120.0
+
+
+def main_mesh() -> int:
+    import numpy as np
+
+    import jax
+
+    from raft_stereo_tpu.config import RAFTStereoConfig, with_eval_precision
+    from raft_stereo_tpu.faults import ChaosPlan, FakeClock
+    from raft_stereo_tpu.models import init_raft_stereo
+    from raft_stereo_tpu.obs.flight import FlightRecorder
+    from raft_stereo_tpu.serve import (InferenceSession, ServiceConfig,
+                                       SessionConfig, StereoService)
+
+    n = int(os.environ.get("RAFT_CHAOS_N", "36"))
+    seed = int(os.environ.get("RAFT_CHAOS_SEED", "1234"))
+    assert len(jax.devices()) >= 2, (
+        f"mesh storm needs >=2 devices, found {len(jax.devices())} — run "
+        f"under XLA_FLAGS=--xla_force_host_platform_device_count=8 (the "
+        f"__main__ dispatch arms it when unset)")
+    rng = np.random.default_rng(seed)
+    # Two invoke-hang ordinals past the compile-heavy head (either one
+    # suffices to trip the watchdog; non-vacuity is asserted below), and
+    # chip 1's post-bounce health probe parks — the chip that "stayed
+    # wedged after the bounce freed the invoke".  hang_cap_s must exceed
+    # probe_chips' join timeout (2 s) or the parked probe self-releases
+    # early and reads healthy.
+    plan = ChaosPlan(hang_invokes={10: 10.0, 18: 10.0},
+                     hang_chips=(1,), hang_cap_s=5.0)
+
+    cfg = with_eval_precision(RAFTStereoConfig(
+        n_gru_layers=1, hidden_dims=(32, 32, 32),
+        corr_levels=2, corr_radius=2))
+    params = init_raft_stereo(jax.random.PRNGKey(0), cfg)
+    clock = FakeClock()
+    flight_dir = tempfile.mkdtemp(prefix="chaos-mesh-flight-")
+    session = InferenceSession(
+        params, cfg,
+        SessionConfig(valid_iters=4, segments=2, max_batch=4,
+                      batch_buckets=(1, 4), canary=False, mesh_data=2),
+        fault_plan=plan, clock=clock,
+        flight=FlightRecorder(flight_dir, limit=1000))
+    assert session.mesh_active and session.mesh_chips == 2, \
+        session.mesh_status()
+    assert all(b % 2 == 0 for b in session.batch_buckets), (
+        f"mesh bucket rounding never engaged: {session.batch_buckets}")
+    svc = StereoService(session, ServiceConfig(
+        max_queue=16, watchdog_ms=2000.0, retry_budget=3,
+        drain_grace_ms=10_000.0)).start()
+
+    pairs = [(rng.uniform(0, 255, (H, W, 3)).astype(np.float32),
+              rng.uniform(0, 255, (H, W, 3)).astype(np.float32))
+             for _ in range(4)]
+
+    def make_request(i) -> dict:
+        left, right = pairs[hash(str(i)) % len(pairs)]
+        req = {"id": i, "left": left[None], "right": right[None],
+               "tenant": f"tenant-{hash(str(i)) % 3}"}
+        # A third of the storm rides stream sessions: round-robin chip
+        # affinity pins cam-0 -> chip 0, cam-1 -> chip 1, so the
+        # quarantine provably has a pinned session to migrate.
+        if isinstance(i, int) and i % 3 == 0:
+            req["stream"] = f"cam-{(i // 3) % 2}"
+        return req
+
+    t_real0 = time.monotonic()
+    deadline_real = t_real0 + MESH_BOUND_S
+    results: dict = {}
+    futs: dict = {}
+    submitted = 0
+    while len(results) < n:
+        assert time.monotonic() < deadline_real, (
+            f"mesh storm exceeded its {MESH_BOUND_S}s real-time bound "
+            f"with {n - len(results)} Futures unresolved")
+        while submitted < n and len(futs) < IN_FLIGHT_CAP:
+            futs[submitted] = svc.submit(make_request(submitted))
+            submitted += 1
+        sup = svc._supervisor
+        if sup is not None:
+            sup.check_now()
+        for rid in [r for r, f in futs.items() if f.done()]:
+            results[rid] = futs.pop(rid).result(timeout=1)
+        time.sleep(0.002)
+
+    # -- invariant 1: the hang landed and the watchdog bounced -----------
+    reg = svc.registry
+    assert session.faults.hangs_entered >= 1, (
+        "no injected hang ever parked a live invocation — the mesh storm "
+        "is vacuous for the device-hang path; retune the ordinals")
+    restarts = {labels["reason"]: int(v) for labels, v in
+                reg.series("raft_sched_restarts_total")}
+    assert restarts.get("device_hang", 0) >= 1, (
+        f"no device_hang bounce ever fired: {restarts}")
+
+    # -- invariant 2: the bounce quarantined EXACTLY chip 1 --------------
+    mesh = session.mesh_status()
+    assert mesh["quarantined"] == [1], (
+        f"chip-local quarantine failed — expected exactly chip 1, got "
+        f"{mesh}")
+    assert mesh["enabled"] and mesh["n_data"] == 1, mesh
+    assert mesh["epoch"] >= 1 and mesh["base_n_data"] == 2, mesh
+    assert int(reg.value("raft_mesh_chips_quarantined_total")) == 1
+
+    # -- invariant 3: the chip-pinned stream session migrated warm -------
+    assert int(reg.value("raft_stream_migrations_total")) >= 1, (
+        "chip 1's pinned stream session never migrated off the "
+        "quarantined chip")
+    by_chip = svc.stream.status()["by_chip"]
+    assert all(int(c) == 0 for c in by_chip), (
+        f"a stream session is still pinned past the 1-chip mesh: "
+        f"{by_chip}")
+    warm_before = int(reg.value("raft_stream_warm_joins_total"))
+    sfm = make_request(3)       # cam-1: the migrated session's stream
+    sfm["id"] = "post-quarantine-warm"
+    rwm = svc.submit(sfm).result(timeout=30)
+    assert rwm["status"] == "ok", rwm
+    assert sfm.get("_flow_init") is not None, (
+        "the migrated stream session lost its held warm seed — the seed "
+        "is host-side state and must survive a chip quarantine")
+    assert int(reg.value("raft_stream_warm_joins_total")) \
+        >= warm_before + 1, "the migrated stream frame never warm-joined"
+
+    # -- invariant 4: the SURVIVING chips keep serving -------------------
+    post = [svc.submit(make_request(f"post-{j}")).result(timeout=30)
+            for j in range(8)]
+    for r in post:
+        assert r["status"] == "ok", (
+            f"post-quarantine serving failed — the bounce was not "
+            f"chip-local: {r}")
+        assert np.isfinite(r["disparity"]).all()
+
+    # -- invariant 5: every storm outcome is structured ------------------
+    responses = list(results.values()) + [rwm] + post
+    assert len(results) == n
+    for r in responses:
+        assert r["status"] in ("ok", "rejected", "error"), r
+        if r["status"] != "ok":
+            assert r.get("code"), r
+
+    # -- invariant 6: the books reconcile under the mesh -----------------
+    # Pod-wide ticks really spanned 2 chips, and the device-seconds
+    # partition stays exact integer-ns: one invocation's wall interval is
+    # ONE interval no matter how many chips it spanned.
+    mesh_ticks = sum(1 for t in session.deck.snapshot()
+                     if int(t.get("chips", 1)) > 1)
+    assert mesh_ticks >= 1, (
+        "no tick ever spanned >1 chip — the mesh storm never exercised a "
+        "pod-wide device batch")
+    usage_doc = session.usage.doc()
+    tenant_ns = sum(t["device_ns"] for t in usage_doc["by_tenant"].values())
+    assert tenant_ns == usage_doc["device_ns_total"], (
+        f"per-tenant device-ns sum {tenant_ns} != accounted total "
+        f"{usage_doc['device_ns_total']} under the mesh")
+    prog_dev_s = sum(v for _, v in
+                     reg.series("raft_program_device_seconds_total"))
+    assert abs(usage_doc["device_ns_total"] / 1e9 - prog_dev_s) <= \
+        max(1e-6, 1e-9 * prog_dev_s), (
+        usage_doc["device_ns_total"] / 1e9, prog_dev_s)
+    cap_doc = session.capacity_status()
+    chips = cap_doc.get("chips")
+    assert chips and chips["n_data"] == 1 and \
+        chips["quarantined"] == [1], chips
+    assert len(chips["per_chip"]) == 2, chips
+    for row in chips["per_chip"]:
+        if row["quarantined"]:
+            assert row["headroom_rps"] == 0.0, (
+                f"a quarantined chip still advertises headroom: {row}")
+
+    # -- invariant 7: the bounce left chip-naming flight evidence --------
+    mesh_records = 0
+    for path in session.flight.records():
+        with open(path) as f:
+            doc = json.load(f)
+        mesh_block = doc.get("mesh")
+        if mesh_block and mesh_block.get("quarantined") == [1]:
+            mesh_records += 1
+    assert mesh_records >= 1, (
+        "the quarantining bounce left no flight record naming chip 1")
+
+    assert svc.drain(), "mesh-storm service failed to drain"
+    elapsed_real = time.monotonic() - t_real0
+
+    outcomes: dict = {}
+    for r in responses:
+        key = (r["status"] if r["status"] == "ok"
+               else f'{r["status"]}:{r["code"]}')
+        outcomes[key] = outcomes.get(key, 0) + 1
+    doc = {
+        "metric": "mesh_chaos",
+        "pass": True,
+        "n": n,
+        "seed": seed,
+        "devices": len(jax.devices()),
+        "mesh": {k: mesh[k] for k in
+                 ("n_data", "base_n_data", "epoch", "quarantined")},
+        "outcomes": dict(sorted(outcomes.items())),
+        "restarts": restarts,
+        "migrations": int(reg.value("raft_stream_migrations_total")),
+        "mesh_ticks": mesh_ticks,
+        "hangs_entered": session.faults.hangs_entered,
+        "elapsed_real_s": round(elapsed_real, 2),
+        "backend": jax.default_backend(),
+    }
+    print(json.dumps(doc))
+
+    from raft_stereo_tpu.obs.trajectory import emit
+    emit("mesh_chaos_chip_bounce", 1.0, "frac",
+         backend=jax.default_backend(), source="scratch/chaos_serve.py",
+         extra={"n": n, "restarts": sum(restarts.values()),
+                "quarantined": mesh["quarantined"],
+                "elapsed_real_s": doc["elapsed_real_s"]})
+    return 0
+
+
 if __name__ == "__main__":
     _wire = "--wire" in sys.argv[1:] or \
         os.environ.get("RAFT_CHAOS_WIRE", "").strip().lower() in (
             "1", "true", "yes", "on")
+    _mesh = "--mesh" in sys.argv[1:] or \
+        os.environ.get("RAFT_CHAOS_MESH", "").strip().lower() in (
+            "1", "true", "yes", "on")
+    if _mesh:
+        # Arm the fake-device pod BEFORE anything imports jax (the same
+        # self-arming bench_serve.py --mesh does).
+        _flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in _flags:
+            os.environ["XLA_FLAGS"] = (
+                _flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+    _metric = ("wire_chaos" if _wire else
+               "mesh_chaos" if _mesh else "chaos_soak")
     try:
-        raise SystemExit(main_wire() if _wire else main())
+        raise SystemExit(main_wire() if _wire
+                         else main_mesh() if _mesh else main())
     except AssertionError as e:
-        print(json.dumps({"metric": "wire_chaos" if _wire else "chaos_soak",
-                          "pass": False, "error": str(e)}))
+        print(json.dumps({"metric": _metric, "pass": False,
+                          "error": str(e)}))
         raise SystemExit(1)

@@ -221,6 +221,42 @@ else
     echo "FAIL: fleet chaos storm"; fail=1
 fi
 
+# graftpod mesh-serve battery (ISSUE 17, DESIGN.md r21): mesh-sharded
+# batched responses vs single-device at the same bucket, the
+# local_batch_rows edge battery, chip-affinity + migrate-on-bounce with
+# the host-side warm seed, integer-ns reconciliation when one invoke
+# spans N chips, quarantine shrink, per-chip capacity. Runs under the
+# 8-fake-device CPU topology (tests/conftest.py arms it).
+step "mesh-serve battery (graftpod: sharded parity, affinity, per-chip books)"
+env JAX_PLATFORMS=cpu python -m pytest tests/test_mesh_serve.py -q -m mesh \
+    -p no:cacheprovider -p no:xdist -p no:randomly \
+    || { echo "FAIL: mesh-serve battery"; fail=1; }
+
+# Mesh chaos storm (ISSUE 17 acceptance): a 2-chip data mesh on 8 fake
+# CPU devices takes an injected device hang whose post-bounce probe
+# parks on exactly ONE chip — asserts the bounce quarantines that chip
+# alone, the mesh shrinks to the divisor width, the chip-pinned stream
+# sessions migrate WARM (held seed is host-side), the surviving chips
+# keep serving, and the device-seconds books still reconcile exactly.
+step "mesh chaos storm (one-chip hang vs chip-local quarantine)"
+if env JAX_PLATFORMS=cpu python scratch/chaos_serve.py --mesh > mesh_chaos.json; then
+    cat mesh_chaos.json
+else
+    echo "--- mesh_chaos.json ---"; cat mesh_chaos.json
+    echo "FAIL: mesh chaos storm"; fail=1
+fi
+
+# Mesh scaling bench smoke (ISSUE 17 acceptance wiring): sweep
+# n_data in {1,2,4,8} over fake CPU devices and emit rps_per_chip +
+# mesh_scaling_efficiency into the trajectory. On this single-core CPU
+# the efficiency NUMBER is meaningless (all 8 "chips" share one core),
+# so the smoke asserts the fields emit; the >=0.75x-linear bar lands
+# with the on-chip run like every other perf acceptance.
+step "mesh scaling bench smoke (n_data sweep over fake devices)"
+env JAX_PLATFORMS=cpu RAFT_SERVE_BENCH_TINY=1 \
+    python scratch/bench_serve.py --mesh \
+    || { echo "FAIL: mesh bench smoke"; fail=1; }
+
 backend=$(python - <<'EOF'
 import jax
 print(jax.default_backend())

@@ -81,6 +81,16 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument('--tick_ms', type=float, default=None,
                         help="scheduler idle-poll interval (batched mode; "
                         "default RAFT_SCHED_TICK_MS or 2 ms)")
+    # graftpod: pod-scale serving (DESIGN.md r21)
+    parser.add_argument('--mesh_data', type=int, default=None,
+                        help="shard the device batch over this many chips "
+                        "(data mesh): one ingress drives N devices, batch "
+                        "buckets round up to multiples of N, per-chip "
+                        "occupancy/saturation surfaces on /healthz "
+                        "(default RAFT_SERVE_MESH_DATA or 1 = single "
+                        "device; validate off-chip with JAX_PLATFORMS=cpu "
+                        "XLA_FLAGS=--xla_force_host_platform_device_"
+                        "count=8)")
     parser.add_argument('--max_pixels', type=int, default=8 << 20,
                         help="admission cap on per-image area")
     parser.add_argument('--warmup', default=None,
@@ -308,6 +318,7 @@ def serve(args) -> int:
             canary=not args.no_canary,
             allow_half_res=not args.no_half_res,
             max_batch=args.max_batch,
+            mesh_data=args.mesh_data,
             admission=AdmissionConfig(max_pixels=args.max_pixels)))
     service = StereoService(session, ServiceConfig(
         max_queue=args.max_queue, workers=args.workers,

@@ -441,6 +441,21 @@ def test_gl002_real_tree_cache_knob_registered():
     assert hits[0].path.endswith("serve/cache.py")
 
 
+def test_gl002_real_tree_mesh_knob_registered():
+    # RAFT_SERVE_MESH_DATA (serve/session.py resolve_serve_mesh_data,
+    # the graftpod data-mesh extent) is covered by HOST_ENV_KNOBS; drop
+    # it and GL002 must fire at the read site — the r21 pod knobs cannot
+    # silently drift out of the registry (the drop leaves
+    # RAFT_SERVE_MESH_FALLBACK covered so the hit is unambiguous).
+    files = collect_files([str(PACKAGE)], base=str(REPO))
+    reduced = tuple(k for k in knobs.SERVE_ENV_KNOBS + knobs.HOST_ENV_KNOBS
+                    if k != "RAFT_SERVE_MESH_DATA")
+    rep = run_checkers(Project(files, serve_knobs=reduced))
+    hits = [f for f in rep.findings if f.code == "GL002"]
+    assert hits and "RAFT_SERVE_MESH_DATA" in hits[0].message
+    assert hits[0].path.endswith("serve/session.py")
+
+
 def test_gl002_real_tree_dropped_knob_fails():
     # Acceptance fixture: drop RAFT_CORR_TILE from the registry while its
     # read still exists in corr/pallas_reg.py -> GL002 must fire.

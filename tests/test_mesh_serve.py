@@ -1,0 +1,453 @@
+"""graftpod pod-serving battery (ISSUE 17, DESIGN.md r21).
+
+Mesh-sharded batched serving on the 8-fake-device CPU topology
+(tests/conftest.py arms ``xla_force_host_platform_device_count=8``):
+
+- knob resolution (named ValueErrors, kill switch, explicit-config wins);
+- the n_data=1 path stays byte-identical (cache keys carry NO mesh
+  component off-mesh — the fallback contract);
+- mesh-sharded batched responses match single-device serving at the
+  SAME batch bucket (B=4 and B=8, odd unpadded widths, pad rows,
+  warm+cold mixed in one tick) under the cross-batch-size comparison
+  discipline of tests/test_batch_serve.py (``assert_rows_match``);
+- quarantine shrinks the mesh to the largest divisor of the base extent
+  that fits the survivors, bumps the epoch (re-keying programs), and the
+  shrunken mesh still serves;
+- chip-affinity placement round-robins new stream sessions over the
+  data shards and migrate-on-bounce keeps the held warm seed (it is
+  HOST-side memory);
+- the device-seconds books stay exact integer-ns partitions when one
+  invoke spans N chips (one wall interval, never multiplied by the chip
+  span), and the per-chip capacity plane reports honestly.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from raft_stereo_tpu.config import RAFTStereoConfig
+from raft_stereo_tpu.faults import FakeClock, ServeFaultPlan
+from raft_stereo_tpu.models import init_raft_stereo
+from raft_stereo_tpu.obs.capacity import saturation_per_chip
+from raft_stereo_tpu.parallel.mesh import local_batch_rows, make_mesh
+from raft_stereo_tpu.serve import (BatchScheduler, InferenceSession,
+                                   SessionConfig)
+from raft_stereo_tpu.serve.session import (resolve_mesh_fallback,
+                                           resolve_serve_mesh_data)
+from raft_stereo_tpu.serve.stream import StreamManager
+from raft_stereo_tpu.serve.validate import AdmissionConfig, validate_pair
+from tests.test_batch_serve import assert_rows_match
+
+pytestmark = pytest.mark.mesh
+
+TINY = dict(n_gru_layers=1, hidden_dims=(32, 32, 32),
+            corr_levels=2, corr_radius=2)
+H, W = 40, 60  # not multiples of 32: every request really is padded
+
+
+@pytest.fixture(scope="module")
+def tiny_cfg():
+    return RAFTStereoConfig(**TINY)
+
+
+@pytest.fixture(scope="module")
+def tiny_params(tiny_cfg):
+    return init_raft_stereo(jax.random.PRNGKey(0), tiny_cfg)
+
+
+@pytest.fixture(scope="module")
+def pairs():
+    rng = np.random.default_rng(7)
+    return [(rng.uniform(0, 255, (H, W, 3)).astype(np.float32),
+             rng.uniform(0, 255, (H, W, 3)).astype(np.float32))
+            for _ in range(8)]
+
+
+def make_session(params, cfg, *, mesh_data=None, max_batch=4,
+                 batch_buckets=(1, 4), plan=None, **kw):
+    scfg = SessionConfig(valid_iters=4, segments=2, max_batch=max_batch,
+                         batch_buckets=batch_buckets, canary=False,
+                         mesh_data=mesh_data, **kw)
+    return InferenceSession(params, cfg, scfg, fault_plan=plan,
+                            clock=FakeClock())
+
+
+def canonical(pair):
+    return validate_pair(pair[0], pair[1], AdmissionConfig())
+
+
+def make_request(pair, rid=None, tenant=None, **extra):
+    left, right = canonical(pair)
+    req = {"id": rid, "left": left, "right": right}
+    if tenant is not None:
+        req["tenant"] = tenant
+    req.update(extra)
+    return req
+
+
+def run_sched(session, requests, *, stream=None, one_tick=True):
+    """Drive a scheduler to completion; with ``one_tick`` every joiner
+    must be admissible before the first tick so they share one batch."""
+    out = {}
+    sched = BatchScheduler(
+        session, resolve=lambda rq, rs: out.__setitem__(rq["id"], rs),
+        stream=stream)
+    for rq in requests:
+        sched.submit(rq)
+    if one_tick:
+        for bucket in sched._buckets.values():
+            for row in list(bucket.pending):
+                assert row.uploaded.wait(timeout=30)
+    spins = 0
+    while len(out) < len(requests):
+        if not sched.run_tick():
+            time.sleep(0.002)
+        spins += 1
+        assert spins < 4000, "scheduler made no progress"
+    status = sched.status()
+    sched.shutdown()
+    assert len(out) == len(requests)
+    return out, status
+
+
+# ---------------------------------------------------------------------------
+# Knob resolution and the n_data=1 fallback contract.
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_knob_resolution_named_errors(monkeypatch):
+    monkeypatch.delenv("RAFT_SERVE_MESH_DATA", raising=False)
+    assert resolve_serve_mesh_data() == 1          # unset: pre-pod default
+    monkeypatch.setenv("RAFT_SERVE_MESH_DATA", "nope")
+    with pytest.raises(ValueError, match="RAFT_SERVE_MESH_DATA"):
+        resolve_serve_mesh_data()
+    monkeypatch.setenv("RAFT_SERVE_MESH_DATA", "0")
+    with pytest.raises(ValueError, match=">= 1"):
+        resolve_serve_mesh_data()
+    monkeypatch.setenv("RAFT_SERVE_MESH_DATA", "4")
+    assert resolve_serve_mesh_data() == 4
+    assert resolve_serve_mesh_data(2) == 2         # explicit config wins
+    with pytest.raises(ValueError, match=">= 1"):
+        resolve_serve_mesh_data(0)
+    monkeypatch.delenv("RAFT_SERVE_MESH_FALLBACK", raising=False)
+    assert resolve_mesh_fallback() is False
+    for raw in ("1", "true", "yes"):
+        monkeypatch.setenv("RAFT_SERVE_MESH_FALLBACK", raw)
+        assert resolve_mesh_fallback() is True
+    monkeypatch.setenv("RAFT_SERVE_MESH_FALLBACK", "0")
+    assert resolve_mesh_fallback() is False
+
+
+def test_mesh_fallback_keeps_single_device_path(monkeypatch, tiny_params,
+                                                tiny_cfg):
+    """The kill switch forces n_data=1 with cache keys BYTE-identical to
+    a never-meshed session — the fallback is the pre-pod code path, not
+    a 1-chip mesh."""
+    plain = make_session(tiny_params, tiny_cfg)
+    monkeypatch.setenv("RAFT_SERVE_MESH_DATA", "4")
+    monkeypatch.setenv("RAFT_SERVE_MESH_FALLBACK", "1")
+    off = make_session(tiny_params, tiny_cfg)
+    assert not off.mesh_active and off.mesh_chips == 1
+    assert off.batch_buckets == plain.batch_buckets == (1, 4)
+    k_off = off.cache_key("advance", 64, 64, 2, b=4)
+    k_plain = plain.cache_key("advance", 64, 64, 2, b=4)
+    assert k_off == k_plain
+    assert not any(isinstance(c, tuple) and c and c[0] == "mesh"
+                   for c in k_off)
+
+
+def test_mesh_session_activates_and_keys(tiny_params, tiny_cfg):
+    sess = make_session(tiny_params, tiny_cfg, mesh_data=2)
+    assert sess.mesh_active and sess.mesh_chips == 2
+    # Bucket rounding: every bucket divisible by the mesh extent, so the
+    # leading dim always shards evenly ((1, 4) -> (2, 4)).
+    assert sess.batch_buckets == (2, 4)
+    st = sess.mesh_status()
+    assert st["enabled"] and st["n_data"] == 2 and st["base_n_data"] == 2
+    assert st["epoch"] == 0 and st["quarantined"] == []
+    assert len(st["devices"]) == 2          # the POD, not the whole host
+    # Mesh rides the program-cache key as a trailing component (like the
+    # batch bucket) — never the config fingerprint, so the host-side
+    # response cache stays ONE cache above all chips.
+    k = sess.cache_key("advance", 64, 64, 2, b=4)
+    assert k[-1] == ("mesh", 2, 0)
+    plain = make_session(tiny_params, tiny_cfg)
+    assert plain.cache_key("advance", 64, 64, 2, b=4) == k[:-1]
+    assert sess.fingerprint_id() == plain.fingerprint_id()
+
+
+def test_local_batch_rows_mesh_edges():
+    """The divisibility rule the bucket rounding enforces, at the mesh
+    seam: an indivisible batch (or n_data > batch) has no contiguous
+    per-process row range."""
+    mesh4 = make_mesh(n_data=4, n_space=1)
+    assert local_batch_rows(mesh4, 8) == slice(0, 8)
+    assert local_batch_rows(mesh4, 4) == slice(0, 4)
+    assert local_batch_rows(mesh4, 6) is None      # 6 % 4 != 0
+    mesh8 = make_mesh(n_data=8, n_space=1)
+    assert local_batch_rows(mesh8, 4) is None      # n_data > batch
+    assert local_batch_rows(mesh8, 8) == slice(0, 8)
+    mesh1 = make_mesh(n_data=1, n_space=1)
+    assert local_batch_rows(mesh1, 3) == slice(0, 3)
+
+
+# ---------------------------------------------------------------------------
+# Parity: mesh-sharded batched responses == single-device at the same
+# bucket (cross-batch-SIZE comparisons stay out — both sides ride the
+# identical bucket, only the sharding differs, which is exactly the
+# graftpod claim under test).
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_scheduler_parity_b4_and_pad_rows(tiny_params, tiny_cfg,
+                                               pairs):
+    mesh_sess = make_session(tiny_params, tiny_cfg, mesh_data=2)
+    plain = make_session(tiny_params, tiny_cfg)
+    # B=4: one full tick at bucket 4 on both sessions.
+    want, _ = run_sched(plain, [make_request(p, rid=i)
+                                for i, p in enumerate(pairs[:4])])
+    got, st4 = run_sched(mesh_sess, [make_request(p, rid=i)
+                                     for i, p in enumerate(pairs[:4])])
+    for i in range(4):
+        assert got[i]["status"] == want[i]["status"] == "ok"
+        assert got[i]["quality"] == "full"
+        assert_rows_match(got[i]["disparity"], want[i]["disparity"],
+                          f"b4 row {i}")
+    # B=3 -> bucket 4 with one pad row: pads land in pad_waste, never in
+    # occupancy (live-row truth), and parity holds next to the pad.
+    # Histograms are session-lifetime, so pin the DELTA over the b4 run.
+    got3, st3 = run_sched(mesh_sess, [make_request(p, rid=i)
+                                      for i, p in enumerate(pairs[:3])])
+    for i in range(3):
+        assert got3[i]["status"] == "ok"
+        assert_rows_match(got3[i]["disparity"], want[i]["disparity"],
+                          f"b3 row {i}")
+    assert st3["pad_waste"] > st4["pad_waste"]
+    assert st3["occupancy_hist"].get("3", 0) >= \
+        st4["occupancy_hist"].get("3", 0) + 1
+    assert st3["occupancy_hist"].get("4", 0) == \
+        st4["occupancy_hist"].get("4", 0), \
+        "the pad row leaked into occupancy as a live row"
+
+
+def test_mesh_scheduler_parity_b8(tiny_params, tiny_cfg, pairs):
+    """B=8 over a 4-chip mesh (2 rows per chip) vs single-device at the
+    same bucket — the widest pod shape the fake-device topology covers
+    without sharing a bucket program between the runs."""
+    mesh_sess = make_session(tiny_params, tiny_cfg, mesh_data=4,
+                             max_batch=8, batch_buckets=(1, 8))
+    assert mesh_sess.batch_buckets == (4, 8)
+    plain = make_session(tiny_params, tiny_cfg, max_batch=8,
+                         batch_buckets=(1, 8))
+    reqs = [make_request(p, rid=i) for i, p in enumerate(pairs)]
+    want, _ = run_sched(plain, [make_request(p, rid=i)
+                                for i, p in enumerate(pairs)])
+    got, _ = run_sched(mesh_sess, reqs)
+    for i in range(8):
+        assert got[i]["status"] == "ok" and got[i]["quality"] == "full"
+        assert_rows_match(got[i]["disparity"], want[i]["disparity"],
+                          f"b8 row {i}")
+
+
+def test_mesh_warm_cold_mixed_one_tick_parity(tiny_params, tiny_cfg,
+                                              pairs):
+    """A warm joiner (held flow seed) and cold joiners share one
+    mesh-sharded tick; every response matches the single-device run of
+    the same mix, and the warm row genuinely warm-started."""
+    l, r = canonical(pairs[0])
+    f = tiny_cfg.downsample_factor
+
+    def requests(sess):
+        ph, pw = sess.padder_for(l.shape).padded_shape
+        rng = np.random.default_rng(9)
+        flow = rng.uniform(-1, 1,
+                           (1, ph // f, pw // f, 1)).astype(np.float32)
+        return ([{"id": "w", "left": l, "right": r, "_flow_init": flow}]
+                + [make_request(pairs[1 + i], rid=f"c{i}")
+                   for i in range(2)])
+
+    mesh_sess = make_session(tiny_params, tiny_cfg, mesh_data=2)
+    plain = make_session(tiny_params, tiny_cfg)
+    warm_before = int(
+        mesh_sess.registry.value("raft_stream_warm_joins_total"))
+    want, _ = run_sched(plain, requests(plain),
+                        stream=StreamManager(plain))
+    got, _ = run_sched(mesh_sess, requests(mesh_sess),
+                       stream=StreamManager(mesh_sess))
+    for rid in ("w", "c0", "c1"):
+        assert got[rid]["status"] == want[rid]["status"] == "ok"
+        assert_rows_match(got[rid]["disparity"], want[rid]["disparity"],
+                          f"mixed row {rid}")
+    assert int(mesh_sess.registry.value(
+        "raft_stream_warm_joins_total")) == warm_before + 1
+    # Non-vacuity: the warm row's seed actually changed its result.
+    assert got["w"]["disparity"].tobytes() != \
+        got["c0"]["disparity"].tobytes()
+
+
+# ---------------------------------------------------------------------------
+# Quarantine: chip-local shrink, epoch re-key, survivors keep serving.
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_quarantine_shrink_and_rekey(tiny_params, tiny_cfg, pairs):
+    sess = make_session(tiny_params, tiny_cfg, mesh_data=4,
+                        max_batch=8, batch_buckets=(1, 8))
+    k0 = sess.cache_key("advance", 64, 64, 2, b=8)
+    assert k0[-1] == ("mesh", 4, 0)
+    # Chip 2 of 4 hangs: 3 survivors, largest divisor of 4 that fits is
+    # 2 — the mesh shrinks, the epoch bumps, programs re-key.
+    assert sess.quarantine_chip(2)
+    assert sess.mesh_chips == 2
+    st = sess.mesh_status()
+    assert st["quarantined"] == [2] and st["epoch"] == 1
+    assert [d["chip"] for d in st["devices"] if d["quarantined"]] == [2]
+    k1 = sess.cache_key("advance", 64, 64, 2, b=8)
+    assert k1[-1] == ("mesh", 2, 1) and k1 != k0
+    # Idempotence + bounds: re-quarantine and out-of-range are refused.
+    assert not sess.quarantine_chip(2)
+    assert not sess.quarantine_chip(99)
+    assert int(sess.registry.value(
+        "raft_mesh_chips_quarantined_total")) == 1
+    # The shrunken mesh still serves (batch 8 now shards 4 rows/chip).
+    out, _ = run_sched(sess, [make_request(p, rid=i)
+                              for i, p in enumerate(pairs[:8])])
+    assert all(out[i]["status"] == "ok" for i in range(8))
+    # Shrink to the floor: two more hangs leave one healthy chip, which
+    # keeps a (1,1) mesh so placement lands on a HEALTHY device.
+    assert sess.quarantine_chip(0)
+    assert sess.quarantine_chip(1)
+    assert sess.mesh_chips == 1 and sess.mesh_active
+    assert sess.mesh_status()["quarantined"] == [0, 1, 2]
+
+
+# ---------------------------------------------------------------------------
+# Chip affinity + migrate-on-bounce: the held seed is HOST-side.
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_chip_affinity_and_warm_migration(tiny_params, tiny_cfg,
+                                               pairs):
+    sess = make_session(tiny_params, tiny_cfg, mesh_data=2)
+    manager = StreamManager(sess)
+    l, r = canonical(pairs[0])
+    ph, pw = sess.padder_for(l.shape).padded_shape
+    f = tiny_cfg.downsample_factor
+
+    def admit(cam):
+        req = {"id": cam, "left": l, "right": r, "stream": cam}
+        manager.admit(req)
+        return req
+
+    # Round-robin placement over the data shards, stamped at admit.
+    r_a, r_b = admit("cam-a"), admit("cam-b")
+    assert {r_a["_chip"], r_b["_chip"]} == {0, 1}
+    assert manager.status()["by_chip"] == {"0": 1, "1": 1}
+    # Deposit a served frame's flow into the chip-1 session.
+    victim = r_a if r_a["_chip"] == 1 else r_b
+    cam1 = victim["id"]
+    victim["_stream_flow"] = np.ones(
+        (1, ph // f, pw // f, 1), np.float32)
+    victim["_stream_shape"] = (ph, pw)
+    manager.deposit(victim, {"status": "ok"})
+    # Chip 1 quarantined, mesh shrunk to 1: the pinned session migrates
+    # (chip pin cleared on a 1-wide mesh) and its next frame is STILL
+    # WARM — the held flow is host memory, not device state.
+    migrated = manager.migrate_off_chips([1], 1)
+    assert migrated >= 1
+    assert int(sess.registry.value(
+        "raft_stream_migrations_total")) == migrated
+    # The chip-0 session's pin is still valid on the 1-wide mesh and is
+    # NOT disturbed; only the quarantined chip's session moved.
+    assert manager.status()["by_chip"] == {"0": 1}
+    nxt = admit(cam1)
+    assert nxt.get("_chip") is None
+    assert nxt.get("_flow_init") is not None, (
+        "the migrated session lost its held warm seed")
+    # On a still-multi-chip mesh the migrated session gets a NEW shard.
+    sess2 = make_session(tiny_params, tiny_cfg, mesh_data=2)
+    m2 = StreamManager(sess2)
+    q = {"id": "x", "left": l, "right": r, "stream": "x"}
+    m2.admit(q)
+    assert q["_chip"] == 0
+    assert m2.migrate_off_chips([0], 2) == 1
+    q2 = {"id": "x", "left": l, "right": r, "stream": "x"}
+    m2.admit(q2)
+    assert q2["_chip"] in (0, 1)
+
+
+# ---------------------------------------------------------------------------
+# Books: one invoke spanning N chips is ONE wall interval — the PR 12
+# three-way reconciliation survives the mesh, and the capacity plane
+# reports per-chip truth.
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_device_seconds_reconcile_exact(tiny_params, tiny_cfg,
+                                             pairs):
+    # slow_forwards injects exact device time under the FakeClock (the
+    # test_deck.py rig) — nonzero durations with zero real sleeping.
+    sess = make_session(
+        tiny_params, tiny_cfg, mesh_data=2,
+        plan=ServeFaultPlan(slow_forwards={i: 0.25 for i in range(128)}))
+    out, st = run_sched(sess, [
+        make_request(pairs[i], rid=i, tenant=f"t{i % 2}")
+        for i in range(3)])
+    assert all(out[i]["status"] == "ok" for i in range(3))
+    # Pod-wide ticks really spanned 2 chips...
+    ticks = sess.deck.snapshot()
+    assert any(int(t.get("chips", 1)) > 1 for t in ticks)
+    # ...yet the pads stayed out of occupancy (3 live rows on bucket 4)
+    assert st["pad_waste"] > 0
+    assert st["occupancy_hist"].get("3", 0) >= 1
+    # Integer-ns partition: per-tenant device-ns sum EQUALS the
+    # accounted total exactly — an invoke's interval was counted once,
+    # never once per chip.
+    doc = sess.usage.doc()
+    tenant_ns = sum(t["device_ns"] for t in doc["by_tenant"].values())
+    assert tenant_ns == doc["device_ns_total"]
+    assert doc["device_ns_total"] > 0
+    # Counter reconciliation at float tolerance (the counter is a float
+    # sum of the same intervals).
+    prog_dev_s = sum(v for _, v in sess.registry.series(
+        "raft_program_device_seconds_total"))
+    assert abs(doc["device_ns_total"] / 1e9 - prog_dev_s) <= \
+        max(1e-6, 1e-9 * prog_dev_s)
+    # Per-chip saturation: a 2-chip record busies chips 0 and 1 with the
+    # SAME interval (never split, never doubled); chips outside the pod
+    # have no history -> ratio None, not a fabricated 0.
+    rows = saturation_per_chip(ticks, 4, now=sess.clock.now() + 1.0,
+                               window_s=60.0)
+    assert rows[0]["busy_s"] == pytest.approx(rows[1]["busy_s"])
+    assert rows[0]["busy_s"] > 0
+    assert rows[2]["ratio"] is None and rows[3]["ratio"] is None
+
+
+def test_mesh_capacity_status_per_chip(tiny_params, tiny_cfg, pairs):
+    sess = make_session(tiny_params, tiny_cfg, mesh_data=2)
+    out, _ = run_sched(sess, [make_request(pairs[0], rid=0),
+                              make_request(pairs[1], rid=1)])
+    assert out[0]["status"] == out[1]["status"] == "ok"
+    doc = sess.capacity_status()
+    chips = doc.get("chips")
+    assert chips is not None
+    assert chips["n_data"] == 2 and chips["base_n_data"] == 2
+    assert chips["quarantined"] == []
+    assert len(chips["per_chip"]) == 2
+    for row in chips["per_chip"]:
+        assert row["quarantined"] is False
+    # Quarantine flips the row and zeroes its headroom — an operator
+    # reading /healthz sees the dead chip, not averaged-away health.
+    assert sess.quarantine_chip(1)
+    doc2 = sess.capacity_status()
+    chips2 = doc2["chips"]
+    assert chips2["n_data"] == 1 and chips2["quarantined"] == [1]
+    row1 = chips2["per_chip"][1]
+    assert row1["quarantined"] is True and row1["headroom_rps"] == 0.0
+    # A single-device session publishes NO chips block (no fabricated
+    # per-chip rows on the pre-pod path).
+    plain = make_session(tiny_params, tiny_cfg)
+    assert "chips" not in plain.capacity_status()
