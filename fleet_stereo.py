@@ -78,6 +78,18 @@ def build_parser() -> argparse.ArgumentParser:
                         help="SIGTERM drain grace per retiring "
                         "instance (default RAFT_DRAIN_GRACE_MS or "
                         "10 s; overrun escalates to SIGKILL, counted)")
+    # graftheal: the fleet rung of the recovery plane — restart budgets
+    # refill on a decay clock so a degraded slot re-enters probation
+    # (one handshake-verified relaunch per refill) instead of staying
+    # dark until the next deploy.
+    parser.add_argument("--restart_refill_ms", type=float, default=None,
+                        help="restart-budget decay: one spent charge "
+                        "refunds per this interval (default "
+                        "RAFT_HEAL_REFILL_MS or 60 s)")
+    parser.add_argument("--no_heal", action="store_true",
+                        help="disable the recovery plane (RAFT_HEAL=0 "
+                        "equivalent): exhausted slots stay degraded "
+                        "until the next deploy")
     # graftpod: forwarded to every instance (incl. replacements) so a
     # rolling deploy can widen/narrow the per-instance mesh in one
     # place; equivalent to putting --mesh_data N after --.
@@ -111,6 +123,8 @@ def main(argv=None) -> int:
         probe_ms=args.probe_ms,
         warmup_timeout_ms=args.warmup_timeout_ms,
         drain_grace_ms=args.drain_grace_ms,
+        heal=False if args.no_heal else None,
+        restart_refill_ms=args.restart_refill_ms,
         cache_dir=args.cache_dir,
         instance_args=tuple(instance_args)))
 

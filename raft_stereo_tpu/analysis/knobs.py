@@ -234,6 +234,32 @@ HOST_ENV_KNOBS: Tuple[str, ...] = (
                             # regardless of config/env (serve/session.py
                             # resolve_mesh_fallback) — the operator
                             # escape every kill switch here honors
+    # graftheal knobs (DESIGN.md r22, serve/heal.py) — recovery-plane
+    # PACING only: when a half-open probe may run, how many chip flaps
+    # are tolerated, how fast a fleet restart budget refills.  None of
+    # them shapes a compiled program — a re-engaged rung/chip is keyed
+    # exactly the way tripping keyed it (the trip set is already in the
+    # config fingerprint projection; the mesh extent/epoch is already a
+    # trailing cache-key component), so healing re-USES keys that
+    # tripping minted and these knobs never belong in any fingerprint.
+    "RAFT_HEAL",            # recovery-plane master switch (serve/heal.py
+                            # resolve_heal_enabled, default ON; 0
+                            # restores the one-way PR 3..17 semantics)
+    "RAFT_HEAL_BACKOFF_MS",  # initial probation backoff per rung/chip,
+                            # ms (serve/heal.py resolve_heal_backoff_ms,
+                            # default 30 s; doubles per failed probe)
+    "RAFT_HEAL_BACKOFF_MAX_MS",  # probation backoff doubling cap, ms
+                            # (serve/heal.py resolve_heal_backoff_max_ms,
+                            # default 480 s)
+    "RAFT_HEAL_FLAP_CAP",   # chip re-admissions per window before
+                            # permanent quarantine (serve/heal.py
+                            # resolve_heal_flap_cap, default 2)
+    "RAFT_HEAL_WINDOW_MS",  # the flap-counting window, ms
+                            # (serve/heal.py resolve_heal_window_ms,
+                            # default 600 s)
+    "RAFT_HEAL_REFILL_MS",  # fleet restart-budget decay: one charge
+                            # refunded per interval, ms (serve/heal.py
+                            # resolve_heal_refill_ms, default 60 s)
 )
 
 

@@ -227,6 +227,18 @@ class ChaosPlan(ServeFaultPlan):
         epoch/real-time cap as ``hang_invokes``.
     hang_cap_s: real-seconds safety cap on any injected hang, so a test
         that never bounces cannot deadlock the suite.
+    clear_after_invokes: graftheal (r22) transient-fault window — the
+        plan's HANG faults (``hang_invokes`` parks and ``hang_chips``
+        probe parks) stop firing once this many device invocations have
+        entered since the plan was installed on its
+        :class:`ServeFaults`.  Models a fault that clears under load;
+        the ordinal-keyed faults (``compile_errors``, ``slow_forwards``,
+        ``poison_outputs``) are already self-limiting by ordinal and are
+        NOT gated, so existing storm ordinals stay byte-stable (the
+        PR 14 stance).
+    clear_after_ms: same window on the injectable session clock: hang
+        faults stop firing once the clock has advanced this many ms past
+        plan installation.  Either bound clearing the window clears it.
     """
 
     hang_invokes: Mapping[int, float] = dataclasses.field(
@@ -235,6 +247,8 @@ class ChaosPlan(ServeFaultPlan):
     crash_ticks: Tuple[int, ...] = ()
     hang_chips: Tuple[int, ...] = ()
     hang_cap_s: float = 30.0
+    clear_after_invokes: Optional[int] = None
+    clear_after_ms: Optional[float] = None
 
 
 class ServeFaults:
@@ -242,7 +256,6 @@ class ServeFaults:
     to one session (mirrors :class:`FaultyDataset` for the loader)."""
 
     def __init__(self, plan: Optional[ServeFaultPlan], clock=None):
-        self.plan = plan
         self.clock = clock
         self.builds = 0
         self.forwards = 0
@@ -257,6 +270,47 @@ class ServeFaults:
         self._hang_cv = threading.Condition()
         self.hangs_entered = 0
         self._hang_epoch = 0
+        # graftheal transient-fault windows are measured from plan
+        # INSTALLATION (the property setter below re-bases them), so a
+        # test that swaps plans mid-run gets a fresh window — assigned
+        # last: the setter reads the counters above.
+        self._window_invokes0 = 0
+        self._window_t0: Optional[float] = None
+        self.plan = plan
+
+    @property
+    def plan(self) -> Optional[ServeFaultPlan]:
+        return self._plan
+
+    @plan.setter
+    def plan(self, plan: Optional[ServeFaultPlan]) -> None:
+        # Plans stay reassignable mid-run (storms swap them); each
+        # install re-bases the transient window's invoke/clock origin.
+        with self._lock:
+            self._plan = plan
+            self._window_invokes0 = self.invokes
+            self._window_t0 = (self.clock.now()
+                               if self.clock is not None else None)
+
+    def _cleared(self, ordinal: Optional[int] = None) -> bool:
+        """True when the plan's transient-fault window has expired —
+        hang faults (invoke parks, chip-probe parks) stop firing.  The
+        ordinal counters themselves are NEVER gated: deterministic fault
+        ordinals survive the window (the PR 14 storm stance)."""
+        plan = self._plan
+        n_clear = getattr(plan, "clear_after_invokes", None)
+        if n_clear is not None:
+            with self._lock:
+                count = (ordinal if ordinal is not None
+                         else self.invokes) - self._window_invokes0
+            if count >= n_clear:
+                return True
+        ms_clear = getattr(plan, "clear_after_ms", None)
+        if ms_clear is not None and self.clock is not None \
+                and self._window_t0 is not None:
+            if self.clock.now() - self._window_t0 >= ms_clear / 1e3:
+                return True
+        return False
 
     def on_build(self) -> int:
         """Fire at each program-compile attempt; raises the injected
@@ -303,7 +357,7 @@ class ServeFaults:
             n = self.invokes
             self.invokes = n + 1
         hang = getattr(self.plan, "hang_invokes", None)
-        if not hang or n not in hang:
+        if not hang or n not in hang or self._cleared(ordinal=n):
             return n
         # Capture the release epoch BEFORE the clock advance below: the
         # advance is what makes this hang detectable, so a supervisor
@@ -335,7 +389,8 @@ class ServeFaults:
         ``release_hangs`` and the real-time cap; a probe that parks past
         its caller's join timeout reads as a hung chip, which is the
         point."""
-        if chip not in getattr(self.plan, "hang_chips", ()):
+        if chip not in getattr(self.plan, "hang_chips", ()) \
+                or self._cleared():
             return
         with self._hang_cv:
             epoch = self._hang_epoch

@@ -169,6 +169,22 @@ def saturation(deck_rows: List[Dict], *, now: float,
             "window_s": window_s, "covered_s": covered}
 
 
+def headroom_recovered(pre: Optional[float], post: Optional[float], *,
+                       tol: float = 0.10) -> Optional[bool]:
+    """graftheal's recovery acceptance test as arithmetic: did summed
+    ``headroom_rps`` return to within ``tol`` of its pre-fault value
+    after a re-admission?  ``None`` in = ``None`` out (capacity EMAs
+    not warmed — absence, never a fabricated verdict); a zero pre-fault
+    headroom recovers trivially (there was nothing to restore).  Shared
+    by the chaos storms and the release-gate trajectory extras so the
+    in-test and in-gate definitions of "recovered" cannot drift."""
+    if pre is None or post is None:
+        return None
+    if pre <= 0:
+        return True
+    return post >= pre * (1.0 - tol)
+
+
 def saturation_per_chip(deck_rows: List[Dict], n_chips: int, *, now: float,
                         window_s: float = DEFAULT_WINDOW_S) -> List[Dict]:
     """Per-chip device-busy fractions over the sliding window (graftpod).

@@ -140,6 +140,11 @@ class TickDeck:
         #                    dropped = closed - ringed, so an OPEN tick
         #                    (seq allocated, not yet ringed) can never
         #                    read as a spurious ring drop
+        self._warm = 0     # CUMULATIVE records that carried compile-
+        #                    inclusive warm time — monotone (unlike a
+        #                    ring scan, which forgets as rows fall off),
+        #                    so graftheal's "zero mid-request compiles
+        #                    across a re-grow" pin is a two-read diff
         self._lock = threading.Lock()
         self._tl = threading.local()
 
@@ -169,6 +174,8 @@ class TickDeck:
         with self._lock:
             self._ring.append(rec)
             self._closed += 1
+            if rec.warm_s > 0:
+                self._warm += 1
 
     def current(self) -> Optional[TickRecord]:
         """The calling thread's open tick, if any (the session's invoke
@@ -209,6 +216,8 @@ class TickDeck:
         with self._lock:
             self._ring.append(rec)
             self._closed += 1
+            if rec.warm_s > 0:
+                self._warm += 1
         return rec.seq
 
     # -- reporting ---------------------------------------------------------
@@ -227,8 +236,10 @@ class TickDeck:
             ringed = len(self._ring)
             recorded = self._seq
             closed = self._closed
+            warm = self._warm
         return {"ring": self._ring_size, "recorded": recorded,
-                "dropped": max(0, closed - ringed)}
+                "dropped": max(0, closed - ringed),
+                "warm_records": warm}
 
     def doc(self, n: Optional[int] = None) -> Dict:
         """The /debug/ticks document: bounded by construction (the ring)

@@ -72,6 +72,12 @@ def rollup(rows: List[Dict]) -> Dict:
     chips = 0
     chips_seen = False
     chips_quarantined = 0
+    # graftheal: MTTR reports the MAX across instances (the fleet
+    # recovered only when its slowest member did — averaging would hide
+    # one slow recovery behind fast peers, the saturation argument
+    # again); recovery events sum.
+    mttr_last: Optional[float] = None
+    heal_events = 0
     per_instance = []
     for row in rows:
         state = str(row.get("state", "unknown"))
@@ -123,6 +129,13 @@ def rollup(rows: List[Dict]) -> Dict:
                 q = _num(doc, "capacity", "chips", "quarantined",
                          default=()) or ()
                 chips_quarantined += len(q)
+            m = _num(doc, "heal", "mttr", "last_s")
+            if m is not None:
+                entry["mttr_last_s"] = float(m)
+                mttr_last = (float(m) if mttr_last is None
+                             else max(mttr_last, float(m)))
+            heal_events += int(
+                _num(doc, "heal", "mttr", "events", default=0) or 0)
         per_instance.append(entry)
     return {
         "schema": FLEET_SCHEMA,
@@ -135,6 +148,8 @@ def rollup(rows: List[Dict]) -> Dict:
         "saturation": saturation,
         "chips": chips if chips_seen else None,
         "chips_quarantined": chips_quarantined if chips_seen else None,
+        "mttr_last_s": mttr_last,
+        "heal_events": heal_events,
         "stream_sessions": stream_sessions,
         "cache_entries": cache_entries,
         "uptime_min_s": uptime_min,

@@ -60,7 +60,13 @@ Knobs (read at function scope; registered in ``analysis/knobs.py``
   500 ms; <= 0 disables the background prober — tests drive
   :meth:`FleetSupervisor.poke` deterministically);
 - ``RAFT_FLEET_WARMUP_TIMEOUT_MS`` — readiness-handshake deadline per
-  launch attempt (default 600 s — a cold TPU warmup is minutes).
+  launch attempt (default 600 s — a cold TPU warmup is minutes);
+- ``RAFT_HEAL`` / ``RAFT_HEAL_REFILL_MS`` (serve/heal.py) — the
+  recovery plane: restart budgets REFILL on a decay clock (one charge
+  refunded per refill interval), so a degraded slot re-enters probation
+  — one budget-charged, probe-verified relaunch per refill — instead of
+  staying dark until the next deploy.  ``RAFT_HEAL=0`` restores the
+  one-way per-generation budget exactly.
 
 Testability: :class:`FleetConfig.command` injects the instance argv —
 tier-1 tests launch a stdlib stub that speaks the same handshake and
@@ -86,6 +92,8 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from raft_stereo_tpu.obs.fleet import rollup
 from raft_stereo_tpu.obs.metrics import MetricsRegistry
+from raft_stereo_tpu.serve.heal import (resolve_heal_enabled,
+                                        resolve_heal_refill_ms)
 from raft_stereo_tpu.serve.supervise import (_parse_number,
                                              resolve_drain_grace_ms)
 
@@ -219,6 +227,16 @@ class FleetConfig:
     restart_backoff_s: float = 0.25
     #: Fleet ingress body cap (same hostile-input stance as http.py).
     body_max: int = 64 << 20
+    #: graftheal: recovery-plane master switch for THIS supervisor
+    #: (None -> RAFT_HEAL -> on).  Off = per-generation budgets are
+    #: one-way, degraded slots stay dark until the next deploy.
+    heal: Optional[bool] = None
+    #: graftheal: restart-budget decay interval — one spent charge is
+    #: refunded per interval on the fleet's monotonic clock
+    #: (None -> RAFT_HEAL_REFILL_MS -> 60 s).  Tests inject tiny values
+    #: here; the fleet has no FakeClock seam by design (its children
+    #: are real processes on real time).
+    restart_refill_ms: Optional[float] = None
 
 
 class FleetInstance:
@@ -447,6 +465,10 @@ class FleetSupervisor:
         self._c_kills = self.registry.counter(
             "raft_fleet_kill_escalations_total",
             "drains that exceeded the grace and were SIGKILLed")
+        self._c_heal_relaunch = self.registry.counter(
+            "raft_heal_slot_relaunches_total",
+            "degraded-slot probation relaunches after a restart-budget "
+            "refill (graftheal)")
         self._g_generation = self.registry.gauge(
             "raft_fleet_generation", "current deploy generation")
         self._g_ready = self.registry.gauge(
@@ -457,6 +479,13 @@ class FleetSupervisor:
         self._affinity: "OrderedDict[str, str]" = OrderedDict()
         self._books: Dict[str, Dict] = {}
         self._spent: Dict[int, int] = {}   # slot -> budget used this gen
+        # graftheal: restart-budget decay.  _refill_last[slot] is the
+        # monotonic instant up to which refunds were accounted — armed
+        # at a slot's first charge, advanced in whole refill intervals.
+        self.heal_enabled = resolve_heal_enabled(self.cfg.heal)
+        self.refill_s = resolve_heal_refill_ms(
+            self.cfg.restart_refill_ms) / 1e3
+        self._refill_last: Dict[int, float] = {}
         self._generation = 0
         self._uid_seq = 0
         self._args = tuple(self.cfg.instance_args)
@@ -517,6 +546,30 @@ class FleetSupervisor:
             env["RAFT_CACHE_DIR"] = self.cfg.cache_dir
         return env
 
+    def _effective_spent_locked(self, slot: int) -> int:
+        """The slot's spent budget AFTER decay refunds (graftheal).
+        Caller holds ``self._lock``.  With healing off (or a
+        non-positive refill) this is exactly the raw per-generation
+        counter — the one-way PR 16 semantics.  Refunds are accounted
+        in whole refill intervals on the fleet's monotonic clock and
+        folded back into ``_spent``, so every reader (charging,
+        relaunch eligibility, /fleet/healthz) sees one truth."""
+        with self._lock:  # re-entrant: callers already hold it
+            spent = self._spent.get(slot, 0)
+            if not self.heal_enabled or self.refill_s <= 0:
+                return spent
+            last = self._refill_last.get(slot)
+            if last is None:
+                return spent
+            now = time.monotonic()
+            refunds = int((now - last) / self.refill_s)
+            if refunds > 0:
+                self._refill_last[slot] = last + refunds * self.refill_s
+                if spent > 0:
+                    spent = max(0, spent - refunds)
+                    self._spent[slot] = spent
+            return spent
+
     def _launch_slot(self, slot: int, generation: int,
                      replacement: bool = False
                      ) -> Optional[FleetInstance]:
@@ -533,9 +586,12 @@ class FleetSupervisor:
             spent = 0
             if not first or replacement:
                 with self._lock:
-                    spent = self._spent.get(slot, 0)
+                    spent = self._effective_spent_locked(slot)
                     if spent < self.restart_budget:
                         self._spent[slot] = spent + 1
+                        # Arm the decay clock at the first live charge.
+                        self._refill_last.setdefault(
+                            slot, time.monotonic())
                 if spent >= self.restart_budget:
                     logger.warning(
                         "fleet slot %d: restart budget (%d) exhausted in "
@@ -618,6 +674,40 @@ class FleetSupervisor:
                 if self._slots[slot] is inst:
                     self._slots[slot] = replacement
             self._publish_ready()
+        # graftheal: degraded-slot probation.  A slot that exhausted its
+        # budget went dark (None); once the decay clock has refunded a
+        # charge, it gets ONE budget-charged, handshake-verified
+        # relaunch — naturally paced at one attempt per refill interval
+        # because the attempt re-spends the refunded charge.  The
+        # silent pre-check keeps an exhausted slot from logging a
+        # budget warning on every probe pass.
+        if self.heal_enabled and not self._stop.is_set():
+            with self._lock:
+                gen = self._generation
+                degraded = [
+                    slot for slot, inst in enumerate(self._slots)
+                    if inst is None
+                    and self._effective_spent_locked(slot)
+                    < self.restart_budget]
+            for slot in degraded:
+                inst = self._launch_slot(slot, gen, replacement=True)
+                if inst is None:
+                    continue
+                adopted = False
+                with self._lock:
+                    if self._slots[slot] is None:
+                        self._slots[slot] = inst
+                        adopted = True
+                if not adopted:
+                    # A concurrent deploy() re-filled the slot while we
+                    # were warming our probe instance — ours loses.
+                    inst.kill()
+                    continue
+                self._c_heal_relaunch.inc()
+                logger.warning(
+                    "fleet slot %d: degraded slot re-entered service "
+                    "as %s after a restart-budget refill", slot,
+                    inst.uid)
         self._publish_ready()
 
     def _publish_ready(self) -> None:
@@ -788,6 +878,7 @@ class FleetSupervisor:
                 self._generation += 1
                 gen = self._generation
                 self._spent = {}   # fresh budget per generation
+                self._refill_last = {}  # fresh decay clock too
             self._g_generation.set(float(gen))
             report: Dict = {"generation": gen, "slots": [],
                             "completed": True}
@@ -855,14 +946,24 @@ class FleetSupervisor:
             rows = []
             degraded = 0
             for slot, inst in enumerate(self._slots):
+                # graftheal satellite: every slot row carries its live
+                # budget position — decay refunds included — so an
+                # operator watching /fleet/healthz sees a degraded
+                # slot's budget_remaining climb back above zero before
+                # its probation relaunch fires.
+                spent = self._effective_spent_locked(slot)
+                budget = {"restarts_spent": spent,
+                          "budget_remaining": max(
+                              0, self.restart_budget - spent)}
                 if inst is None:
                     degraded += 1
                     rows.append({"uid": None, "slot": slot,
-                                 "state": "degraded", "doc": None})
+                                 "state": "degraded", "doc": None,
+                                 **budget})
                     continue
                 rows.append({"uid": inst.uid, "slot": slot,
                              "state": inst.state, "doc": inst.last_doc,
-                             "chips": inst.chips()})
+                             "chips": inst.chips(), **budget})
             draining = len(self._retired)
             affinity = len(self._affinity)
         doc = rollup(rows)
@@ -876,6 +977,13 @@ class FleetSupervisor:
             ).set(doc["chips"])
         doc.update({
             "generation": self._generation,
+            "restart_budget": self.restart_budget,
+            "heal": {
+                "enabled": self.heal_enabled,
+                "refill_ms": self.refill_s * 1e3,
+                "slot_relaunches_total": int(self.registry.value(
+                    "raft_heal_slot_relaunches_total")),
+            },
             "degraded_slots": degraded,
             "draining": draining,
             "pinned_sessions": affinity,
@@ -896,6 +1004,8 @@ class FleetSupervisor:
         })
         for row, slot_doc in zip(doc["by_instance"], rows):
             row["slot"] = slot_doc["slot"]
+            row["restarts_spent"] = slot_doc["restarts_spent"]
+            row["budget_remaining"] = slot_doc["budget_remaining"]
         return doc
 
     def metrics_text(self) -> str:

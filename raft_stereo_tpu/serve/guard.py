@@ -14,9 +14,17 @@ recorded in metrics. The process degrades; it does not die. The bottom of
 the ladder is the pure-XLA program, which has no rung below it by
 construction.
 
-Trips are one-way within a session lifetime (a tripped kernel is assumed
-broken until an operator resets — flapping between a crashing kernel and
-its fallback would re-pay a compile per flap).
+Trips are one-way only while the recovery plane is off (``RAFT_HEAL=0``
+— a tripped kernel is then assumed broken until an operator resets).
+With healing armed (the r22 default), a tripped rung enters **probation**:
+after an exponential per-rung backoff on the session clock the rung goes
+*half-open*, and the next heal sweep re-runs the parity canary — the
+candidate projection (current trips minus this rung) against the
+plain-XLA reference — BEFORE the rung re-engages for real traffic.  A
+passing canary untrips the rung (re-keying programs exactly as tripping
+keyed them; the session re-warms before routing); a failing canary
+re-trips with doubled backoff (capped), so a persistently broken kernel
+costs one canary per backoff period, never a serving-path flap.
 """
 
 from __future__ import annotations
@@ -250,6 +258,16 @@ class KernelCircuitBreaker:
                     "untripped programs key on it too", p.name, p.env_var)
         self._tripped: Dict[str, TripRecord] = {}
         self._lock = threading.Lock()
+        # graftheal (r22) probation state. Disarmed until the owning
+        # session calls configure_heal with its clock — an unconfigured
+        # breaker keeps the historical one-way semantics bit-for-bit.
+        self._heal_enabled = False
+        self._heal_clock = None
+        self._heal_backoff_s = 30.0
+        self._heal_backoff_max_s = 480.0
+        # rung -> {backoff_s, deadline, probes, retrips}; deadlines run
+        # on the session clock (FakeClock in tests/storms).
+        self._probation: Dict[str, Dict] = {}
 
     def bind_registry(self, registry) -> None:
         """Attach a metrics registry (first bind wins — a breaker shared
@@ -257,6 +275,29 @@ class KernelCircuitBreaker:
         with)."""
         if self._registry is None:
             self._registry = registry
+
+    def configure_heal(self, *, enabled: bool, clock,
+                       backoff_s: float, backoff_max_s: float) -> None:
+        """Arm (or disarm) half-open probation for this breaker.  Called
+        by the owning session with ITS clock so every probation deadline
+        rides the same FakeClock the tests/storms drive.  Existing trips
+        (a breaker shared across a rebuild) are put on probation at one
+        full backoff from now — never instantly eligible."""
+        with self._lock:
+            self._heal_enabled = bool(enabled)
+            self._heal_clock = clock
+            self._heal_backoff_s = float(backoff_s)
+            self._heal_backoff_max_s = float(backoff_max_s)
+            if not self._heal_enabled or clock is None:
+                self._probation.clear()
+                return
+            now = clock.now()
+            for name in self._tripped:
+                if name not in self._probation:
+                    self._probation[name] = {
+                        "backoff_s": self._heal_backoff_s,
+                        "deadline": now + self._heal_backoff_s,
+                        "probes": 0, "retrips": 0}
 
     # -- state ------------------------------------------------------------
 
@@ -316,12 +357,98 @@ class KernelCircuitBreaker:
                 self._tripped[name] = rec
             else:  # repeated failure attributed to an already-dark path
                 rec.count += 1
+            if self._heal_enabled and self._heal_clock is not None:
+                now = self._heal_clock.now()
+                st = self._probation.get(name)
+                if st is None:
+                    # First trip of this rung: probation at base backoff.
+                    self._probation[name] = {
+                        "backoff_s": self._heal_backoff_s,
+                        "deadline": now + self._heal_backoff_s,
+                        "probes": 0, "retrips": 0}
+                else:
+                    # Re-trip (incl. a failed half-open canary): backoff
+                    # doubles, capped — a persistently broken kernel
+                    # settles at one canary per max-backoff period.
+                    st["backoff_s"] = min(st["backoff_s"] * 2.0,
+                                          self._heal_backoff_max_s)
+                    st["deadline"] = now + st["backoff_s"]
+                    st["retrips"] += 1
             return rec
+
+    # -- half-open probation (graftheal r22) -------------------------------
+
+    def heal_candidate(self, now: Optional[float] = None) -> Optional[str]:
+        """The ONE rung eligible for a half-open canary probe right now,
+        or None.  Only the MOST recently tripped rung is ever a
+        candidate (``_tripped`` is insertion-ordered, so re-engagement
+        walks the ladder back in strict reverse trip order — re-arming a
+        lower rung under a still-dark higher one would canary a
+        configuration that was never served).  Handing out a candidate
+        pushes its deadline one backoff out, so a sweep that dies
+        mid-probe cannot hand the same rung to a concurrent sweep."""
+        with self._lock:
+            if not self._heal_enabled or self._heal_clock is None \
+                    or not self._tripped:
+                return None
+            if now is None:
+                now = self._heal_clock.now()
+            name = next(reversed(self._tripped))
+            st = self._probation.get(name)
+            if st is None:  # tripped before heal was configured
+                self._probation[name] = {
+                    "backoff_s": self._heal_backoff_s,
+                    "deadline": now + self._heal_backoff_s,
+                    "probes": 0, "retrips": 0}
+                return None
+            if now < st["deadline"]:
+                return None
+            st["probes"] += 1
+            st["deadline"] = now + st["backoff_s"]
+            return name
+
+    def untrip(self, name: str) -> bool:
+        """Half-open canary passed: the rung re-engages.  Removes the
+        trip record AND its probation state (a later re-trip starts back
+        at the base backoff — the fault class that cleared is not the
+        one that re-trips).  The caller owns re-keying: the trip set is
+        in the program-cache projection, so it must rebuild its run
+        config and RE-WARM before routing traffic (the PR 5
+        mid-request-compile class)."""
+        with self._lock:
+            if name not in self._tripped:
+                return False
+            del self._tripped[name]
+            self._probation.pop(name, None)
+        if self._registry is not None:
+            self._registry.counter(
+                "raft_heal_untrips_total",
+                "breaker rungs re-engaged after a passing half-open "
+                "canary", rung=name).inc()
+        return True
+
+    def heal_status(self) -> Dict:
+        """The /healthz ``breaker.heal`` block: probation state per
+        still-tripped rung."""
+        with self._lock:
+            now = (self._heal_clock.now()
+                   if self._heal_clock is not None else None)
+            half_open = {}
+            for name, st in self._probation.items():
+                row = {"backoff_ms": st["backoff_s"] * 1e3,
+                       "probes": st["probes"],
+                       "retrips": st["retrips"]}
+                if now is not None:
+                    row["eligible_in_s"] = max(0.0, st["deadline"] - now)
+                half_open[name] = row
+            return {"enabled": self._heal_enabled,
+                    "half_open": half_open}
 
     def reset(self) -> None:
         """Operator action: forget all trips (e.g. after a driver fix)."""
         with self._lock:
             self._tripped.clear()
+            self._probation.clear()
 
     # -- application ------------------------------------------------------
 
@@ -372,3 +499,10 @@ class KernelCircuitBreaker:
                 "trip_count": sum(r.count for r in self._tripped.values()),
                 "exhausted": len(self._tripped) == len(self.ladder),
             }
+
+    def status_with_heal(self) -> Dict:
+        """``status()`` plus the r22 probation block (kept separate so
+        pre-r22 status pins stay byte-stable)."""
+        doc = self.status()
+        doc["heal"] = self.heal_status()
+        return doc

@@ -1267,6 +1267,34 @@ class StereoService:
         }, trace_id=f"bounce-g{gen}")
         return True
 
+    # -- recovery plane (graftheal, DESIGN.md r22) -------------------------
+
+    def heal_sweep(self) -> Dict:
+        """One recovery-plane sweep: at most one half-open breaker-rung
+        canary (strict reverse trip order), then one probe pass over
+        probation-eligible quarantined chips, then stream-session
+        re-placement onto any re-grown mesh.
+
+        Deliberately NOT wired into the Supervisor's monitor thread —
+        detection (watchdog) and recovery (heal) run on different
+        clocks and different triggers, and the chaos battery pins the
+        detector's one-way monotonicity mid-storm.  Production drives
+        this from the serve_stereo.py / fleet_stereo.py wait loops;
+        tests and storms call it explicitly on the FakeClock.  With
+        ``RAFT_HEAL=0`` every sub-step is a no-op and the PR 3..17
+        one-way semantics hold bit-for-bit."""
+        rung = self.session.heal_breaker()
+        mesh = self.session.heal_mesh()
+        repinned = 0
+        if mesh["readmitted"]:
+            # Sessions parked off-mesh (chip=None after a shrink to one
+            # chip) re-pin round-robin onto the re-grown extent — their
+            # held seeds are host-side, so they come back WARM (the
+            # migration seam's bounce-warm pin, in reverse).
+            repinned = self.stream.repin_unplaced(self.session.mesh_chips)
+        return {"breaker": rung, "mesh": mesh,
+                "stream_repinned": repinned}
+
     def supervision_status(self) -> Dict:
         """The /healthz ``supervision`` block: generation, drain state,
         heartbeat ages, watchdog/retry config, restart + trip counters."""
@@ -1343,6 +1371,10 @@ class StereoService:
             # counters, byte accounting, tier config (serve/cache.py).
             "cache": self.cache.status(),
             "supervision": self.supervision_status(),
+            # graftheal: the recovery plane — per-rung/per-chip
+            # probation state, flap caps, MTTR (serve/heal.py knobs;
+            # session.heal_status()).
+            "heal": self.session.heal_status(),
             # The operator-plane capacity block (obs/capacity.py):
             # per-bucket theoretical requests/s from the warmed EMAs,
             # live saturation from the tick deck, headroom gauges

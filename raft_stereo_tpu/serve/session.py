@@ -58,6 +58,11 @@ from raft_stereo_tpu.obs.usage import DEFAULT_TENANT, UsageAccountant
 from raft_stereo_tpu.ops.padder import InputPadder
 from raft_stereo_tpu.serve.guard import (KernelCircuitBreaker, CANARY_ATOL,
                                          CANARY_RTOL, is_kernel_failure)
+from raft_stereo_tpu.serve.heal import (resolve_heal_backoff_max_ms,
+                                        resolve_heal_backoff_ms,
+                                        resolve_heal_enabled,
+                                        resolve_heal_flap_cap,
+                                        resolve_heal_window_ms)
 from raft_stereo_tpu.serve.supervise import InvocationWatch, _parse_number
 from raft_stereo_tpu.serve.validate import AdmissionConfig, validate_pair
 
@@ -183,6 +188,11 @@ class SessionConfig:
     max_batch: int = 1
     batch_buckets: Tuple[int, ...] = ()
     mesh_data: Optional[int] = None
+    # graftheal (r22): recovery-plane master switch. None = the RAFT_HEAL
+    # env override, else ON.  False restores the one-way PR 3..17
+    # degradation semantics exactly (no probation, no re-admission, no
+    # refill).  Host-side pacing only — never part of any fingerprint.
+    heal: Optional[bool] = None
     admission: AdmissionConfig = dataclasses.field(
         default_factory=AdmissionConfig)
 
@@ -581,6 +591,25 @@ class InferenceSession:
         # trace correctly — it just won't key untripped programs.
         self.breaker = breaker or KernelCircuitBreaker()
         self.breaker.bind_registry(self.registry)
+        # graftheal (r22): recovery-plane pacing, resolved ONCE here
+        # (explicit SessionConfig.heal > RAFT_HEAL > on).  The breaker's
+        # probation deadlines ride THIS session's clock — FakeClock in
+        # tests/storms, so every heal test is instantaneous and exact.
+        self._heal_enabled = resolve_heal_enabled(self.cfg.heal)
+        self._heal_backoff_s = resolve_heal_backoff_ms() / 1e3
+        self._heal_backoff_max_s = resolve_heal_backoff_max_ms() / 1e3
+        self._heal_flap_cap = resolve_heal_flap_cap()
+        self._heal_window_s = resolve_heal_window_ms() / 1e3
+        self.breaker.configure_heal(
+            enabled=self._heal_enabled, clock=self.clock,
+            backoff_s=self._heal_backoff_s,
+            backoff_max_s=self._heal_backoff_max_s)
+        # Per-chip probation state (chip -> {backoff_s, deadline, probes,
+        # readmitted: [session-clock times], permanent, quarantined_at}),
+        # mutated only under _mesh_lock; and the MTTR record the heal
+        # sweeps publish (fault-injected -> capacity restored).
+        self._chip_heal: Dict[int, Dict] = {}
+        self._heal_mttr: Dict = {"last_s": None, "events": 0}
         self.faults = ServeFaults(fault_plan, clock=self.clock)
         # graftguard (serve/supervise.py): every device invocation is
         # bracketed in this watch so a supervisor can classify a hung
@@ -778,6 +807,39 @@ class InferenceSession:
                     not (0 <= chip < len(self._mesh_devices)):
                 return False
             self._quarantined.add(chip)
+            if self._heal_enabled:
+                # graftheal: arm (or re-arm) this chip's probation.  A
+                # RE-quarantine doubles the backoff (capped) and counts
+                # against the flap cap — a chip flapping past the cap
+                # inside the window goes permanently out (an epoch bump
+                # per flap would thrash the mesh programs into a
+                # recompile storm, which is worse than serving shrunk).
+                now = self.clock.now()
+                st = self._chip_heal.get(chip)
+                if st is None:
+                    self._chip_heal[chip] = {
+                        "backoff_s": self._heal_backoff_s,
+                        "deadline": now + self._heal_backoff_s,
+                        "probes": 0, "readmitted": [],
+                        "permanent": False, "quarantined_at": now}
+                else:
+                    st["quarantined_at"] = now
+                    st["backoff_s"] = min(st["backoff_s"] * 2.0,
+                                          self._heal_backoff_max_s)
+                    st["deadline"] = now + st["backoff_s"]
+                    window = [t for t in st["readmitted"]
+                              if now - t <= self._heal_window_s]
+                    if len(window) >= self._heal_flap_cap \
+                            and not st["permanent"]:
+                        st["permanent"] = True
+                        logger.error(
+                            "chip %d re-quarantined after %d "
+                            "re-admissions in the flap window — "
+                            "permanently out", chip, len(window))
+                        self.registry.counter(
+                            "raft_heal_chips_permanent_total",
+                            "chips permanently quarantined by the flap "
+                            "cap").inc()
             healthy = [d for i, d in enumerate(self._mesh_devices)
                        if i not in self._quarantined]
             new_n = max((d for d in range(1, self._mesh_base_n + 1)
@@ -823,6 +885,250 @@ class InferenceSession:
                      "quarantined": i in self._quarantined}
                     for i, d in enumerate(self._mesh_devices)],
             }
+
+    # -- recovery plane (graftheal r22) ------------------------------------
+
+    def probe_quarantined(self, chips: Tuple[int, ...],
+                          timeout_s: float = 2.0) -> Tuple[int, ...]:
+        """Probe exactly the given quarantined chips (tiny transfer +
+        ``block_until_ready`` on a daemon thread each, the
+        ``probe_chips`` recipe) and return the subset that FAILED.  The
+        ``faults.on_chip_probe`` hook runs inside each probe thread, so
+        a transient chaos fault whose window has cleared passes and a
+        still-wedged chip keeps failing."""
+        done: Dict[int, bool] = {}
+
+        def _probe(i: int, dev) -> None:
+            try:
+                self.faults.on_chip_probe(i)
+                x = self._jax.device_put(np.zeros((), np.float32), dev)
+                x.block_until_ready()
+                done[i] = True
+            except Exception:  # noqa: BLE001 — a failed probe IS a hang
+                done[i] = False
+
+        threads = []
+        for i in chips:
+            if not (0 <= i < len(self._mesh_devices)):
+                continue
+            t = threading.Thread(target=_probe,
+                                 args=(i, self._mesh_devices[i]),
+                                 name=f"chip-heal-probe-{i}", daemon=True)
+            t.start()
+            threads.append((i, t))
+        deadline = self.clock.now() + timeout_s
+        for i, t in threads:
+            t.join(timeout=max(0.05, deadline - self.clock.now()))
+        return tuple(i for i, t in threads
+                     if t.is_alive() or not done.get(i, False))
+
+    def readmit_chip(self, chip: int) -> bool:
+        """Re-grow the mesh onto one probe-verified chip: flap-cap
+        check, un-quarantine, recompute the extent (largest divisor of
+        the base fitting the healthy set), bump the epoch, rebuild the
+        mesh — then RE-WARM the re-keyed mesh programs before returning,
+        so no row ever routes onto a cold epoch (the PR 5
+        mid-request-compile class).  Returns False when the chip is not
+        quarantined, healing is off, or the flap cap fired."""
+        with self._mesh_lock:
+            if not self._heal_enabled or chip not in self._quarantined:
+                return False
+            st = self._chip_heal.get(chip)
+            now = self.clock.now()
+            if st is None or st["permanent"]:
+                return False
+            window = [t for t in st["readmitted"]
+                      if now - t <= self._heal_window_s]
+            if len(window) >= self._heal_flap_cap:
+                st["permanent"] = True
+                self.registry.counter(
+                    "raft_heal_chips_permanent_total",
+                    "chips permanently quarantined by the flap cap").inc()
+                return False
+            self._quarantined.discard(chip)
+            st["readmitted"] = window + [now]
+            # The fault class that cleared is not the one that re-trips:
+            # a LATER quarantine starts back at the base backoff (then
+            # doubles per flap).
+            st["backoff_s"] = self._heal_backoff_s
+            healthy = [d for i, d in enumerate(self._mesh_devices)
+                       if i not in self._quarantined]
+            new_n = max((d for d in range(1, self._mesh_base_n + 1)
+                         if self._mesh_base_n % d == 0
+                         and d <= len(healthy)), default=1)
+            self._mesh_epoch += 1
+            self._build_mesh(healthy[:new_n], new_n)
+            logger.warning(
+                "re-admitted chip %d; mesh now %d chip(s) (epoch %d, "
+                "quarantined=%s)", chip, new_n, self._mesh_epoch,
+                sorted(self._quarantined))
+            self.registry.counter(
+                "raft_heal_chips_readmitted_total",
+                "chips re-admitted to the live data mesh").inc()
+            self.registry.gauge(
+                "raft_mesh_chips",
+                "chips the live data mesh spans").set(new_n)
+            mttr = now - st["quarantined_at"]
+            self._heal_mttr = {"last_s": mttr,
+                               "events": self._heal_mttr["events"] + 1}
+            self.registry.gauge(
+                "raft_heal_mttr_seconds",
+                "last fault-injected -> capacity-restored interval "
+                "(session clock)").set(mttr)
+        # Re-warm the new epoch's mesh-keyed programs OUTSIDE the mesh
+        # lock (compiles are slow; quarantine from another thread must
+        # not block behind them) but BEFORE returning — the heal sweep
+        # is synchronous, so no request routes onto the grown mesh
+        # until the warmup-LRU floor holds the new programs.
+        if self.cfg.max_batch > 1:
+            for (h, w) in self.cfg.warmup_shapes:
+                self._warm_shape(h, w)
+        return True
+
+    def heal_mesh(self, probe_timeout_s: float = 2.0) -> Dict:
+        """One recovery sweep over quarantined chips: probe every chip
+        whose probation deadline elapsed, re-admit the passers, double
+        the backoff of the failers.  Returns
+        ``{"probed", "readmitted", "failed"}`` chip lists."""
+        out: Dict = {"probed": [], "readmitted": [], "failed": []}
+        if not self._heal_enabled or self._heal_flap_cap < 1:
+            return out
+        now = self.clock.now()
+        with self._mesh_lock:
+            candidates = []
+            for c in sorted(self._quarantined):
+                st = self._chip_heal.get(c)
+                if st is None or st["permanent"] or now < st["deadline"]:
+                    continue
+                # Hand-out pushes the deadline one backoff out, so a
+                # concurrent sweep cannot double-probe this chip.
+                st["probes"] += 1
+                st["deadline"] = now + st["backoff_s"]
+                candidates.append(c)
+        if not candidates:
+            return out
+        out["probed"] = list(candidates)
+        failed = set(self.probe_quarantined(tuple(candidates),
+                                            timeout_s=probe_timeout_s))
+        for c in candidates:
+            ok = c not in failed and self.readmit_chip(c)
+            self.registry.counter(
+                "raft_heal_chip_probes_total",
+                "quarantined-chip probation probes by outcome",
+                result=("passed" if ok else "failed")).inc()
+            if ok:
+                out["readmitted"].append(c)
+                continue
+            out["failed"].append(c)
+            if c in failed:
+                with self._mesh_lock:
+                    st = self._chip_heal.get(c)
+                    if st is not None:
+                        st["backoff_s"] = min(st["backoff_s"] * 2.0,
+                                              self._heal_backoff_max_s)
+                        st["deadline"] = (self.clock.now()
+                                          + st["backoff_s"])
+        return out
+
+    def heal_breaker(self) -> Optional[Dict]:
+        """One half-open canary probe of the most-recently-tripped
+        eligible rung (strict reverse trip order — the breaker only ever
+        nominates the last trip).  The CANDIDATE projection (current
+        trips minus the rung) runs against the plain-XLA reference
+        within the pinned drift band, WITHOUT touching serving state; a
+        pass untrips + rebuilds + re-warms before any traffic routes on
+        the re-engaged rung, a fail re-trips with doubled backoff.
+        Returns None when no rung is eligible."""
+        name = self.breaker.heal_candidate()
+        if name is None:
+            return None
+        out: Dict = {"rung": name, "passed": False}
+        cand = tuple(n for n in self.breaker.tripped_names if n != name)
+        cand_cfg, cand_env = self.breaker.apply(self._base_cfg,
+                                                tripped=cand)
+        h, w = self.cfg.canary_shape
+        padder = self.padder_for((h, w, 3))
+        rng = np.random.default_rng(1234)
+        left = rng.uniform(0, 255, (1, h, w, 3)).astype(np.float32)
+        right = rng.uniform(0, 255, (1, h, w, 3)).astype(np.float32)
+        iters = self.cfg.canary_iters
+        ok = False
+        try:
+            fast = self._run_full(padder, left, right, iters=iters,
+                                  cfg=cand_cfg, env=cand_env)
+            ref_cfg, ref_env = self.breaker.plain_xla_cfg(self._base_cfg)
+            if (self._fingerprint(cand_cfg, cand_env) ==
+                    self._fingerprint(ref_cfg, ref_env)):
+                # Candidate IS plain XLA (every other rung tripped):
+                # finite output is the whole parity statement.
+                ok = bool(np.isfinite(fast).all())
+            else:
+                ref = self._run_full(padder, left, right, iters=iters,
+                                     cfg=ref_cfg, env=ref_env)
+                ok = bool(np.isfinite(fast).all()
+                          and np.isfinite(ref).all()
+                          and np.allclose(fast, ref, rtol=CANARY_RTOL,
+                                          atol=CANARY_ATOL))
+        except Exception as e:  # noqa: BLE001 — filtered just below
+            if not is_kernel_failure(e):
+                raise
+            # The probe's own kernel failure is a failed canary, never a
+            # ladder walk: the rung under probation is the suspect.
+            out["error"] = str(e)
+        self.registry.counter(
+            "raft_heal_rung_probes_total",
+            "half-open breaker canary probes by rung and outcome",
+            rung=name, result=("passed" if ok else "failed")).inc()
+        if ok:
+            self.breaker.untrip(name)
+            # Untripping re-keys exactly as tripping did: re-project the
+            # trip set, then RE-WARM before routing (same rebuild
+            # counter — /healthz sees the walk back up the ladder).
+            self._run_cfg, self._env = self.breaker.apply(self._base_cfg)
+            self._ctr["rebuilds"].inc()
+            logger.warning(
+                "heal: rung %s re-engaged after a passing canary; "
+                "tripped=%s", name, list(self.breaker.tripped_names))
+            for (wh, ww) in self.cfg.warmup_shapes:
+                self._warm_shape(wh, ww)
+            out["passed"] = True
+        else:
+            # Re-trip doubles the probation backoff (guard.py trip()) and
+            # increments the rung's trip count with the heal reason —
+            # pinned visible on /healthz.
+            self.breaker.trip(name, "heal_canary_failed")
+        return out
+
+    def heal_status(self) -> Dict:
+        """The /healthz ``heal`` block: pacing knobs, per-rung and
+        per-chip probation state, MTTR.  Bounded by construction (one
+        row per ladder rung / construction-time chip)."""
+        with self._mesh_lock:
+            now = self.clock.now()
+            chips = {}
+            for chip, st in sorted(self._chip_heal.items()):
+                quarantined = chip in self._quarantined
+                chips[str(chip)] = {
+                    "quarantined": quarantined,
+                    "permanent": st["permanent"],
+                    "backoff_ms": st["backoff_s"] * 1e3,
+                    "probes": st["probes"],
+                    "readmissions": len(st["readmitted"]),
+                    "eligible_in_s": (
+                        max(0.0, st["deadline"] - now)
+                        if quarantined and not st["permanent"] else None),
+                }
+            mttr = dict(self._heal_mttr)
+        return {
+            "enabled": self._heal_enabled,
+            "backoff_ms": self._heal_backoff_s * 1e3,
+            "backoff_max_ms": self._heal_backoff_max_s * 1e3,
+            "flap_cap": self._heal_flap_cap,
+            "window_ms": self._heal_window_s * 1e3,
+            "breaker": self.breaker.heal_status(),
+            "chips": chips,
+            "mttr": mttr,
+        }
 
     def _shard_args(self, prog: _Program, args):
         """Canonically re-``device_put`` a mesh program's operands every
@@ -1616,6 +1922,12 @@ class InferenceSession:
             for row in per_chip:
                 chip = row["chip"]
                 row["quarantined"] = chip in self._quarantined
+                # graftheal: distinguish a chip in probation (eligible
+                # for re-admission on its backoff clock) from one the
+                # flap cap retired for good.
+                st = self._chip_heal.get(chip)
+                if row["quarantined"] and st is not None:
+                    row["permanent"] = st["permanent"]
                 row["headroom_rps"] = (
                     0.0 if row["quarantined"] else
                     None if best is None else best / max(1, self.mesh_chips))

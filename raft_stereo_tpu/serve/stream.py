@@ -315,6 +315,17 @@ class StreamManager:
                 migrated += 1
         return migrated
 
+    def _repin_unplaced_locked(self, n_chips: int) -> int:
+        # Caller holds self._lock.
+        repinned = 0
+        for sess in self._table.values():
+            if sess.chip is not None:
+                continue
+            sess.chip = self._rr_chip % n_chips
+            self._rr_chip += 1
+            repinned += 1
+        return repinned
+
     # -- the request protocol ----------------------------------------------
 
     def admit(self, request: Dict) -> None:
@@ -415,6 +426,27 @@ class StreamManager:
                 "stream sessions migrated off quarantined chips"
             ).inc(migrated)
         return migrated
+
+    def repin_unplaced(self, n_chips: int) -> int:
+        """graftheal re-place-on-grow: the migration seam in reverse.  A
+        mesh shrink to one chip parks sessions off-mesh (``chip=None``,
+        ``_migrate_locked``); when a re-admitted chip re-grows the mesh,
+        those parked sessions re-pin round-robin over the new extent so
+        the scheduler's same-chip packing works again.  Their held seeds
+        are HOST memory, so re-placed streams come back WARM — the next
+        frame rides prepare_warm on its new chip.  Returns the number of
+        sessions re-pinned (0 when the mesh is still 1-wide)."""
+        n_chips = int(n_chips)
+        if n_chips <= 1:
+            return 0
+        with self._lock:
+            repinned = self._repin_unplaced_locked(n_chips)
+        if repinned:
+            self.registry.counter(
+                "raft_stream_migrations_total",
+                "stream sessions migrated off quarantined chips"
+            ).inc(repinned)
+        return repinned
 
     # -- lifecycle ---------------------------------------------------------
 

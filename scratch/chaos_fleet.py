@@ -14,7 +14,13 @@ ingress while the two advertised failure drills fire mid-storm:
 2. a rolling deploy that changes ``--valid_iters`` (and therefore the
    run fingerprint) WHILE a background traffic thread keeps posting —
    generation must advance with zero unstructured responses and the
-   stream session must hand off to the new generation warm.
+   stream session must hand off to the new generation warm;
+3. (graftheal) a slot whose restart budget is EXHAUSTED is killed raw —
+   it must degrade (no relaunch, budget pinned at zero on
+   ``/fleet/healthz``), then the refill decay clock refunds a charge
+   and the next ``poke()`` must run the probation relaunch: exactly one
+   handshake-verified launch, the slot back to ready, and
+   ``raft_heal_slot_relaunches_total`` booked.
 
 The storm then quiesces and settles the books: for every live instance,
 the fleet router's ``answered`` count for that uid must EXACTLY equal
@@ -275,6 +281,64 @@ def main() -> int:
                 f"{fleet_answered}")
         assert reconciliation, "no live instances to reconcile"
 
+        # -- phase 5: graftheal — the slot rung of the recovery plane --
+        # Exhaust one slot's restart budget at the ledger (the charge
+        # arithmetic itself is pinned in tests/test_fleet.py) and kill
+        # its instance raw: with zero budget remaining the supervisor
+        # must DEGRADE the slot, not relaunch it.
+        heal_slot, veteran = next(
+            (i, inst) for i, inst in enumerate(sup._slots)
+            if inst is not None)
+        with sup._lock:
+            sup._spent[heal_slot] = sup.restart_budget
+            sup._refill_last[heal_slot] = time.monotonic()
+        veteran.proc.kill()
+        veteran.proc.wait(timeout=30)
+        deadline = time.monotonic() + 30.0
+        while True:
+            sup.poke()
+            doc = sup.status()
+            row = next(r for r in doc["by_instance"]
+                       if r.get("slot") == heal_slot)
+            if row.get("state") == "degraded":
+                break
+            assert time.monotonic() < deadline, (
+                f"slot {heal_slot} never degraded on an exhausted "
+                f"restart budget: {row}")
+            time.sleep(0.1)
+        assert doc["degraded_slots"] == 1, doc
+        # Satellite pin: the per-slot budget ledger is visible on the
+        # fleet health document, spent == budget, nothing remaining.
+        assert row["restarts_spent"] == sup.restart_budget, row
+        assert row["budget_remaining"] == 0, row
+        assert doc["heal"]["enabled"] is True, doc["heal"]
+        relaunches0 = doc["heal"]["slot_relaunches_total"]
+        # Now let the decay clock refund one charge: shrink the refill
+        # interval so a fraction of a second of real time covers one
+        # whole interval, and the next poke() pass must spend it on a
+        # single handshake-verified probation relaunch.
+        sup.refill_s = 0.3
+        time.sleep(0.4)
+        doc = settle(want_ready=2)
+        row = next(r for r in doc["by_instance"]
+                   if r.get("slot") == heal_slot)
+        assert row["state"] == "ready", row
+        assert doc["heal"]["slot_relaunches_total"] >= relaunches0 + 1, (
+            doc["heal"])
+        # Serving resumed through the fleet ingress over the healed
+        # fleet — a structured ok is the whole requirement.
+        r = post("healed", frame_body(
+            f"storm-heal-{next(seq)}", perturbed()))
+        assert r["status"] == "ok", r
+        heal_report = {
+            "slot": heal_slot,
+            "degraded_observed": True,
+            "restarts_spent_at_degrade": sup.restart_budget,
+            "slot_relaunches_total":
+                doc["heal"]["slot_relaunches_total"],
+            "refill_ms": doc["heal"]["refill_ms"],
+        }
+
         with ledger_lock:
             total = len(ledger)
             unstructured = [r for r in ledger if not r["structured"]]
@@ -284,7 +348,9 @@ def main() -> int:
             f"{len(unstructured)}/{total} responses were not "
             f"structured: {unstructured[:5]}")
 
-        counters = final["counters"]
+        # Re-read after phase 5 so restarts_total includes the
+        # probation relaunch.
+        counters = sup.status()["counters"]
 
     print(json.dumps({
         "metric": "chaos_fleet",
@@ -296,6 +362,7 @@ def main() -> int:
         "deploy": {"completed": True, "generation": 2,
                    "fingerprint_before": fp_before[0],
                    "fingerprint_after": fp_after[0]},
+        "heal": heal_report,
         "reconciliation": reconciliation,
         "counters": counters,
     }))

@@ -9,6 +9,13 @@ hang whose post-bounce health probe parks on exactly ONE chip — the
 bounce must quarantine that chip alone, shrink the mesh, migrate the
 chip-pinned stream sessions (held seeds are host-side, so they stay
 warm), keep serving on the survivors, and reconcile the books.
+``--heal`` switches to the graftheal fault-CLEARS recovery storm
+(DESIGN.md r22): the same 2-chip quarantine, but the injected fault is
+TRANSIENT — its window clears, the probation probe re-admits the chip,
+headroom returns to within 10% of pre-fault, a flapping chip is
+re-admitted exactly flap-cap times then permanently quarantined, and a
+poisoned breaker rung's half-open canary fails closed (doubled backoff,
+never served) until the poison clears.
 
 Drives N seeded requests through the REAL ``StereoService`` (continuous
 batching, retry budget, watchdog supervision armed) under a composite
@@ -1084,6 +1091,398 @@ def main_mesh() -> int:
     return 0
 
 
+# ---------------------------------------------------------------------------
+# Recovery storm (graftheal, DESIGN.md r22): the fault CLEARS.  main_mesh
+# proves detection (one-way quarantine under a persistent fault); this storm
+# proves the other half of the r22 contract — probation probes re-admit a
+# healthy chip, a flapping chip is retired for good, and a poisoned breaker
+# rung's half-open canary fails CLOSED until the poison clears.
+# ---------------------------------------------------------------------------
+
+#: Hard real-time bound on the recovery storm (CPU fake devices, tiny
+#: model; each failed probation probe parks ~2 s real, plus two parity
+#: canaries' plain-XLA compiles).
+HEAL_BOUND_S = 240.0
+
+
+def _labeled(reg, name: str, **labels) -> int:
+    """Sum of a counter's series rows matching the given labels."""
+    return sum(int(v) for lab, v in reg.series(name)
+               if all(lab.get(k) == want for k, want in labels.items()))
+
+
+def main_heal() -> int:
+    import numpy as np
+
+    import jax
+
+    from raft_stereo_tpu.config import RAFTStereoConfig, with_eval_precision
+    from raft_stereo_tpu.faults import ChaosPlan, FakeClock
+    from raft_stereo_tpu.models import init_raft_stereo
+    from raft_stereo_tpu.obs.capacity import headroom_recovered
+    from raft_stereo_tpu.obs.flight import FlightRecorder
+    from raft_stereo_tpu.serve import (InferenceSession, ServiceConfig,
+                                       SessionConfig, StereoService)
+
+    n = int(os.environ.get("RAFT_CHAOS_N", "24"))
+    seed = int(os.environ.get("RAFT_CHAOS_SEED", "1234"))
+    assert len(jax.devices()) >= 2, (
+        f"recovery storm needs >=2 devices, found {len(jax.devices())} — "
+        f"run under XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+        f"(the __main__ dispatch arms it when unset)")
+    rng = np.random.default_rng(seed)
+
+    cfg = with_eval_precision(RAFTStereoConfig(
+        n_gru_layers=1, hidden_dims=(32, 32, 32),
+        corr_levels=2, corr_radius=2))
+    params = init_raft_stereo(jax.random.PRNGKey(0), cfg)
+    clock = FakeClock()
+    flight_dir = tempfile.mkdtemp(prefix="chaos-heal-flight-")
+    # Every forward costs 0.05 s of injected FAKE device time, in every
+    # plan of the storm: on a FakeClock nothing else advances the clock,
+    # so without this the latency EMAs never warm and the pre/post
+    # headroom comparison the acceptance hinges on would be vacuous.
+    SLOW = {o: 0.05 for o in range(4000)}
+    # The storm STARTS benign (plan swapped in mid-run below): the
+    # pre-fault headroom reading must come from clean steady serving.
+    session = InferenceSession(
+        params, cfg,
+        SessionConfig(valid_iters=4, segments=2, max_batch=4,
+                      batch_buckets=(1, 4), canary=False, mesh_data=2,
+                      warmup_shapes=((H, W),)),
+        fault_plan=ChaosPlan(slow_forwards=SLOW), clock=clock,
+        flight=FlightRecorder(flight_dir, limit=1000))
+    assert session.mesh_active and session.mesh_chips == 2, \
+        session.mesh_status()
+    hs0 = session.heal_status()
+    assert hs0["enabled"], (
+        "the recovery storm needs RAFT_HEAL on (the default)")
+    base_backoff_s = hs0["backoff_ms"] / 1e3
+    flap_cap = int(hs0["flap_cap"])
+    assert flap_cap >= 2, (
+        f"flap-cap pin needs RAFT_HEAL_FLAP_CAP >= 2, got {flap_cap}")
+    # Long stream TTL: the probation sweeps jump the fake clock minutes
+    # at a time and the re-placement pin needs the parked sessions alive.
+    svc = StereoService(session, ServiceConfig(
+        max_queue=16, watchdog_ms=2000.0, retry_budget=3,
+        drain_grace_ms=10_000.0,
+        stream_ttl_ms=100 * base_backoff_s * 1e3)).start()
+    reg = svc.registry
+
+    pairs = [(rng.uniform(0, 255, (H, W, 3)).astype(np.float32),
+              rng.uniform(0, 255, (H, W, 3)).astype(np.float32))
+             for _ in range(4)]
+
+    def make_request(i) -> dict:
+        left, right = pairs[hash(str(i)) % len(pairs)]
+        req = {"id": i, "left": left[None], "right": right[None],
+               "tenant": f"tenant-{hash(str(i)) % 3}"}
+        # A third of the storm rides stream sessions so the shrink parks
+        # them and the re-grow's repin seam has real rows to re-place.
+        if isinstance(i, int) and i % 3 == 0:
+            req["stream"] = f"cam-{(i // 3) % 2}"
+        return req
+
+    t_real0 = time.monotonic()
+    deadline_real = t_real0 + HEAL_BOUND_S
+    responses: list = []
+
+    def pump(tag: str, count: int) -> None:
+        """Closed-loop serve ``count`` requests, supervisor armed."""
+        futs: dict = {}
+        done = 0
+        submitted = 0
+        while done < count:
+            assert time.monotonic() < deadline_real, (
+                f"recovery storm exceeded its {HEAL_BOUND_S}s bound in "
+                f"phase {tag} with {count - done} Futures unresolved")
+            while submitted < count and len(futs) < IN_FLIGHT_CAP:
+                futs[submitted] = svc.submit(
+                    make_request(f"{tag}-{submitted}"
+                                 if tag != "storm" else submitted))
+                submitted += 1
+            sup = svc._supervisor
+            if sup is not None:
+                sup.check_now()
+            for rid in [r for r, f in futs.items() if f.done()]:
+                responses.append(futs.pop(rid).result(timeout=1))
+                done += 1
+            time.sleep(0.002)
+
+    def steady_headroom(tag: str):
+        """One canonical capacity reading: idle fake time, then require
+        the model's winning candidate to be fully warmed.
+
+        The idle gap first: with zero idle the injected device seconds
+        fill the whole covered window and saturation clamps to 1.0,
+        zeroing the very headroom the acceptance compares.  The
+        ``partial`` gate second: the model scores a batch bucket as
+        soon as its ADVANCE EMA exists, treating a missing
+        prepare/epilogue estimate as 0 — an honest under-informed
+        ceiling, but ~2x the warmed number, and WHICH components have
+        warmed at read time depends on how the closed-loop traffic
+        happened to batch (thread timing).  Pump more steady traffic
+        until the winner is complete: the per-forward fake cost is
+        constant, so a non-partial reading is deterministic and the
+        pre/post comparison measures the fault excursion, not the
+        warmup race."""
+        cap = None
+        for attempt in range(6):
+            clock.sleep(30.0)
+            cap = session.capacity_status()
+            winners = [b_ for b_ in cap["by_bucket"].values()
+                       if b_.get("rps") is not None]
+            best = max(winners, key=lambda b_: b_["rps"], default=None)
+            rows_ = [r_.get("headroom_rps")
+                     for r_ in cap.get("chips", {}).get("per_chip", [])]
+            total = (sum(v for v in rows_ if v is not None)
+                     if any(v is not None for v in rows_) else None)
+            if best is not None and not best.get("partial") and total:
+                return cap, total
+            pump(f"{tag}-warm{attempt}", 8)
+        raise AssertionError(
+            f"capacity model never fully warmed for the {tag} read — "
+            f"the recovery comparison would be vacuous: "
+            f"{cap['by_bucket'] if cap else None}")
+
+    # -- phase 0: clean steady serving; pre-fault headroom ---------------
+    pump("warm", n)
+    cap_pre, pre = steady_headroom("pre")
+
+    # -- phase 1: TRANSIENT fault lands; detection quarantines chip 1 ----
+    inv = session.faults.invokes
+    session.faults.plan = ChaosPlan(
+        hang_invokes={inv + 2: 10.0, inv + 6: 10.0},
+        hang_chips=(1,), hang_cap_s=5.0, slow_forwards=SLOW)
+    pump("storm", n)
+    assert session.faults.hangs_entered >= 1, (
+        "no injected hang ever parked a live invocation — the recovery "
+        "storm is vacuous for the device-hang path; retune the ordinals")
+    restarts = {labels["reason"]: int(v) for labels, v in
+                reg.series("raft_sched_restarts_total")}
+    assert restarts.get("device_hang", 0) >= 1, (
+        f"no device_hang bounce ever fired: {restarts}")
+    mesh = session.mesh_status()
+    assert mesh["quarantined"] == [1] and mesh["n_data"] == 1, mesh
+    by_chip = svc.stream.status()["by_chip"]
+    assert all(int(c) == 0 for c in by_chip), (
+        f"a stream session is still pinned past the 1-chip mesh: "
+        f"{by_chip}")
+    # The second hang ordinal can advance the fake clock AFTER the
+    # quarantine landed, so MTTR is pinned against the probation
+    # record's own quarantined_at, not this read point.
+    t_q0 = session._chip_heal[1]["quarantined_at"]
+
+    # Re-base the transient window NOW (plan installation re-bases it):
+    # the first probation probe must land INSIDE the still-active window
+    # (fails, backoff doubles), the second after it clears (passes).
+    clear_after_s = base_backoff_s + 10.0
+    session.faults.plan = ChaosPlan(
+        hang_chips=(1,), hang_cap_s=5.0,
+        clear_after_ms=clear_after_s * 1e3, slow_forwards=SLOW)
+
+    # -- phase 2: recovery is EXPLICIT, detection stays one-way ----------
+    # The supervisor's monitor never heals: check_now() with the backoff
+    # elapsed must leave the chip quarantined; only heal_sweep() probes.
+    res = svc.heal_sweep()
+    assert res["mesh"]["probed"] == [], (
+        f"a probation probe fired before the backoff elapsed: {res}")
+    clock.sleep(base_backoff_s + 1.0)
+    sup = svc._supervisor
+    if sup is not None:
+        sup.check_now()
+    assert session.mesh_status()["quarantined"] == [1], (
+        "the supervisor's monitor re-admitted a chip — recovery must "
+        "never ride the detection path")
+    # Still-wedged probe (window active): fail, backoff doubles.
+    res = svc.heal_sweep()
+    assert res["mesh"]["probed"] == [1] and res["mesh"]["failed"] == [1], res
+    chip_hs = session.heal_status()["chips"]["1"]
+    assert chip_hs["backoff_ms"] == 2 * base_backoff_s * 1e3, chip_hs
+    assert _labeled(reg, "raft_heal_chip_probes_total",
+                    result="failed") == 1
+    assert session.mesh_status()["quarantined"] == [1]
+
+    # Touch one parked stream session so the re-grow provably re-places
+    # a live row (host-side seed held across the whole excursion).
+    sfh = make_request(0)
+    sfh["id"] = "heal-parked-stream"
+    r = svc.submit(sfh).result(timeout=30)
+    assert r["status"] == "ok", r
+    responses.append(r)
+
+    # -- phase 3: the fault clears; the probe passes; the mesh re-grows --
+    clock.sleep(2 * base_backoff_s + 1.0)
+    t_sweep = clock.now()
+    res = svc.heal_sweep()
+    assert res["mesh"]["readmitted"] == [1], (
+        f"the cleared fault's probation probe failed to re-admit: {res}")
+    mesh = session.mesh_status()
+    assert mesh["quarantined"] == [] and mesh["n_data"] == 2, mesh
+    assert int(reg.value("raft_heal_chips_readmitted_total")) == 1
+    assert _labeled(reg, "raft_heal_chip_probes_total",
+                    result="passed") == 1
+    assert res["stream_repinned"] >= 1, (
+        f"no parked stream session was re-placed onto the re-grown "
+        f"mesh: {res}")
+    n_repinned = res["stream_repinned"]
+    mttr = session.heal_status()["mttr"]
+    assert mttr["events"] == 1 and mttr["last_s"] is not None
+    # Readmit stamps MTTR BEFORE its re-warm advances the fake clock:
+    # bounded by the sweep-entry and sweep-exit clock reads.
+    assert (t_sweep - t_q0) <= mttr["last_s"] <= (clock.now() - t_q0), \
+        (mttr, t_sweep - t_q0, clock.now() - t_q0)
+
+    # -- phase 4: capacity actually returned (the 10% acceptance) --------
+    pump("post", 8)
+    # Same measurement protocol as the pre-fault read (steady serving,
+    # idle gap, fully-warmed winner), so the saturation term cancels
+    # and the comparison isolates what the fault excursion did.
+    cap_post, post = steady_headroom("post")
+    post_rows = cap_post.get("chips", {}).get("per_chip", [])
+    assert all(not r_["quarantined"] for r_ in post_rows), post_rows
+    recovered = headroom_recovered(pre, post)
+    if os.environ.get("RAFT_CHAOS_DEBUG"):
+        print("PRE ", json.dumps(cap_pre["by_bucket"], default=str))
+        print("PRE-SAT ", json.dumps(cap_pre.get("saturation"), default=str))
+        print("POST", json.dumps(cap_post["by_bucket"], default=str))
+        print("POST-SAT", json.dumps(cap_post.get("saturation"), default=str))
+    assert recovered is True, (
+        f"headroom never recovered: pre={pre:.3f} post={post:.3f} rps")
+
+    # -- phase 5: a poisoned rung's half-open canary fails CLOSED --------
+    assert "fuse_iter" not in session.breaker.tripped_names
+    session.breaker.trip("fuse_iter", "storm_injected")
+    run_cfg_before = session._run_cfg
+    fwd = session.faults.forwards
+    session.faults.plan = ChaosPlan(
+        poison_outputs=tuple(range(fwd, fwd + 64)), slow_forwards=SLOW)
+    clock.sleep(base_backoff_s + 1.0)
+    res = svc.heal_sweep()
+    assert res["breaker"] == {"rung": "fuse_iter", "passed": False}, res
+    assert "fuse_iter" in session.breaker.tripped_names, (
+        "a FAILED half-open canary untripped the rung")
+    assert session._run_cfg is run_cfg_before, (
+        "a failed canary re-projected the serving config — the poisoned "
+        "rung was served from half-open")
+    half_open = session.heal_status()["breaker"]["half_open"]["fuse_iter"]
+    assert half_open["backoff_ms"] == 2 * base_backoff_s * 1e3, half_open
+    assert half_open["retrips"] == 1 and half_open["probes"] == 1
+    assert session.breaker.status()["tripped"]["fuse_iter"]["count"] == 2
+    assert _labeled(reg, "raft_heal_rung_probes_total",
+                    rung="fuse_iter", result="failed") == 1
+    # /healthz visibility: the doubled backoff rides the status document.
+    hz = svc.status()["heal"]
+    assert hz["breaker"]["half_open"]["fuse_iter"]["backoff_ms"] == \
+        2 * base_backoff_s * 1e3, hz["breaker"]
+    # The poison clears -> the canary passes -> the rung re-engages.
+    session.faults.plan = ChaosPlan(slow_forwards=SLOW)
+    clock.sleep(2 * base_backoff_s + 1.0)
+    res = svc.heal_sweep()
+    assert res["breaker"] == {"rung": "fuse_iter", "passed": True}, res
+    assert "fuse_iter" not in session.breaker.tripped_names
+    assert _labeled(reg, "raft_heal_untrips_total", rung="fuse_iter") == 1
+    assert _labeled(reg, "raft_heal_rung_probes_total",
+                    rung="fuse_iter", result="passed") == 1
+    pump("postheal", 4)
+
+    # -- phase 6: a FLAPPING chip is retired after exactly flap-cap ------
+    readmissions = 1  # phase 3's
+    for _ in range(flap_cap - 1):
+        assert session.quarantine_chip(1), "flap re-quarantine refused"
+        clock.sleep(2 * base_backoff_s + 1.0)
+        res = svc.heal_sweep()
+        assert res["mesh"]["readmitted"] == [1], res
+        readmissions += 1
+    assert int(reg.value("raft_heal_chips_readmitted_total")) == \
+        readmissions == flap_cap
+    assert session.quarantine_chip(1), "final flap quarantine refused"
+    chip_hs = session.heal_status()["chips"]["1"]
+    assert chip_hs["permanent"] is True, (
+        f"chip flapped past the cap yet was not retired: {chip_hs}")
+    assert int(reg.value("raft_heal_chips_permanent_total")) == 1
+    clock.sleep(100 * base_backoff_s)
+    res = svc.heal_sweep()
+    assert res["mesh"]["probed"] == [], (
+        f"a permanently-quarantined chip was probed again: {res}")
+    mesh = session.mesh_status()
+    assert mesh["quarantined"] == [1] and mesh["n_data"] == 1, mesh
+    assert int(reg.value("raft_heal_chips_readmitted_total")) == flap_cap, (
+        "re-admissions moved past the flap cap")
+    cap_doc = session.capacity_status()
+    flap_row = [r_ for r_ in cap_doc["chips"]["per_chip"]
+                if r_["chip"] == 1][0]
+    assert flap_row["quarantined"] and flap_row.get("permanent") is True, \
+        flap_row
+    pump("flapped", 4)
+
+    # -- invariants: structured outcomes + the books reconcile -----------
+    for r in responses:
+        assert r["status"] in ("ok", "rejected", "error"), r
+        if r["status"] != "ok":
+            assert r.get("code"), r
+        else:
+            assert np.isfinite(r["disparity"]).all()
+    usage_doc = session.usage.doc()
+    tenant_ns = sum(t["device_ns"] for t in usage_doc["by_tenant"].values())
+    assert tenant_ns == usage_doc["device_ns_total"], (
+        f"per-tenant device-ns sum {tenant_ns} != accounted total "
+        f"{usage_doc['device_ns_total']} across the recovery excursion")
+    prog_dev_s = sum(v for _, v in
+                     reg.series("raft_program_device_seconds_total"))
+    assert abs(usage_doc["device_ns_total"] / 1e9 - prog_dev_s) <= \
+        max(1e-6, 1e-9 * prog_dev_s), (
+        usage_doc["device_ns_total"] / 1e9, prog_dev_s)
+
+    heal_final = session.heal_status()
+    assert svc.drain(), "recovery-storm service failed to drain"
+    elapsed_real = time.monotonic() - t_real0
+
+    outcomes: dict = {}
+    for r in responses:
+        key = (r["status"] if r["status"] == "ok"
+               else f'{r["status"]}:{r["code"]}')
+        outcomes[key] = outcomes.get(key, 0) + 1
+    doc = {
+        "metric": "heal_chaos",
+        "pass": True,
+        "n": len(responses),
+        "seed": seed,
+        "devices": len(jax.devices()),
+        "outcomes": dict(sorted(outcomes.items())),
+        "restarts": restarts,
+        "headroom": {"pre_rps": round(pre, 4),
+                     "post_rps": round(post, 4),
+                     "recovered": recovered},
+        "mttr_s": heal_final["mttr"]["last_s"],
+        "mttr_events": heal_final["mttr"]["events"],
+        "readmitted": int(reg.value("raft_heal_chips_readmitted_total")),
+        "flap_cap": flap_cap,
+        "permanent": int(reg.value("raft_heal_chips_permanent_total")),
+        "rung_probes": {
+            "failed": _labeled(reg, "raft_heal_rung_probes_total",
+                               result="failed"),
+            "passed": _labeled(reg, "raft_heal_rung_probes_total",
+                               result="passed")},
+        "stream_repinned": n_repinned,
+        "hangs_entered": session.faults.hangs_entered,
+        "elapsed_real_s": round(elapsed_real, 2),
+        "backend": jax.default_backend(),
+    }
+    print(json.dumps(doc))
+
+    from raft_stereo_tpu.obs.trajectory import emit
+    emit("heal_chaos_recovered", 1.0, "frac",
+         backend=jax.default_backend(), source="scratch/chaos_serve.py",
+         extra={"n": doc["n"], "mttr_s": doc["mttr_s"],
+                "headroom_pre_rps": doc["headroom"]["pre_rps"],
+                "headroom_post_rps": doc["headroom"]["post_rps"],
+                "readmitted": doc["readmitted"],
+                "flap_cap": flap_cap,
+                "elapsed_real_s": doc["elapsed_real_s"]})
+    return 0
+
+
 if __name__ == "__main__":
     _wire = "--wire" in sys.argv[1:] or \
         os.environ.get("RAFT_CHAOS_WIRE", "").strip().lower() in (
@@ -1091,7 +1490,10 @@ if __name__ == "__main__":
     _mesh = "--mesh" in sys.argv[1:] or \
         os.environ.get("RAFT_CHAOS_MESH", "").strip().lower() in (
             "1", "true", "yes", "on")
-    if _mesh:
+    _heal = "--heal" in sys.argv[1:] or \
+        os.environ.get("RAFT_CHAOS_HEAL", "").strip().lower() in (
+            "1", "true", "yes", "on")
+    if _mesh or _heal:
         # Arm the fake-device pod BEFORE anything imports jax (the same
         # self-arming bench_serve.py --mesh does).
         _flags = os.environ.get("XLA_FLAGS", "")
@@ -1100,9 +1502,11 @@ if __name__ == "__main__":
                 _flags + " --xla_force_host_platform_device_count=8"
             ).strip()
     _metric = ("wire_chaos" if _wire else
+               "heal_chaos" if _heal else
                "mesh_chaos" if _mesh else "chaos_soak")
     try:
         raise SystemExit(main_wire() if _wire
+                         else main_heal() if _heal
                          else main_mesh() if _mesh else main())
     except AssertionError as e:
         print(json.dumps({"metric": _metric, "pass": False,

@@ -239,6 +239,21 @@ def main() -> int:
         assert isinstance(health["uptime_s"], float)
         assert health["uptime_s"] >= 0.0, health
 
+        # /healthz heal block (graftheal, DESIGN.md r22): the recovery
+        # plane's probation state rides health — pacing knobs, the
+        # per-rung half-open table, per-chip probation rows, MTTR.  On
+        # a healthy default-ON instance the tables are empty and MTTR
+        # has recorded nothing — absence, never a fabricated zero.
+        heal = health["heal"]
+        assert heal["enabled"] is True, heal
+        assert heal["backoff_ms"] > 0, heal
+        assert heal["backoff_max_ms"] >= heal["backoff_ms"], heal
+        assert heal["flap_cap"] >= 1 and heal["window_ms"] > 0, heal
+        assert heal["breaker"]["enabled"] is True, heal["breaker"]
+        assert heal["breaker"]["half_open"] == {}, heal["breaker"]
+        assert heal["chips"] == {}, heal["chips"]
+        assert heal["mttr"] == {"last_s": None, "events": 0}, heal
+
         proc.send_signal(signal.SIGTERM)
         # communicate(), not wait(): the CLI prints its final /healthz
         # status document on drain, and an unread pipe could wedge it.
@@ -249,6 +264,51 @@ def main() -> int:
         if proc.poll() is None:
             proc.kill()
             proc.wait(timeout=30)
+
+    # /fleet/healthz (graftheal satellite): every slot row carries its
+    # live restart-budget position — ``restarts_spent`` and
+    # ``budget_remaining`` — alongside the fleet-level ``heal`` block.
+    # A 1-instance fleet over the same tiny recipe pins the schema
+    # through the live fleet ingress.
+    import tempfile
+
+    from raft_stereo_tpu.serve.fleet import (FleetConfig, FleetFrontend,
+                                             FleetSupervisor)
+    fcfg = FleetConfig(
+        instances=1, restart_budget=3, probe_ms=200.0,
+        warmup_timeout_ms=600_000.0, drain_grace_ms=60_000.0,
+        instance_args=("--no_canary", "--max_batch", "2",
+                       "--valid_iters", "2", "--segments", "2",
+                       "--n_gru_layers", "1",
+                       "--hidden_dims", "32", "32", "32",
+                       "--corr_levels", "2", "--corr_radius", "2",
+                       "--corr_implementation", "reg"),
+        instance_env={"JAX_PLATFORMS": "cpu"},
+        cache_dir=tempfile.mkdtemp(prefix="gate-fleet-cache-"))
+    with FleetSupervisor(fcfg) as fsup, FleetFrontend(fsup) as ffe:
+        fraw = _get(f"http://{ffe.host}:{ffe.port}", "/fleet/healthz")
+        assert len(fraw) <= 1 << 20, (
+            f"/fleet/healthz body is {len(fraw)} bytes > its 1 MiB "
+            f"bound")
+        fdoc = json.loads(fraw)
+        assert fdoc["restart_budget"] == 3, fdoc
+        fheal = fdoc["heal"]
+        assert fheal["enabled"] is True, fheal
+        assert fheal["refill_ms"] > 0, fheal
+        assert fheal["slot_relaunches_total"] == 0, fheal
+        frows = fdoc["by_instance"]
+        assert len(frows) == 1, frows
+        row = frows[0]
+        assert row["slot"] == 0, row
+        # The first launch of a generation is free (budget charges
+        # cover retries and replacements), so a fresh slot shows a full
+        # budget.
+        assert row["restarts_spent"] == 0, row
+        assert row["budget_remaining"] == 3, row
+        # The fleet rollup's recovery columns are present even before
+        # any recovery happened — absence as None/0, never fabricated.
+        assert fdoc["mttr_last_s"] is None, fdoc["mttr_last_s"]
+        assert fdoc["heal_events"] == 0, fdoc["heal_events"]
 
     print(json.dumps({
         "metric": "debug_endpoints",
@@ -261,6 +321,9 @@ def main() -> int:
         "cache": {"hits": cache_block["hits"],
                   "entries": cache_block["entries"],
                   "tenant_hits": gate_cache["hits"]},
+        "fleet": {"restart_budget": fdoc["restart_budget"],
+                  "slot0_budget_remaining": row["budget_remaining"],
+                  "heal_enabled": fheal["enabled"]},
     }))
     return 0
 

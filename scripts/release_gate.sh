@@ -246,6 +246,37 @@ else
     echo "FAIL: mesh chaos storm"; fail=1
 fi
 
+# graftheal battery (ISSUE 18, DESIGN.md r22): the recovery plane's
+# half-open probation state machines on FakeClock — breaker rungs
+# re-engage in strict reverse trip order behind a passing parity canary
+# (a failed canary re-trips with doubled backoff and never touches
+# serving state), a quarantined chip re-grows the mesh with bitwise
+# response parity and zero mid-request compiles, the flap cap is exact,
+# fleet restart budgets refill on the decay clock, and RAFT_HEAL=0
+# provably restores the one-way semantics for all three ladders.
+step "recovery-plane battery (graftheal: probation, canary gate, flap cap)"
+env JAX_PLATFORMS=cpu python -m pytest tests/test_heal.py -q -m heal \
+    -p no:cacheprovider -p no:xdist -p no:randomly \
+    || { echo "FAIL: recovery-plane battery"; fail=1; }
+
+# Recovery storm (ISSUE 18 acceptance): the fault-CLEARS chaos storm —
+# a transient chip hang quarantines one chip of a 2-chip mesh, the
+# too-early sweep provably no-ops and the detection path never heals,
+# a failed probe doubles the backoff, then the fault window clears and
+# the explicit heal_sweep() re-grows the mesh (re-warmed before any row
+# routes, stream sessions re-placed), headroom recovers to within 10%
+# of the pre-fault reading, a poisoned rung fails CLOSED from half-open
+# before re-engaging, the flap cap retires a flapping chip permanently,
+# and the books still reconcile. MTTR lands in the JSON verdict and the
+# trajectory artifact.
+step "recovery storm (fault-clears chaos: quarantine -> probation -> re-grow)"
+if env JAX_PLATFORMS=cpu python scratch/chaos_serve.py --heal > heal_chaos.json; then
+    cat heal_chaos.json
+else
+    echo "--- heal_chaos.json ---"; cat heal_chaos.json
+    echo "FAIL: recovery storm"; fail=1
+fi
+
 # Mesh scaling bench smoke (ISSUE 17 acceptance wiring): sweep
 # n_data in {1,2,4,8} over fake CPU devices and emit rps_per_chip +
 # mesh_scaling_efficiency into the trajectory. On this single-core CPU

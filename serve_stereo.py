@@ -98,6 +98,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "pre-compile, e.g. '544x960,736x1280'")
     parser.add_argument('--no_canary', action='store_true',
                         help="skip the startup fast-vs-XLA parity canary")
+    parser.add_argument('--no_heal', action='store_true',
+                        help="disable the recovery plane (RAFT_HEAL=0 "
+                        "equivalent): breaker trips, chip quarantines "
+                        "and restart-budget exhaustion stay one-way")
     parser.add_argument('--no_half_res', action='store_true',
                         help="never degrade to half resolution")
     parser.add_argument('--status_json', default=None,
@@ -319,6 +323,7 @@ def serve(args) -> int:
             allow_half_res=not args.no_half_res,
             max_batch=args.max_batch,
             mesh_data=args.mesh_data,
+            heal=False if args.no_heal else None,
             admission=AdmissionConfig(max_pixels=args.max_pixels)))
     service = StereoService(session, ServiceConfig(
         max_queue=args.max_queue, workers=args.workers,
@@ -405,7 +410,18 @@ def serve(args) -> int:
                 pass
         try:
             while not stop_requested.wait(0.2):
-                pass
+                # graftheal: the production recovery drive point — the
+                # wait loop, NOT the Supervisor's monitor thread
+                # (detection and recovery stay on separate triggers; the
+                # chaos battery pins the detector's one-way monotonicity
+                # mid-storm).  A sweep with nothing in probation is two
+                # lock peeks; probes/canaries only run once a probation
+                # deadline elapses.  Failure-isolated: a dying sweep
+                # must never take the serve loop down with it.
+                try:
+                    service.heal_sweep()
+                except Exception:
+                    logging.exception("heal sweep failed")
             # SIGTERM rides the PR 9 drain: the very same state machine
             # in-process callers get — late wire requests are answered
             # 503 service_draining by the still-listening frontend,

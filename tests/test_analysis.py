@@ -456,6 +456,22 @@ def test_gl002_real_tree_mesh_knob_registered():
     assert hits[0].path.endswith("serve/session.py")
 
 
+def test_gl002_real_tree_heal_knob_registered():
+    # RAFT_HEAL_BACKOFF_MS (serve/heal.py resolve_heal_backoff_ms, the
+    # r22 recovery-plane probation backoff) is covered by
+    # HOST_ENV_KNOBS; drop it and GL002 must fire at the read site — the
+    # recovery-pacing knobs cannot silently drift out of the registry
+    # (the drop leaves RAFT_HEAL / RAFT_HEAL_FLAP_CAP /
+    # RAFT_HEAL_REFILL_MS covered so the hit is unambiguous).
+    files = collect_files([str(PACKAGE)], base=str(REPO))
+    reduced = tuple(k for k in knobs.SERVE_ENV_KNOBS + knobs.HOST_ENV_KNOBS
+                    if k != "RAFT_HEAL_BACKOFF_MS")
+    rep = run_checkers(Project(files, serve_knobs=reduced))
+    hits = [f for f in rep.findings if f.code == "GL002"]
+    assert hits and "RAFT_HEAL_BACKOFF_MS" in hits[0].message
+    assert hits[0].path.endswith("serve/heal.py")
+
+
 def test_gl002_real_tree_dropped_knob_fails():
     # Acceptance fixture: drop RAFT_CORR_TILE from the registry while its
     # read still exists in corr/pallas_reg.py -> GL002 must fire.

@@ -141,7 +141,7 @@ def test_deck_ring_bounded_and_dropped_counted():
         clk.sleep(0.1)
         deck.end_tick(t)
     st = deck.status()
-    assert st == {"ring": 4, "recorded": 10, "dropped": 6}
+    assert st == {"ring": 4, "recorded": 10, "dropped": 6, "warm_records": 0}
     # an OPEN tick (seq allocated, not yet ringed) must never read as a
     # spurious ring drop on a concurrent /debug/ticks scrape
     open_tick = deck.begin_tick(bucket="64x64")

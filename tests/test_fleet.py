@@ -110,10 +110,19 @@ def test_launch_handshake_and_status():
         assert doc["fingerprints"] == ["stub-fp"]
         assert doc["counters"]["instances_total"] == 2
         assert doc["counters"]["restarts_total"] == 0
-        # each by_instance row carries its slot and health fields
-        for row in doc["by_instance"]:
+        # each by_instance row carries its slot, health fields, and the
+        # per-slot restart-budget ledger (graftheal satellite: the
+        # first launch of a generation is free)
+        for i, row in enumerate(doc["by_instance"]):
             assert row["state"] == "ready"
             assert "uptime_s" in row and "headroom_rps" in row
+            assert row["slot"] == i
+            assert row["restarts_spent"] == 0
+            assert row["budget_remaining"] == 3
+        assert doc["restart_budget"] == 3
+        assert doc["heal"]["enabled"] is True
+        assert doc["heal"]["refill_ms"] > 0
+        assert doc["heal"]["slot_relaunches_total"] == 0
     # stop() drained both cleanly (SIGTERM kills the stub fast)
     assert int(sup.registry.value("raft_fleet_draining_total")) == 2
     assert int(sup.registry.value(
@@ -273,6 +282,13 @@ def test_warmup_death_budget_exhausted_degrades(tmp_path):
         doc = sup.status()
         assert doc["degraded_slots"] == 1
         assert doc["states"].get("degraded") == 1
+        # the degraded row pins its exhausted ledger on /fleet/healthz
+        row0 = doc["by_instance"][0]
+        assert row0["state"] == "degraded" and row0["slot"] == 0
+        assert row0["uid"] is None
+        assert row0["restarts_spent"] == 2
+        assert row0["budget_remaining"] == 0
+        assert doc["by_instance"][1]["budget_remaining"] == 2
         status, resp = post(sup)
         assert status == 200 and resp["status"] == "ok"
 

@@ -1,0 +1,404 @@
+"""graftheal battery — the recovery plane (ISSUE 18, DESIGN.md r22).
+
+Half-open probation for the three one-way degradation ladders, on the
+injectable FakeClock so every deadline is exact and instantaneous:
+
+- knob resolution (named ValueErrors, kill switch, explicit-config
+  wins) for the six RAFT_HEAL_* pacing knobs;
+- breaker rungs re-engage in STRICT REVERSE trip order, only after a
+  passing parity canary run from the half-open state — a failed canary
+  re-trips with doubled backoff and never touches serving state;
+- a quarantined chip re-probes on the probation clock, a passing probe
+  re-grows the mesh (epoch bump) with responses BITWISE identical to
+  the pre-shrink serve at the same bucket and ZERO mid-request compiles
+  (the warmup-LRU floor holds the re-keyed programs before any row
+  routes — pinned via the deck's cumulative warm-record counter);
+- the flap cap is exact: K re-admissions inside the window, then the
+  chip is permanently out and never re-probed;
+- ``RAFT_HEAL=0`` provably restores the one-way PR 3..17 semantics for
+  all three ladders;
+- fleet restart budgets refill on the decay clock: an exhausted slot
+  degrades, then re-enters probation with exactly one
+  handshake-verified relaunch per refund (stub instances, real
+  subprocesses — the tests/test_fleet.py rig).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+from raft_stereo_tpu.faults import FakeClock, ServeFaultPlan
+from raft_stereo_tpu.serve.guard import KernelCircuitBreaker
+from raft_stereo_tpu.serve.heal import (resolve_heal_backoff_max_ms,
+                                        resolve_heal_backoff_ms,
+                                        resolve_heal_enabled,
+                                        resolve_heal_flap_cap,
+                                        resolve_heal_refill_ms,
+                                        resolve_heal_window_ms)
+from tests.test_fleet import make_fleet
+from tests.test_mesh_serve import (H, W, make_request, make_session,
+                                   run_sched)
+from tests.test_mesh_serve import pairs  # noqa: F401 — fixture
+from tests.test_mesh_serve import tiny_cfg  # noqa: F401 — fixture
+from tests.test_mesh_serve import tiny_params  # noqa: F401 — fixture
+
+pytestmark = pytest.mark.heal
+
+HEAL_VARS = ("RAFT_HEAL", "RAFT_HEAL_BACKOFF_MS",
+             "RAFT_HEAL_BACKOFF_MAX_MS", "RAFT_HEAL_FLAP_CAP",
+             "RAFT_HEAL_WINDOW_MS", "RAFT_HEAL_REFILL_MS")
+
+
+@pytest.fixture(autouse=True)
+def _clean_heal_env(monkeypatch):
+    for var in HEAL_VARS:
+        monkeypatch.delenv(var, raising=False)
+
+
+def series_sum(registry, name, **labels):
+    return int(sum(v for lbl, v in registry.series(name)
+                   if all(lbl.get(k) == want
+                          for k, want in labels.items())))
+
+
+# ---------------------------------------------------------------------------
+# Knob resolution (serve/heal.py): named errors, kill switch, precedence.
+# ---------------------------------------------------------------------------
+
+
+def test_heal_knob_resolution_named_errors(monkeypatch):
+    assert resolve_heal_enabled() is True          # default ON
+    monkeypatch.setenv("RAFT_HEAL", "0")
+    assert resolve_heal_enabled() is False         # the kill switch
+    assert resolve_heal_enabled(True) is True      # explicit config wins
+    monkeypatch.setenv("RAFT_HEAL", "1")
+    assert resolve_heal_enabled() is True
+
+    assert resolve_heal_backoff_ms() == 30_000.0
+    monkeypatch.setenv("RAFT_HEAL_BACKOFF_MS", "5000")
+    assert resolve_heal_backoff_ms() == 5000.0
+    assert resolve_heal_backoff_ms(250.0) == 250.0
+    monkeypatch.setenv("RAFT_HEAL_BACKOFF_MS", "soon")
+    with pytest.raises(ValueError, match="RAFT_HEAL_BACKOFF_MS"):
+        resolve_heal_backoff_ms()
+    monkeypatch.setenv("RAFT_HEAL_BACKOFF_MS", "-1")
+    with pytest.raises(ValueError, match="RAFT_HEAL_BACKOFF_MS"):
+        resolve_heal_backoff_ms()
+
+    assert resolve_heal_backoff_max_ms() == 480_000.0
+    monkeypatch.setenv("RAFT_HEAL_BACKOFF_MAX_MS", "nope")
+    with pytest.raises(ValueError, match="RAFT_HEAL_BACKOFF_MAX_MS"):
+        resolve_heal_backoff_max_ms()
+
+    assert resolve_heal_flap_cap() == 2
+    monkeypatch.setenv("RAFT_HEAL_FLAP_CAP", "0")
+    assert resolve_heal_flap_cap() == 0            # 0 = never re-admit
+    monkeypatch.setenv("RAFT_HEAL_FLAP_CAP", "-2")
+    with pytest.raises(ValueError, match="RAFT_HEAL_FLAP_CAP"):
+        resolve_heal_flap_cap()
+    monkeypatch.setenv("RAFT_HEAL_FLAP_CAP", "many")
+    with pytest.raises(ValueError, match="RAFT_HEAL_FLAP_CAP"):
+        resolve_heal_flap_cap()
+
+    assert resolve_heal_window_ms() == 600_000.0
+    monkeypatch.setenv("RAFT_HEAL_WINDOW_MS", "0")
+    with pytest.raises(ValueError, match="RAFT_HEAL_WINDOW_MS"):
+        resolve_heal_window_ms()
+
+    assert resolve_heal_refill_ms() == 60_000.0
+    monkeypatch.setenv("RAFT_HEAL_REFILL_MS", "bad")
+    with pytest.raises(ValueError, match="RAFT_HEAL_REFILL_MS"):
+        resolve_heal_refill_ms()
+
+
+# ---------------------------------------------------------------------------
+# Breaker probation (serve/guard.py): reverse trip order, backoff
+# doubling, hand-out pacing — pure state machine, no jax.
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_probation_reverse_trip_order():
+    clock = FakeClock()
+    br = KernelCircuitBreaker()
+    br.configure_heal(enabled=True, clock=clock, backoff_s=30.0,
+                      backoff_max_s=480.0)
+    br.trip("fuse_iter", "storm")
+    clock.sleep(1.0)
+    br.trip("corr_pack8", "storm")
+    # Nothing is eligible before its probation deadline.
+    assert br.heal_candidate() is None
+    clock.sleep(40.0)
+    # Only the MOST recently tripped rung is ever nominated — re-arming
+    # fuse_iter under a still-dark corr_pack8 would canary a
+    # configuration that was never served.
+    assert br.heal_candidate() == "corr_pack8"
+    # Hand-out pushed the deadline one backoff out: a concurrent sweep
+    # cannot double-probe the rung.
+    assert br.heal_candidate() is None
+    assert br.untrip("corr_pack8")
+    # With the later trip re-engaged, the earlier rung (deadline long
+    # past) becomes the candidate — strict reverse trip order.
+    assert br.heal_candidate() == "fuse_iter"
+    assert br.untrip("fuse_iter")
+    assert br.tripped_names == ()
+    assert br.heal_candidate() is None
+    assert br.heal_status()["half_open"] == {}
+
+
+def test_breaker_retrip_doubles_backoff_capped():
+    clock = FakeClock()
+    br = KernelCircuitBreaker()
+    br.configure_heal(enabled=True, clock=clock, backoff_s=30.0,
+                      backoff_max_s=100.0)
+    br.trip("fuse_iter", "storm")
+    for want in (60.0, 100.0, 100.0):   # doubles, then pins at the cap
+        br.trip("fuse_iter", "heal_canary_failed")
+        st = br.heal_status()["half_open"]["fuse_iter"]
+        assert st["backoff_ms"] == want * 1e3
+    assert br.heal_status()["half_open"]["fuse_iter"]["retrips"] == 3
+    # A pass-and-later-retrip starts back at the BASE backoff: the
+    # fault class that cleared is not the one that re-trips.
+    assert br.untrip("fuse_iter")
+    br.trip("fuse_iter", "storm")
+    assert br.heal_status()["half_open"]["fuse_iter"]["backoff_ms"] == \
+        30_000.0
+
+
+def test_breaker_unconfigured_keeps_one_way_semantics():
+    br = KernelCircuitBreaker()
+    br.trip("fuse_iter", "storm")
+    assert br.heal_candidate() is None
+    assert br.heal_status() == {"enabled": False, "half_open": {}}
+    assert "fuse_iter" in br.tripped_names
+
+
+# ---------------------------------------------------------------------------
+# Session-level rung re-engagement: the canary gates the untrip.
+# ---------------------------------------------------------------------------
+
+
+def test_heal_breaker_canary_fail_then_pass(tiny_params, tiny_cfg):
+    sess = make_session(tiny_params, tiny_cfg)
+    base_s = sess.heal_status()["backoff_ms"] / 1e3
+    sess.breaker.trip("fuse_iter", "test_injected")
+    run_cfg_before = sess._run_cfg
+    rebuilds0 = series_sum(sess.registry, "raft_session_rebuilds_total")
+    # Not eligible before the probation deadline.
+    assert sess.heal_breaker() is None
+    # Poison every upcoming forward: the half-open canary must fail
+    # CLOSED — the rung stays tripped and serving config is untouched.
+    fwd = sess.faults.forwards
+    sess.faults.plan = ServeFaultPlan(
+        poison_outputs=tuple(range(fwd, fwd + 16)))
+    sess.clock.sleep(base_s + 1.0)
+    res = sess.heal_breaker()
+    assert res == {"rung": "fuse_iter", "passed": False}
+    assert "fuse_iter" in sess.breaker.tripped_names
+    assert sess._run_cfg is run_cfg_before, (
+        "a failed canary must never touch the serving config")
+    assert series_sum(sess.registry,
+                      "raft_session_rebuilds_total") == rebuilds0
+    ho = sess.breaker.heal_status()["half_open"]["fuse_iter"]
+    assert ho["backoff_ms"] == 2 * base_s * 1e3   # doubled on re-trip
+    assert ho["probes"] == 1 and ho["retrips"] == 1
+    assert sess.breaker.status()["tripped"]["fuse_iter"]["count"] == 2
+    assert series_sum(sess.registry, "raft_heal_rung_probes_total",
+                      rung="fuse_iter", result="failed") == 1
+    # The hand-out pushed the deadline: no immediate re-probe.
+    assert sess.heal_breaker() is None
+    # Fault clears; after the doubled backoff the canary passes and the
+    # rung re-engages (re-projected config, probation row dropped).
+    sess.faults.plan = ServeFaultPlan()
+    sess.clock.sleep(2 * base_s + 1.0)
+    res2 = sess.heal_breaker()
+    assert res2 == {"rung": "fuse_iter", "passed": True}
+    assert "fuse_iter" not in sess.breaker.tripped_names
+    # The re-engagement re-keyed the serving programs (one rebuild —
+    # fuse_iter is an env-switch rung, so the dataclass cfg is
+    # unchanged; the rebuild is what re-keys the program cache).
+    assert series_sum(sess.registry,
+                      "raft_session_rebuilds_total") == rebuilds0 + 1
+    assert sess.breaker.heal_status()["half_open"] == {}
+    assert series_sum(sess.registry, "raft_heal_rung_probes_total",
+                      rung="fuse_iter", result="passed") == 1
+    assert series_sum(sess.registry, "raft_heal_untrips_total",
+                      rung="fuse_iter") == 1
+
+
+# ---------------------------------------------------------------------------
+# Mesh shrink -> re-grow: bitwise parity at the same bucket, zero
+# mid-request compiles (the warmup floor held the re-keyed programs).
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_regrow_bitwise_parity_no_midrequest_compiles(
+        tiny_params, tiny_cfg, pairs):
+    sess = make_session(tiny_params, tiny_cfg, mesh_data=2,
+                        warmup_shapes=((H, W),))
+
+    def reqs(tag):
+        return [make_request(p, rid=f"{tag}{i}")
+                for i, p in enumerate(pairs[:4])]
+
+    want, _ = run_sched(sess, reqs("a"))
+    assert all(want[f"a{i}"]["status"] == "ok" for i in range(4))
+    assert sess.quarantine_chip(1)
+    assert sess.mesh_chips == 1
+    mid, _ = run_sched(sess, reqs("m"))
+    assert all(mid[f"m{i}"]["status"] == "ok" for i in range(4))
+    base_s = sess.heal_status()["backoff_ms"] / 1e3
+    # Too early: the sweep must not probe.
+    assert sess.heal_mesh() == {"probed": [], "readmitted": [],
+                                "failed": []}
+    sess.clock.sleep(base_s + 1.0)
+    res = sess.heal_mesh()
+    assert res == {"probed": [1], "readmitted": [1], "failed": []}
+    st = sess.mesh_status()
+    assert st["n_data"] == 2 and st["quarantined"] == []
+    assert st["epoch"] == 2                    # shrink + re-grow
+    assert series_sum(sess.registry, "raft_heal_chip_probes_total",
+                      result="passed") == 1
+    assert sess.heal_status()["mttr"] == {
+        "last_s": pytest.approx(base_s + 1.0), "events": 1}
+    # The re-admission re-warmed BEFORE returning: serving the same
+    # rows at the same bucket is bitwise identical to the pre-shrink
+    # run with ZERO new compile-bearing deck records (the PR 5
+    # mid-request-compile class, pinned on the cumulative counter).
+    warm0 = sess.deck.status()["warm_records"]
+    got, _ = run_sched(sess, reqs("b"))
+    for i in range(4):
+        assert got[f"b{i}"]["status"] == "ok"
+        assert got[f"b{i}"]["disparity"].tobytes() == \
+            want[f"a{i}"]["disparity"].tobytes(), (
+            f"row {i} not bitwise identical across shrink -> re-grow")
+    assert sess.deck.status()["warm_records"] == warm0, (
+        "the re-grown mesh served a cold program mid-request")
+
+
+def test_chip_flap_cap_exact(tiny_params, tiny_cfg):
+    sess = make_session(tiny_params, tiny_cfg, mesh_data=2)
+    hs = sess.heal_status()
+    base_s = hs["backoff_ms"] / 1e3
+    flap_cap = hs["flap_cap"]
+    assert flap_cap == 2
+    # Exactly flap_cap re-admissions succeed (the backoff doubles per
+    # re-quarantine, so sleep past the worst case each round).
+    for k in range(flap_cap):
+        assert sess.quarantine_chip(1)
+        sess.clock.sleep(2 * base_s + 1.0)
+        res = sess.heal_mesh()
+        assert res["readmitted"] == [1], (k, res)
+    assert series_sum(sess.registry,
+                      "raft_heal_chips_readmitted_total") == flap_cap
+    # Flap cap + 1: the chip goes PERMANENTLY out.
+    assert sess.quarantine_chip(1)
+    chip = sess.heal_status()["chips"]["1"]
+    assert chip["permanent"] is True
+    assert chip["readmissions"] == flap_cap
+    assert chip["eligible_in_s"] is None
+    assert series_sum(sess.registry,
+                      "raft_heal_chips_permanent_total") == 1
+    # Never re-probed again, no matter how long the clock runs.
+    sess.clock.sleep(100 * base_s)
+    assert sess.heal_mesh() == {"probed": [], "readmitted": [],
+                                "failed": []}
+    assert not sess.readmit_chip(1)
+    st = sess.mesh_status()
+    assert st["n_data"] == 1 and st["quarantined"] == [1]
+    assert series_sum(sess.registry,
+                      "raft_heal_chips_readmitted_total") == flap_cap
+
+
+# ---------------------------------------------------------------------------
+# RAFT_HEAL=0: the one-way PR 3..17 semantics, provably restored.
+# ---------------------------------------------------------------------------
+
+
+def test_heal_disabled_is_one_way(monkeypatch, tiny_params, tiny_cfg):
+    monkeypatch.setenv("RAFT_HEAL", "0")
+    sess = make_session(tiny_params, tiny_cfg, mesh_data=2)
+    hs = sess.heal_status()
+    assert hs["enabled"] is False
+    assert hs["breaker"] == {"enabled": False, "half_open": {}}
+    # Chips: quarantine arms NO probation state; no sweep, no explicit
+    # readmit, no amount of clock ever re-grows the mesh.
+    assert sess.quarantine_chip(1)
+    sess.clock.sleep(1e6)
+    assert sess.heal_mesh() == {"probed": [], "readmitted": [],
+                                "failed": []}
+    assert not sess.readmit_chip(1)
+    assert sess.mesh_status()["quarantined"] == [1]
+    assert sess.heal_status()["chips"] == {}
+    # Rungs: tripped stays tripped, no candidate is ever nominated.
+    sess.breaker.trip("fuse_iter", "storm")
+    sess.clock.sleep(1e6)
+    assert sess.heal_breaker() is None
+    assert "fuse_iter" in sess.breaker.tripped_names
+    assert sess.breaker.heal_status()["half_open"] == {}
+    assert sess.heal_status()["mttr"] == {"last_s": None, "events": 0}
+
+
+# ---------------------------------------------------------------------------
+# Fleet slots (tests/test_fleet.py stub rig): restart budgets refill on
+# the decay clock; a degraded slot re-enters probation.
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_budget_refill_probation(tmp_path):
+    countdown = tmp_path / "die"
+    countdown.write_text("99")        # every launch dies during warmup
+    extra = lambda spec: ["--die-before-ready",  # noqa: E731
+                          str(countdown)]
+    sup = make_fleet(n=1, budget=1, extra=extra,
+                     restart_refill_ms=600_000.0)
+    with sup:
+        # Budget exhausted during start: the slot degraded, and the
+        # ledger is visible per-slot on /fleet/healthz.
+        assert sup._slots[0] is None
+        doc = sup.status()
+        assert doc["degraded_slots"] == 1
+        row = doc["by_instance"][0]
+        assert row["state"] == "degraded" and row["slot"] == 0
+        assert row["restarts_spent"] == 1
+        assert row["budget_remaining"] == 0
+        assert doc["heal"]["enabled"] is True
+        assert doc["heal"]["slot_relaunches_total"] == 0
+        # No refund yet: the probation pass must NOT relaunch.
+        sup.poke()
+        assert sup._slots[0] is None
+        # The fault clears AND the decay clock refunds a charge: the
+        # next poke runs exactly one handshake-verified relaunch.
+        countdown.write_text("0")
+        sup.refill_s = 0.05
+        time.sleep(0.12)
+        sup.poke()
+        assert sup._slots[0] is not None
+        assert sup._slots[0].state == "ready"
+        doc = sup.status()
+        assert doc["degraded_slots"] == 0
+        assert doc["heal"]["slot_relaunches_total"] == 1
+
+
+def test_fleet_refill_disabled_stays_degraded(tmp_path):
+    countdown = tmp_path / "die"
+    countdown.write_text("99")
+    extra = lambda spec: ["--die-before-ready",  # noqa: E731
+                          str(countdown)]
+    # heal=False: even a ~0 refill interval must never relaunch — the
+    # one-way PR 16 semantics, bit for bit.
+    sup = make_fleet(n=1, budget=1, extra=extra, heal=False,
+                     restart_refill_ms=1.0)
+    with sup:
+        assert sup._slots[0] is None
+        assert sup.status()["heal"]["enabled"] is False
+        countdown.write_text("0")
+        time.sleep(0.05)
+        sup.poke()
+        assert sup._slots[0] is None               # stays dark
+        doc = sup.status()
+        assert doc["degraded_slots"] == 1
+        assert doc["heal"]["slot_relaunches_total"] == 0
+        assert doc["by_instance"][0]["budget_remaining"] == 0
