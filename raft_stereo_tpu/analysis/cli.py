@@ -1,10 +1,15 @@
-"""``python -m raft_stereo_tpu.analysis`` — the graftlint/graftverify CLI.
+"""``python -m raft_stereo_tpu.analysis`` — the graftlint/graftverify/
+graftlock CLI.
 
 Default: the AST suite (GL001-GL006, milliseconds, no jax). With
 ``--trace``, ALSO runs graftverify (GV101-GV105): traces the repo's real
 entry points on CPU via jax.eval_shape/make_jaxpr/.lower() — no TPU, no
-execution — and walks the jaxprs/StableHLO; both reports merge into one
-verdict/JSON artifact.
+execution — and walks the jaxprs/StableHLO. With ``--concurrency``,
+ALSO runs graftlock (GC201-GC206, stdlib-only like the AST stage): the
+whole-repo lock model, the ``LOCK_ORDER.md`` manifest ceremony
+(``--write-manifest`` regenerates it), Future-lifecycle and
+sink/blocking-under-lock contracts.  All requested stages merge into
+one verdict/JSON artifact.
 
 Exit codes: 0 clean (suppressed findings allowed), 1 unsuppressed
 findings, 2 usage/internal error.
@@ -77,6 +82,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "defining build_registry() instead of the "
                         "default — tests point this at poisoned fixture "
                         "registries to prove each GV checker fires")
+    p.add_argument("--concurrency", action="store_true",
+                   help="also run graftlock (GC201-GC206): lock-order "
+                        "graph vs the committed LOCK_ORDER.md, Future "
+                        "lifecycle, blocking/sink-under-lock, _*_locked "
+                        "and Thread lifecycle contracts (stdlib-only, "
+                        "fast)")
+    p.add_argument("--write-manifest", action="store_true",
+                   help="with --concurrency: regenerate LOCK_ORDER.md "
+                        "from the tree before checking (the reviewed-"
+                        "diff ceremony — commit the result)")
     return p
 
 
@@ -100,6 +115,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("graftlint: --trace-registry/--trace-geometry require "
               "--trace", file=sys.stderr)
         return 2
+    if args.write_manifest and not args.concurrency:
+        # Same principle: a manifest silently not regenerated must never
+        # read as "regenerated".
+        print("graftlint: --write-manifest requires --concurrency",
+              file=sys.stderr)
+        return 2
     if args.list_checkers:
         from raft_stereo_tpu.analysis.checkers import ALL_CHECKERS
         for cls in ALL_CHECKERS:
@@ -109,6 +130,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         from raft_stereo_tpu.analysis.trace.checkers import \
             ALL_TRACE_CHECKERS
         for cls in ALL_TRACE_CHECKERS:
+            print(f"{cls.code}  {cls.name:<24} {cls.description}")
+        from raft_stereo_tpu.analysis.concurrency.checkers import \
+            ALL_CONCURRENCY_CHECKERS
+        for cls in ALL_CONCURRENCY_CHECKERS:
             print(f"{cls.code}  {cls.name:<24} {cls.description}")
         return 0
     roots = args.paths or _default_roots()
@@ -150,6 +175,24 @@ def main(argv: Optional[List[str]] = None) -> int:
                 run_trace_analysis(registry, select=select))
         except Exception as e:
             print(f"graftverify: internal error: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            return 2
+    if args.concurrency:
+        try:
+            from raft_stereo_tpu.analysis.concurrency import (
+                run_concurrency_analysis, write_lock_order_manifest)
+            if args.write_manifest:
+                path = write_lock_order_manifest(roots, base=base)
+                print(f"graftlock: wrote {path}", file=sys.stderr)
+            gc_report = run_concurrency_analysis(
+                roots, base=base, select=select, only_paths=only_paths,
+                # The AST stage above already reported parse errors and
+                # reasonless suppressions for this same file set.
+                emit_file_meta=False)
+            gc_report.files_analyzed = 0
+            report = report.merged(gc_report)
+        except Exception as e:
+            print(f"graftlock: internal error: {type(e).__name__}: {e}",
                   file=sys.stderr)
             return 2
     print(report.render_json() if args.as_json

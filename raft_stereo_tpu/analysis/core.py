@@ -25,6 +25,14 @@ from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 #: not be able to hide itself).
 META_CODE = "GL000"
 
+#: Meta-code of the graftlock (concurrency) stage — same non-suppressible,
+#: non-filterable contract as GL000, emitted by the GC stage for stale
+#: GC-code suppressions and (when the stage runs standalone) parse errors.
+CONCURRENCY_META_CODE = "GC200"
+
+#: Codes that are never suppressible and always pass ``--select``.
+META_CODES = (META_CODE, CONCURRENCY_META_CODE)
+
 _SUPPRESS_RE = re.compile(
     r"#\s*graftlint:\s*disable=([A-Z]{2}\d{3}(?:\s*,\s*[A-Z]{2}\d{3})*)"
     r"\s*(\([^)]*\))?")
@@ -419,40 +427,74 @@ def git_changed_files(repo_root: str) -> Set[str]:
 
 # -- runner ----------------------------------------------------------------
 
-def run_checkers(project: Project, checkers: Optional[Sequence] = None
-                 ) -> Report:
-    """Run ``checkers`` (default: the full registry) over ``project`` and
-    fold suppressions into the verdict."""
+def run_checkers(project: Project, checkers: Optional[Sequence] = None, *,
+                 meta_code: str = META_CODE,
+                 emit_file_meta: bool = True,
+                 stale_prefix: Optional[str] = "GL") -> Report:
+    """Run ``checkers`` (default: the full AST registry) over ``project``
+    and fold suppressions into the verdict.
+
+    meta_code: code for this stage's meta findings (GL000 for the AST
+        stage, GC200 for the concurrency stage).
+    emit_file_meta: emit parse errors and reasonless-suppression findings.
+        True for whichever stage runs first over a project; the
+        concurrency stage passes False when merging into an AST report so
+        the same broken suppression is not reported twice.
+    stale_prefix: suppressions whose codes ALL carry this prefix and that
+        suppressed nothing in this run are reported as stale meta
+        findings (the GL000/GC200 rot guard); None disables the check
+        (used when running a checker subset, where "unused" is
+        meaningless).
+    """
     if checkers is None:
         from raft_stereo_tpu.analysis.checkers import ALL_CHECKERS
         checkers = [c() for c in ALL_CHECKERS]
     raw: List[Finding] = []
     by_rel = {sf.relpath: sf for sf in project.files}
-    for sf in project.files:
-        if sf.parse_error is not None:
-            raw.append(Finding(
-                META_CODE, f"file does not parse: {sf.parse_error.msg}",
-                sf.relpath, sf.parse_error.lineno or 1))
+    if emit_file_meta:
+        for sf in project.files:
+            if sf.parse_error is not None:
+                raw.append(Finding(
+                    meta_code, f"file does not parse: {sf.parse_error.msg}",
+                    sf.relpath, sf.parse_error.lineno or 1))
     for checker in checkers:
         raw.extend(checker.check_project(project))
     # Malformed suppressions are findings in their own right.
-    for sf in project.files:
-        for line, sup in sorted(sf.suppressions.items()):
-            if not sup.reason:
-                raw.append(Finding(
-                    META_CODE, "suppression without a reason — use "
-                    "# graftlint: disable=GLxxx (why this is intentional)",
-                    sf.relpath, line))
+    if emit_file_meta:
+        for sf in project.files:
+            for line, sup in sorted(sf.suppressions.items()):
+                if not sup.reason:
+                    raw.append(Finding(
+                        meta_code, "suppression without a reason — use "
+                        "# graftlint: disable=XXnnn (why this is "
+                        "intentional)", sf.relpath, line))
     active, suppressed = [], []
+    used: Set[int] = set()  # id() of _Suppression objects that suppressed
     for f in raw:
         sf = by_rel.get(f.path)
         sup = sf.suppression_for(f.line) if sf is not None else None
-        if (f.code != META_CODE and sup is not None and sup.reason
+        if (f.code not in META_CODES and sup is not None and sup.reason
                 and f.code in sup.codes):
+            used.add(id(sup))
             suppressed.append(dataclasses.replace(
                 f, suppressed=True, suppress_reason=sup.reason))
         else:
             active.append(f)
+    # Stale suppressions: a disable comment that no longer suppresses
+    # anything must not rot silently — it reads as "this line has a
+    # waived finding" when nothing is waived (satellite of ISSUE 19).
+    if stale_prefix is not None:
+        for sf in project.files:
+            for line, sup in sorted(sf.suppressions.items()):
+                if (sup.reason and id(sup) not in used and sup.codes and
+                        all(c.startswith(stale_prefix) and
+                            c not in META_CODES for c in sup.codes)):
+                    active.append(Finding(
+                        meta_code,
+                        "stale suppression: "
+                        f"{','.join(sup.codes)} no longer fires here — "
+                        "delete the comment (or re-point it at the code "
+                        "that actually fires)", sf.relpath, line))
     return Report(active, suppressed, len(project.files))
 
 
@@ -478,7 +520,7 @@ def run_analysis(roots: Sequence[str], *, base: Optional[str] = None,
     by_rel = {sf.relpath: sf.abspath for sf in files}
 
     def keep(f: Finding) -> bool:
-        if select is not None and f.code != META_CODE and \
+        if select is not None and f.code not in META_CODES and \
                 f.code not in select:
             return False
         if only_paths is not None and by_rel.get(f.path) not in only_paths:

@@ -59,8 +59,13 @@ def _build() -> Optional[Path]:
         cmd = [cxx, "-O3", "-shared", "-fPIC", "-std=c++17",
                str(_SRC), "-o", tmp]
         try:
+            # Serializing the one-time native build is this module
+            # lock's entire job: concurrent importers must wait for one
+            # .so, not race multiple compilers over the same cache path.
+            # graftlint: disable=GC203 (build serialization is the lock's purpose)
             proc = subprocess.run(cmd, capture_output=True, timeout=120)
             if proc.returncode == 0:
+                # graftlint: disable=GC204 (atomic .so publish under the build lock)
                 os.replace(tmp, out)
                 return out
             last_err = proc.stderr.decode(errors="replace")[-500:]

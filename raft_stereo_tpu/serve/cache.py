@@ -665,8 +665,14 @@ class ResponseCache:
             }
             if entry.flow is not None:
                 payload["flow"] = entry.flow
+            # The spill write IS the cache's disk tier doing its job;
+            # bounce-path deposits (watchdog resolving scheduled rows
+            # under _check_lock) accept the bounded write — _check_lock
+            # serializes sweeps only, never the serving path.
+            # graftlint: disable=GC204 (disk-tier spill; watchdog sweep tolerates bounded IO)
             with open(tmp, "wb") as f:
                 np.savez(f, **payload)
+            # graftlint: disable=GC204 (atomic publish of the same spill)
             os.replace(tmp, path)
         except OSError:
             logger.warning("cache spill to %s failed", path,

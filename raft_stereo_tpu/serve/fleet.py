@@ -265,6 +265,10 @@ class FleetInstance:
             self.argv, stdout=subprocess.PIPE,
             stderr=subprocess.DEVNULL, text=True, env=self.env,
             start_new_session=True)
+        # Reads the child's stdout until the pipe dies with the
+        # process: reap()/kill() end it by killing the child, and
+        # joining a reader blocked on a live pipe would hang forever.
+        # graftlint: disable=GC206 (reader ends when reap/kill closes the pipe)
         self._reader = threading.Thread(
             target=self._drain_stdout, name=f"fleet-stdout-{self.uid}",
             daemon=True)
@@ -296,6 +300,11 @@ class FleetInstance:
         so a crash costs one poll interval, not the full warmup grace)."""
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
+            # deploy() reaches this wait while holding _deploy_lock on
+            # purpose: warmup is part of the one-rollout-at-a-time
+            # critical section, and _deploy_lock is never taken on the
+            # serving path.
+            # graftlint: disable=GC203 (warmup wait inside the one-deploy-at-a-time mutex)
             if self.ready.wait(timeout=0.05):
                 self.state = "ready"
                 return True
@@ -415,6 +424,10 @@ class FleetInstance:
             except OSError:
                 pass
             try:
+                # Reaping a killed child under _deploy_lock is the
+                # rollout's own cleanup; the serving path never waits on
+                # this lock.
+                # graftlint: disable=GC203 (bounded reap inside the deploy mutex)
                 self.proc.wait(timeout=30)
             except subprocess.TimeoutExpired:
                 pass
@@ -603,6 +616,11 @@ class FleetSupervisor:
                 # Linear backoff, attempt-scaled: enough to let a
                 # transient (port exhaustion, OOM reclaim) clear, short
                 # enough that tests with a ~0 base stay fast.
+                # deploy() holds _deploy_lock across the whole rollout
+                # BY DESIGN — one deploy at a time; backoff inside it
+                # only delays that deploy, and the serving plane's
+                # _lock is NOT held across this sleep.
+                # graftlint: disable=GC203 (backoff under the one-deploy-at-a-time mutex only)
                 time.sleep(self.cfg.restart_backoff_s * (spent + 1))
             first = False
             with self._lock:
@@ -921,6 +939,11 @@ class FleetSupervisor:
                 if inst in self._retired:
                     self._retired.remove(inst)
 
+        # Bounded fire-and-forget: _reap ends within drain_grace_s by
+        # construction — reap() escalates to SIGKILL at the deadline —
+        # and stop()'s sweep re-reaps anything still in _retired, so no
+        # reap thread outlives the supervisor.
+        # graftlint: disable=GC206 (bounded by drain_grace_s; stop() re-reaps _retired)
         threading.Thread(target=_reap, name=f"fleet-reap-{inst.uid}",
                          daemon=True).start()
 

@@ -170,6 +170,11 @@ class _Uploader:
         self.dead: Optional[BaseException] = None
         self.busy_since: Optional[float] = None
         self._q: "queue.Queue[Optional[_Row]]" = queue.Queue()
+        # stop() is the queue's None sentinel — the loop exits after
+        # draining, and the generation watchdog owns replacement;
+        # joining would park stop() behind a possibly-wedged device
+        # upload, the exact hang the watchdog exists to break.
+        # graftlint: disable=GC206 (sentinel stop; watchdog owns a wedged uploader)
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="stereo-uploader")
         self._thread.start()

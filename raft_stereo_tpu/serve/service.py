@@ -809,6 +809,10 @@ class StereoService:
                     return True
             if _time.monotonic() >= deadline:
                 return False
+            # Re-admitting stranded rows during a bounce polls a full
+            # queue in 10 ms beats; the bounce already owns _check_lock
+            # (one recovery at a time) and serving never waits on it.
+            # graftlint: disable=GC203 (bounded requeue poll inside the one-bounce-at-a-time sweep)
             _time.sleep(0.01)
 
     def _force_resolve(self, request: Dict, fut=None, *,
@@ -1193,6 +1197,10 @@ class StereoService:
         # REAL device hang won't join — harvest anyway; its eventual
         # wake discards behind the scheduler's ``defunct`` checks.
         for t in old_threads:
+            # Joining the dead generation's threads IS the bounce; it
+            # runs under _check_lock because exactly one recovery may
+            # touch generation state at a time, and the join is bounded.
+            # graftlint: disable=GC203 (bounded generation join inside the serialized bounce)
             t.join(timeout=5.0)
         self._zombies.extend(t for t in old_threads if t.is_alive())
         # graftpod: a device_hang bounce on a live mesh probes every

@@ -792,6 +792,11 @@ class InferenceSession:
             threads.append((i, t))
         deadline = self.clock.now() + timeout_s
         for i, t in threads:
+            # Chip probes are deadline-bounded and run from the bounce
+            # path (under _check_lock by design: one recovery at a
+            # time); a wedged probe thread is exactly what the deadline
+            # caps.
+            # graftlint: disable=GC203 (deadline-capped probe join on the serialized bounce path)
             t.join(timeout=max(0.05, deadline - self.clock.now()))
         return tuple(i for i, t in threads
                      if t.is_alive() or not done.get(i, False))

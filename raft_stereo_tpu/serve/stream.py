@@ -232,7 +232,7 @@ class StreamManager:
 
     # -- table maintenance (caller holds self._lock) -----------------------
 
-    def _drop(self, key: Tuple[str, str]) -> None:
+    def _drop_locked(self, key: Tuple[str, str]) -> None:
         sess = self._table.pop(key, None)
         if sess is None:
             return
@@ -242,15 +242,16 @@ class StreamManager:
         else:
             self._per_tenant[sess.tenant] = n
 
-    def _sweep(self, now: float) -> None:
+    def _sweep_locked(self, now: float) -> None:
         expired = [k for k, s in self._table.items()
                    if now - s.last_seen > self.ttl_s]
         for k in expired:
-            self._drop(k)
+            self._drop_locked(k)
         if expired:
             self._c_expired.inc(len(expired))
 
-    def _create(self, key: Tuple[str, str], now: float) -> StreamSession:
+    def _create_locked(self, key: Tuple[str, str], now: float
+                       ) -> StreamSession:
         tenant = key[0]
         # Per-tenant cap first (a tenant at its own cap must not push
         # OTHER tenants' sessions out), then the global cap: both evict
@@ -261,11 +262,11 @@ class StreamManager:
             victim = next((k for k, s in self._table.items()
                            if s.tenant == tenant), None)
             if victim is not None:
-                self._drop(victim)
+                self._drop_locked(victim)
                 self._c_evicted.inc()
         while len(self._table) >= self.max_sessions:
             victim = next(iter(self._table))
-            self._drop(victim)
+            self._drop_locked(victim)
             self._c_evicted.inc()
         # Chip-affinity placement (graftpod): spread new sessions round-
         # robin over the mesh's data shards; None off-mesh so the stamp
@@ -280,17 +281,17 @@ class StreamManager:
         self._c_created.inc()
         return sess
 
-    def _touch(self, key: Tuple[str, str], now: float) -> StreamSession:
+    def _touch_locked(self, key: Tuple[str, str], now: float) -> StreamSession:
         # Caller holds self._lock (like every mutator here: the table
         # and the per-tenant counts are mutated ONLY in these lock-held
         # helpers).
         sess = self._table.get(key)
         if sess is None:
-            return self._create(key, now)
+            return self._create_locked(key, now)
         self._table.move_to_end(key)
         return sess
 
-    def _clear(self) -> int:
+    def _clear_locked(self) -> int:
         # Caller holds self._lock.
         n = len(self._table)
         self._table.clear()
@@ -349,8 +350,8 @@ class StreamManager:
         padded = self.session.padder_for(
             request["left"].shape).padded_shape
         with self._lock:
-            self._sweep(now)
-            sess = self._touch(key, now)
+            self._sweep_locked(now)
+            sess = self._touch_locked(key, now)
             sess.last_seen = now
             sess.frames += 1
             request["_stream"] = key
@@ -380,7 +381,7 @@ class StreamManager:
             return
         now = self.session.clock.now()
         with self._lock:
-            self._sweep(now)
+            self._sweep_locked(now)
             # The sweep may have dropped sessions: refresh the gauge
             # here too, or it reads stale-high until the next admit.
             self._g_sessions.set(len(self._table))
@@ -455,7 +456,7 @@ class StreamManager:
         freed, gauge zeroed).  In-flight deposits after this land as
         counted drops."""
         with self._lock:
-            n = self._clear()
+            n = self._clear_locked()
             self._g_sessions.set(0)
         return n
 

@@ -335,6 +335,11 @@ class Supervisor:
         if not self._check_lock.acquire(blocking=False):
             return []
         try:
+            # _check_lock IS held here — taken by the non-blocking
+            # acquire above; try/finally instead of `with` is what lets
+            # concurrent sweeps skip instead of queueing (graftlock
+            # models the finally-release region as held, so this needs
+            # no suppression).
             return self._check_locked()
         finally:
             self._check_lock.release()

@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Lock-order witness gate (graftlock runtime half, DESIGN.md r23).
+
+Re-runs the chaos serve soak (scratch/chaos_serve.py main(), the same
+seeded fault storm the gate's soak step runs) with every
+``threading.Lock``/``RLock`` minted inside raft_stereo_tpu/ wrapped by
+the :class:`LockWitness`, then asserts every OBSERVED nested
+acquisition maps to an edge of the static lock-order graph — the graph
+``LOCK_ORDER.md`` is rendered from.  A violation here means the static
+model missed a real runtime ordering (or the code acquires against the
+manifest), which is exactly the gap a lock-order manifest must not
+have.
+
+The soak must itself pass: a witness run over a crashed battery proves
+nothing.  One JSON verdict line on stdout; exit 0 iff the soak passed
+AND no unexplained edges.
+
+Knobs: RAFT_WITNESS_N (requests for this re-run, default 80 — smaller
+than the soak step's 200; the lock topology saturates within the first
+few dozen requests) plus chaos_serve.py's own RAFT_CHAOS_* envs.
+"""
+
+import io
+import json
+import os
+import sys
+from contextlib import redirect_stdout
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _HERE)
+sys.path.insert(0, os.path.dirname(_HERE))
+
+
+def main() -> int:
+    os.environ.setdefault("RAFT_CHAOS_N",
+                          os.environ.get("RAFT_WITNESS_N", "80"))
+    from raft_stereo_tpu.analysis.concurrency.witness import (
+        LockWitness, package_model, unexplained_edges)
+
+    # The soak imports jax/serve lazily inside main(), so arming the
+    # witness FIRST wraps every serving-plane lock.  jax-internal locks
+    # minted under a repo frame resolve to no declaration and are
+    # skipped at check time.
+    import chaos_serve
+    soak_out = io.StringIO()
+    with LockWitness() as witness:
+        try:
+            with redirect_stdout(soak_out):
+                soak_rc = chaos_serve.main()
+        except BaseException as e:  # noqa: BLE001 - verdict must emit
+            soak_rc = 98
+            print(f"witness: soak raised {type(e).__name__}: {e}",
+                  file=sys.stderr)
+
+    model = package_model()
+    violations = unexplained_edges(witness, model)
+    mapped = sum(1 for s, d in witness.edges
+                 if model.decl_at(*s) is not None
+                 and model.decl_at(*d) is not None)
+    verdict = {
+        "witness_ok": soak_rc == 0 and not violations,
+        "soak_rc": soak_rc,
+        "observed_edges": len(witness.edges),
+        "mapped_edges": mapped,
+        "violations": violations,
+    }
+    try:
+        verdict["soak"] = json.loads(
+            soak_out.getvalue().strip().splitlines()[-1])
+    except (IndexError, ValueError):
+        verdict["soak"] = soak_out.getvalue()[-2000:]
+    print(json.dumps(verdict, indent=2, sort_keys=True))
+    return 0 if verdict["witness_ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

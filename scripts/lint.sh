@@ -9,6 +9,11 @@
 #   bash scripts/lint.sh --trace         # + graftverify (GV101-GV105):
 #                                        # trace-level jaxpr/HLO analysis,
 #                                        # ~40 s on CPU (DESIGN.md r10)
+#   bash scripts/lint.sh --concurrency   # + graftlock (GC201-GC206):
+#                                        # lock-order graph vs LOCK_ORDER.md,
+#                                        # Future lifecycle, sinks under
+#                                        # locks (DESIGN.md r23; still AST
+#                                        # only — no jax, milliseconds)
 #   bash scripts/lint.sh <paths...>      # explicit targets (tests use this
 #                                        # to prove an injected violation
 #                                        # fails the gate)

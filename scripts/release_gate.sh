@@ -34,6 +34,14 @@ rm -f "$RAFT_LEDGER"
 step "graftlint (zero unsuppressed findings)"
 bash scripts/lint.sh || { echo "FAIL: graftlint"; fail=1; }
 
+# graftlock (DESIGN.md "Concurrency contracts (r23)"): the concurrency
+# suite — whole-repo lock-order graph vs the committed LOCK_ORDER.md
+# manifest (drift is a finding), Future lifecycle on the serving paths,
+# blocking calls and IO sinks under held locks, _locked discipline,
+# thread join/stop coverage. Still AST-only: milliseconds, no jax.
+step "graftlock (lock-order manifest + concurrency contracts)"
+bash scripts/lint.sh --concurrency || { echo "FAIL: graftlock"; fail=1; }
+
 # graftverify second (DESIGN.md "Trace-level analysis (r10)"): traces the
 # real entry points at headline geometry on CPU (~40 s, no TPU touched)
 # and proves the jaxpr/HLO-level invariants the AST stage can only grep
@@ -79,6 +87,20 @@ if env JAX_PLATFORMS=cpu python scratch/chaos_serve.py > chaos_soak.json; then
 else
     echo "--- chaos_soak.json ---"; cat chaos_soak.json
     echo "FAIL: chaos soak"; fail=1
+fi
+
+# Runtime lock witness (ISSUE 19 acceptance, DESIGN.md r23): re-run the
+# chaos soak with every threading.Lock/RLock wrapped by the graftlock
+# witness — each OBSERVED nested acquisition must appear as an edge in
+# the static lock-order graph (observed ⊆ static, so the manifest and
+# the GC201 cycle check provably cover what the code actually does).
+# The soak's own invariants are asserted too; one JSON verdict line.
+step "lock witness (observed acquisition orders vs LOCK_ORDER.md)"
+if env JAX_PLATFORMS=cpu python scratch/check_witness.py > witness.json; then
+    cat witness.json
+else
+    echo "--- witness.json ---"; cat witness.json
+    echo "FAIL: lock witness"; fail=1
 fi
 
 # Wire chaos storm (ISSUE 10 acceptance, DESIGN.md r14): the same seeded

@@ -123,7 +123,11 @@ def test_wrong_code_suppression_does_not_apply(tmp_path):
         import os
         FLAG = os.environ.get("RAFT_THING", "1")  # graftlint: disable=GL002 (wrong code)
     """})
-    assert codes(rep) == ["GL001"]
+    # the GL002 suppression neither applies to the GL001 finding nor
+    # suppresses anything at all — which makes it STALE, and a stale
+    # suppression is itself a meta finding (dead suppressions rot into
+    # false confidence; GL000 names them so they get deleted)
+    assert codes(rep) == ["GL000", "GL001"]
 
 
 def test_suppression_without_reason_is_gl000(tmp_path):
@@ -1073,6 +1077,28 @@ def test_lint_sh_clean_and_injected_violation(tmp_path):
 def test_release_gate_runs_lint_step():
     gate = (REPO / "scripts" / "release_gate.sh").read_text()
     assert "lint.sh" in gate and "graftlint" in gate
+
+
+def test_suite_inventory_pinned():
+    """The three-suite inventory: GL 6 + GV 5 + GC 6, unique codes, all
+    selectable.  A checker added or dropped without updating this pin
+    (and the docs that enumerate the suites) fails here by name."""
+    from raft_stereo_tpu.analysis.checkers import ALL_CHECKERS
+    from raft_stereo_tpu.analysis.trace.checkers import ALL_TRACE_CHECKERS
+    from raft_stereo_tpu.analysis.concurrency.checkers import \
+        ALL_CONCURRENCY_CHECKERS
+    gl = [c.code for c in ALL_CHECKERS]
+    gv = [c.code for c in ALL_TRACE_CHECKERS]
+    gc = [c.code for c in ALL_CONCURRENCY_CHECKERS]
+    assert gl == [f"GL00{i}" for i in range(1, 7)]
+    assert gv == [f"GV10{i}" for i in range(1, 6)]
+    assert gc == [f"GC20{i}" for i in range(1, 7)]
+    all_codes = gl + gv + gc
+    assert len(all_codes) == len(set(all_codes)) == 17
+    res = _run_cli(["--list-checkers"])
+    assert res.returncode == 0
+    for code in all_codes:
+        assert code in res.stdout
 
 
 def test_gl002_real_tree_fleet_knob_registered():
