@@ -392,6 +392,23 @@ def main() -> None:
     corr_dma_doc = {"bench": corr_dma(h, w),
                     "headline": corr_dma(2016, 2976)}
 
+    # r24 context-lane accounting, same ledger discipline: the
+    # per-iteration czrq bytes the GRU scan-body BlockSpecs declare, bf16
+    # vs the width-group int8 containers (exact arithmetic — grid revisit
+    # factors cancel in the ratio; <= 0.6 is the acceptance bound,
+    # asserted at headline AND serve geometry by check_engagement.py).
+    def lane_dma(hh, ww):
+        from raft_stereo_tpu.ops.pallas_stream import plan_lane_dma_bytes
+        bf16_b = plan_lane_dma_bytes(hh, ww, pack8=False)
+        int8_b = plan_lane_dma_bytes(hh, ww, pack8=True)
+        return {"h": hh, "w": ww,
+                "bf16_bytes_per_iter": bf16_b,
+                "int8_bytes_per_iter": int8_b,
+                "int8_over_bf16": round(int8_b / bf16_b, 4)}
+
+    lane_dma_doc = {"bench": lane_dma(h, w),
+                    "headline": lane_dma(2016, 2976)}
+
     doc = {
         "metric": (f"middlebury_F_disparity_fps_per_chip_{iters}iters_"
                    f"{h}x{w}_{corr}_{'bf16' if mixed else 'fp32'}"
@@ -408,6 +425,7 @@ def main() -> None:
         "roofline": row.roofline(peaks),
         "bytes": row.bytes_accessed,
         "corr_dma": corr_dma_doc,
+        "lane_dma": lane_dma_doc,
     }
     print(json.dumps(doc))
 
@@ -425,7 +443,8 @@ def main() -> None:
          extra={"mfu": doc["mfu"], "device_s": doc["device_s"],
                 "flops": flops, "bytes": row.bytes_accessed,
                 "roofline": doc["roofline"],
-                "corr_dma": corr_dma_doc})
+                "corr_dma": corr_dma_doc,
+                "lane_dma": lane_dma_doc})
 
 
 if __name__ == "__main__":

@@ -39,6 +39,13 @@ ENV_KNOBS: Tuple[str, ...] = (
     "RAFT_STREAM_BATCH",       # B>1 engagement of the streamed scan-body
                                # kernels (ops/pallas_stream.py, default on;
                                # crossover from stream_batch_crossover)
+    "RAFT_LANE_PACK8",         # r24 narrow-lane context streams: int8
+                               # width-group containers for the
+                               # iteration-invariant context/fmap state +
+                               # the in-kernel czrq lane (corr/pallas_reg,
+                               # ops/pallas_{stream,resident,encoder},
+                               # models/raft_stereo.py; default OFF —
+                               # canary-banded like RAFT_CORR_PACK8)
 )
 
 # Serving-behavior env knobs (continuous batching, DESIGN.md r9). These are
@@ -285,10 +292,11 @@ class KernelEntry:
 # and that each rung's env switch is actually consulted by the module.
 KERNEL_ENTRY_POINTS = {
     "ops/pallas_encoder.py": KernelEntry(
-        rungs=("fused_encoders", "stream_tail")),
+        rungs=("fused_encoders", "stream_tail", "lane_pack8")),
     "ops/pallas_stream.py": KernelEntry(
-        rungs=("fuse_gru1632", "fused_update", "stream_batch")),
-    "ops/pallas_resident.py": KernelEntry(rungs=("fuse_iter",)),
+        rungs=("fuse_gru1632", "fused_update", "stream_batch",
+               "lane_pack8")),
+    "ops/pallas_resident.py": KernelEntry(rungs=("fuse_iter", "lane_pack8")),
     "corr/pallas_reg.py": KernelEntry(rungs=("corr_kernel", "corr_pack8")),
     "corr/pallas_alt.py": KernelEntry(rungs=("corr_kernel",)),
 }

@@ -76,6 +76,9 @@ KNOB_FLIP_PROBES: Dict[str, KnobProbe] = {
     "RAFT_FUSE_ITER": KnobProbe("0"),            # default on -> off
     "RAFT_CORR_PACK8": KnobProbe("1"),           # default OFF -> on
     "RAFT_STREAM_BATCH": KnobProbe("0", kind="advance", batch=2),
+    # r24: packed context lanes bite the B=1 full forward (the fake-quant
+    # inp/fmap roundtrip plus the packed-czrq gru kernels).
+    "RAFT_LANE_PACK8": KnobProbe("1"),           # default OFF -> on
 }
 
 GEOMETRIES: Dict[str, Dict[str, int]] = {
@@ -199,14 +202,22 @@ def default_registry(geometry: str = "headline") -> TraceRegistry:
             jax.random.PRNGKey(0))
 
     @functools.lru_cache(maxsize=None)
-    def state_spec(batch: int = 1):
-        # The refinement carry's structure, from the same prepare program
-        # serving compiles (shape-only — eval_shape executes nothing).
+    def _state_spec(batch: int, lane8: str):
         prep = build_program("prepare", cfg_serve, 0)
         bimg = jax.ShapeDtypeStruct((batch, g["h"], g["w"], 3),
                                     jnp.float32)
         (state,) = jax.eval_shape(prep, params_spec(), bimg, bimg)
         return state
+
+    def state_spec(batch: int = 1):
+        # The refinement carry's structure, from the same prepare program
+        # serving compiles (shape-only — eval_shape executes nothing).
+        # The structure depends on RAFT_LANE_PACK8 (r24: packed context
+        # containers ride the carry pytree), and builds run inside each
+        # entry's env-override window — re-key the cache on the live
+        # switch so an armed ladder trace never reuses a baseline spec.
+        import os
+        return _state_spec(batch, os.environ.get("RAFT_LANE_PACK8", ""))
 
     def serve_entry(name: str, kind: str, iters: int, *,
                     carry_input: bool) -> TraceEntry:
@@ -266,11 +277,12 @@ def default_registry(geometry: str = "headline") -> TraceRegistry:
         # etc.: the advance program has no encoder half). One combined
         # jaxpr gives every rung a program text it provably changes, and
         # GV102's pairwise comparison logic applies unchanged. The walk's
-        # base env additionally ARMS the opt-in corr_pack8 path
-        # (RAFT_CORR_PACK8=1): an opt-in rung can only be non-vacuous
-        # from an armed base — which is exactly the operational state the
-        # rung exists to degrade from.
-        ladder_base = resolve_env({"RAFT_CORR_PACK8": "1"}, base_env)
+        # base env additionally ARMS the opt-in pack paths
+        # (RAFT_CORR_PACK8=1, RAFT_LANE_PACK8=1): an opt-in rung can only
+        # be non-vacuous from an armed base — which is exactly the
+        # operational state the rung exists to degrade from.
+        ladder_base = resolve_env({"RAFT_CORR_PACK8": "1",
+                                   "RAFT_LANE_PACK8": "1"}, base_env)
 
         def ladder_build(run_cfg):
             def build(run_cfg=run_cfg):

@@ -426,6 +426,107 @@ def plan_dma_bytes(widths: Sequence[int], bf16: bool, pack8: bool
     return total
 
 
+# ---------------------------------------------------------------------------
+# Narrow-lane FEATURE containers (r24, RAFT_LANE_PACK8): the corr pyramid's
+# quad-pack seam (above) generalized to the iteration-invariant context /
+# feature tensors the GRU scan re-reads every iteration. Layout is
+# WIDTH-GROUP, not channel-group: a (..., W, C) tensor packs to
+# (..., ceil(W/4), C) fp32 containers where byte b of lane column j holds
+# width position ``b * ceil(W/4) + j``. Keeping the minor (lane) axis at the
+# original channel count means the container tiles HBM exactly like the
+# bf16 tensor it replaces (C = 128-multiples stay 128-multiples), so the
+# declared DMA ratio is ~0.5 instead of the ~0.67 a channel-group layout
+# pays to lane padding — and the in-kernel unpack is four sign-extending
+# byte extracts concatenated on the SUBLANE axis (no minor-dim reshape).
+# ---------------------------------------------------------------------------
+
+
+def lane_pack8() -> bool:
+    """``RAFT_LANE_PACK8=1`` quantizes the iteration-invariant context
+    streams (the three-scale ``inp`` czrq tensors and the fmap operands the
+    state pytree carries) into width-group int8 containers — halving the
+    per-iteration context DMA the same way RAFT_CORR_PACK8 halved the
+    pyramid's (r24). Read at trace time and registered in ENV_KNOBS so
+    serving programs key on it; default OFF: canary-banded (dequant error
+    ``scale/2`` per element, pinned in tests/test_lane_pack8.py), not
+    bit-identical, so an operator opts in."""
+    return os.environ.get("RAFT_LANE_PACK8", "0").strip().lower() in (
+        "1", "true", "yes", "on")
+
+
+def feature_scale8(x: jax.Array) -> jax.Array:
+    """PER-SAMPLE symmetric dequant scale ``max|v| / 127`` over every
+    non-batch axis of a (B, ...) feature tensor, keepdims (so (B, 1, 1, 1)
+    for the 4D activations), floored away from zero. Per-sample for the
+    same reason as :func:`level_scale8`: a whole-batch amax would let one
+    sample's content set a batchmate's quantization grid, breaking the
+    batched-rows == B=1 invariant (regression-pinned in
+    tests/test_lane_pack8.py)."""
+    axes = tuple(range(1, x.ndim))
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axes, keepdims=True)
+    return jnp.maximum(amax, 1e-30) / 127.0
+
+
+def _qfeat8_impl(x: jax.Array, scale: jax.Array) -> jax.Array:
+    w = x.shape[-2]
+    wq = -(-w // 4)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale),
+                 -127.0, 127.0).astype(jnp.int32)
+    if 4 * wq != w:
+        pad = [(0, 0)] * x.ndim
+        pad[-2] = (0, 4 * wq - w)
+        q = jnp.pad(q, pad)  # symmetric: zero pad rows quantize to q == 0
+    ax = x.ndim - 2
+    qs = [jax.lax.slice_in_dim(q, b * wq, (b + 1) * wq, axis=ax)
+          for b in range(4)]
+    packed = ((qs[0] & 0xFF) | ((qs[1] & 0xFF) << 8)
+              | ((qs[2] & 0xFF) << 16) | ((qs[3] & 0xFF) << 24))
+    return jax.lax.bitcast_convert_type(packed, jnp.float32)
+
+
+@jax.custom_vjp
+def quantize_pack_feature8(x: jax.Array, scale: jax.Array) -> jax.Array:
+    """(..., W, C) float activations -> (..., ceil(W/4), C) fp32 width-group
+    int8 containers: ``q = clip(round(v / scale), -127, 127)``. Zero pad
+    rows/columns quantize to exact zero bytes (symmetric grid), so the
+    czrq row padding ``prepare_gru_context`` applies survives packing
+    bit-exactly. Like :func:`quantize_pack_rows8` the container is an
+    opaque bit transport with zero cotangent — the straight-through
+    gradient flows through the unpacked ``context`` operand the fused ops
+    carry alongside it."""
+    return _qfeat8_impl(x, scale)
+
+
+def _qfeat8_fwd(x, scale):
+    return quantize_pack_feature8(x, scale), (
+        x.shape, x.dtype, scale.shape, scale.dtype)
+
+
+def _qfeat8_bwd(res, g):
+    # Bit container: zero cotangent for the activation AND its scale, in
+    # the operands' own shapes/dtypes (unlike _qpack8_bwd this seam packs
+    # arbitrary-rank feature tensors, so nothing is hardcoded).
+    x_shape, x_dtype, s_shape, s_dtype = res
+    del g
+    return jnp.zeros(x_shape, x_dtype), jnp.zeros(s_shape, s_dtype)
+
+
+quantize_pack_feature8.defvjp(_qfeat8_fwd, _qfeat8_bwd)
+
+
+def unpack_feature8(pk: jax.Array, scale: jax.Array, width: int) -> jax.Array:
+    """(..., Wq, C) container -> (..., width, C) fp32 dequantized rows —
+    the pack inverse modulo quantization: four ARITHMETIC-shift byte
+    extracts (sign-extending, the gather_lerp_taps_packed8 idiom)
+    concatenated on the width axis, sliced to the true width, times the
+    broadcastable dequant scale."""
+    gi = jax.lax.bitcast_convert_type(pk, jnp.int32)
+    parts = [(gi << 24) >> 24, (gi << 16) >> 24, (gi << 8) >> 24, gi >> 24]
+    q = jnp.concatenate(parts, axis=-2)
+    q = jax.lax.slice_in_dim(q, 0, width, axis=pk.ndim - 2)
+    return q.astype(jnp.float32) * scale
+
+
 PACK_ALIGN = 2 * LANE  # bf16 row width multiple that packs to whole vregs
 
 

@@ -198,7 +198,14 @@ def apply_multi_basic_encoder(p: Params, x: jax.Array, *, norm_fn: str,
     # Only the finest (1/4-res) heads stream: they carry ~16x the pixels
     # of outputs16/32, whose XLA convs are already cheap — and each
     # streamed pass is one more Mosaic kernel in an already
-    # compile-time-bound program.
+    # compile-time-bound program. The r24 quantize-on-exit epilogues
+    # (stream_head_conv_q8 / stream_resblock_q8) are NOT wired at these
+    # heads either: the tensors that ride as packed containers are the
+    # zqr gate levels, produced at raft_stereo._packed_context_level
+    # (which picks the q8 epilogue per-geometry and host-packs
+    # bitwise-identically otherwise), while apply_basic_encoder's fmap
+    # tail ends in a 1x1 conv — the wrong seam for a width-group
+    # packing epilogue, so fmaps pack host-side in raft_stereo_prepare.
     outputs08 = [head(h, x, streamed=fused) for h in p["outputs08"]]
     if num_layers == 1:
         return (outputs08, v) if dual_inp else (outputs08,)

@@ -41,6 +41,62 @@ from raft_stereo_tpu.ops.upsample import convex_upsample
 FNET_SEQUENTIAL_MIN_PIXELS = 1 << 21
 
 
+# -- narrow-lane (r24, RAFT_LANE_PACK8) state containers ---------------------
+# The iteration-invariant tensors the serving carry re-reads — the
+# three-scale post-zqr context and the fmap pair the corr volume rebuilds
+# from — ride the state pytree as width-group int8 container dicts
+# ``{"pk", "scale"}`` (corr/pallas_reg.py seam) instead of bf16 planes.
+# ``net`` deliberately stays bf16: it is MUTATED every iteration, so a
+# container would pay quantize+dequantize per step for zero reuse.
+# Engagement is inference-only (test-mode forward / prepare / advance;
+# the spatial-shard path is excluded) and the test-mode forward
+# fake-quantizes through the SAME helpers, so forward == prepare+advance
+# stays bitwise by construction.
+
+
+def _lane_pack_feature(x: jax.Array) -> dict:
+    """(B, H, W, C) activation -> {"pk": (B, H, ceil(W/4), C) fp32
+    container, "scale": (B, 1, 1, 1) fp32 per-sample dequant scale}."""
+    from raft_stereo_tpu.corr.pallas_reg import (feature_scale8,
+                                                 quantize_pack_feature8)
+    scale = feature_scale8(x)
+    return {"pk": quantize_pack_feature8(x, scale), "scale": scale}
+
+
+def _lane_unpack_feature(packed: dict, width: int, dtype) -> jax.Array:
+    """Container dict -> (B, H, width, C) activation in ``dtype``."""
+    from raft_stereo_tpu.corr.pallas_reg import unpack_feature8
+    return unpack_feature8(packed["pk"], packed["scale"],
+                           width).astype(dtype)
+
+
+def _is_lane_packed(leaf) -> bool:
+    """STRUCTURAL packed-container detection — the advance path keys on
+    what the carry actually holds, not on the env knob at trace time, so
+    a breaker trip or ladder walk that flips RAFT_LANE_PACK8 between
+    prepare and advance still dequantizes (or passes through) correctly."""
+    return isinstance(leaf, dict) and "pk" in leaf
+
+
+def _packed_context_level(conv: dict, x: jax.Array, dtype) -> dict:
+    """One zqr level as a packed container: the streamed quantize-on-exit
+    epilogue (ops/pallas_encoder.py, tentpole b) when the geometry
+    supports it, else a host-side pack of the SAME conv producer's output
+    — bitwise-identical bytes either way (the epilogue quantizes the
+    bf16-rounded rows with the same masked amax scale; pinned in
+    tests/test_lane_pack8.py), so the container contract never depends on
+    which branch ran."""
+    from raft_stereo_tpu.ops.pallas_encoder import (
+        head_conv_q8_streamable, head_conv_streamable, stream_head_conv,
+        stream_head_conv_q8)
+    if head_conv_q8_streamable(conv, x):
+        pk, scale = stream_head_conv_q8(conv, x)
+        return {"pk": pk, "scale": scale}
+    y = (stream_head_conv(conv, x) if head_conv_streamable(conv, x)
+         else apply_conv(conv, x, padding=1))
+    return _lane_pack_feature(y.astype(dtype))
+
+
 def init_raft_stereo(key: jax.Array, cfg: RAFTStereoConfig) -> Params:
     """Build the parameter pytree (reference ctor, ``core/raft_stereo.py:23-39``)."""
     ks = jax.random.split(key, 4 + cfg.n_gru_layers)
@@ -67,8 +123,15 @@ def init_raft_stereo(key: jax.Array, cfg: RAFTStereoConfig) -> Params:
 def _context_and_features(params: Params, cfg: RAFTStereoConfig,
                           image1: jax.Array, image2: jax.Array,
                           compute_dtype,
-                          fused: bool = True) -> Tuple[list, list, jax.Array, jax.Array]:
-    """Run context + feature networks (reference forward :76-88)."""
+                          fused: bool = True,
+                          pack_ctx: bool = False) -> Tuple[list, list, jax.Array, jax.Array]:
+    """Run context + feature networks (reference forward :76-88).
+
+    ``pack_ctx`` (RAFT_LANE_PACK8): return each post-zqr context level as
+    a packed ``{"pk", "scale"}`` container instead of a (z, r, q) triple —
+    the forward and the prepare half both route through this switch, so
+    the bytes the serving carry stores are the bytes the forward consumed.
+    """
     image1 = (2 * (image1 / 255.0) - 1.0).astype(compute_dtype)
     image2 = (2 * (image2 / 255.0) - 1.0).astype(compute_dtype)
 
@@ -112,9 +175,14 @@ def _context_and_features(params: Params, cfg: RAFTStereoConfig,
     net_list = [jnp.tanh(x[0]) for x in cnet_list]
     inp_list = [jax.nn.relu(x[1]) for x in cnet_list]
     # GRU gate biases from context, computed once outside the loop (:87-88).
-    inp_list = [
-        tuple(jnp.split(apply_conv(conv, i, padding=1), 3, axis=-1))
-        for i, conv in zip(inp_list, params["context_zqr_convs"])]
+    if pack_ctx:
+        inp_list = [
+            _packed_context_level(conv, i, compute_dtype)
+            for i, conv in zip(inp_list, params["context_zqr_convs"])]
+    else:
+        inp_list = [
+            tuple(jnp.split(apply_conv(conv, i, padding=1), 3, axis=-1))
+            for i, conv in zip(inp_list, params["context_zqr_convs"])]
     return net_list, inp_list, fmap1, fmap2
 
 
@@ -200,8 +268,15 @@ def _refinement_closures(params: Params, cfg: RAFTStereoConfig,
         # B>1 crossover (stream_batch_crossover) is an eval heuristic
         # (see gru_is_fusable).
         any_batch = not test_mode and cfg.fused_train
+        # Inference additionally packs the pre-folded czrq into an int8
+        # container when RAFT_LANE_PACK8 is armed (prepare_gru_context_any
+        # is a pass-through otherwise) — the per-iteration context stream
+        # is the largest unnarrowed lane. Train numerics are untouched.
+        from raft_stereo_tpu.ops.pallas_stream import prepare_gru_context_any
+        ctx_builder = (prepare_gru_context_any if test_mode
+                       else prepare_gru_context)
         fused_ctx = [
-            prepare_gru_context(
+            ctx_builder(
                 params["update_block"][("gru08", "gru16", "gru32")[i]],
                 inp[i], compute_dtype)
             if fuse and gru_is_fusable(net[i], any_batch=any_batch) else None
@@ -316,12 +391,28 @@ def raft_stereo_forward(params: Params, cfg: RAFTStereoConfig,
     their global instance-norm stats and full-H row streams do not cut).
     """
     compute_dtype = jnp.bfloat16 if cfg.mixed_precision else jnp.float32
+    from raft_stereo_tpu.corr.pallas_reg import lane_pack8
+    pack_ctx = test_mode and space_mesh is None and lane_pack8()
     net_list, inp_list, fmap1, fmap2 = _context_and_features(
         params, cfg, image1, image2, compute_dtype,
-        fused=cfg.fused_update and space_mesh is None)
+        fused=cfg.fused_update and space_mesh is None, pack_ctx=pack_ctx)
 
     net = tuple(x.astype(compute_dtype) for x in net_list)
-    inp = [tuple(c.astype(compute_dtype) for c in triple) for triple in inp_list]
+    if pack_ctx:
+        # Fake-quantize through the SAME containers the prepare half
+        # stores: the forward consumes the exact dequantized bytes the
+        # segment path will, so forward == prepare+segments stays bitwise
+        # under the knob (pinned by tests/test_lane_pack8.py).
+        inp = [tuple(jnp.split(
+            _lane_unpack_feature(lvl, n.shape[2], compute_dtype),
+            3, axis=-1)) for lvl, n in zip(inp_list, net)]
+        fmap1 = _lane_unpack_feature(
+            _lane_pack_feature(fmap1), fmap1.shape[2], fmap1.dtype)
+        fmap2 = _lane_unpack_feature(
+            _lane_pack_feature(fmap2), fmap2.shape[2], fmap2.dtype)
+    else:
+        inp = [tuple(c.astype(compute_dtype) for c in triple)
+               for triple in inp_list]
     coords0, one_iteration, upsampled, fused_engaged = _refinement_closures(
         params, cfg, net, inp, fmap1, fmap2, compute_dtype=compute_dtype,
         test_mode=test_mode, flow_init=flow_init, space_mesh=space_mesh)
@@ -415,12 +506,24 @@ def raft_stereo_prepare(params: Params, cfg: RAFTStereoConfig,
     the x-only construction rules out.
     """
     compute_dtype = jnp.bfloat16 if cfg.mixed_precision else jnp.float32
+    from raft_stereo_tpu.corr.pallas_reg import lane_pack8
+    pack_ctx = lane_pack8()
     net_list, inp_list, fmap1, fmap2 = _context_and_features(
-        params, cfg, image1, image2, compute_dtype, fused=cfg.fused_update)
+        params, cfg, image1, image2, compute_dtype, fused=cfg.fused_update,
+        pack_ctx=pack_ctx)
     net = tuple(x.astype(compute_dtype) for x in net_list)
-    inp = tuple(tuple(c.astype(compute_dtype) for c in triple)
-                for triple in inp_list)
     b, h, w, _ = fmap1.shape
+    if pack_ctx:
+        # Narrow-lane carry: context levels arrive packed from
+        # _context_and_features; the fmap pair packs here. Every leaf
+        # keeps its leading batch dim, so stack/take row composition is
+        # untouched.
+        inp = tuple(inp_list)
+        fmap1 = _lane_pack_feature(fmap1)
+        fmap2 = _lane_pack_feature(fmap2)
+    else:
+        inp = tuple(tuple(c.astype(compute_dtype) for c in triple)
+                    for triple in inp_list)
     coords1 = coords_grid(b, h, w)
     if flow_init is not None:
         coords1 = coords1 + flow_init
@@ -437,12 +540,28 @@ def _advance_carry(params: Params, cfg: RAFTStereoConfig, state, *,
     and runs :func:`raft_stereo_epilogue` only for the rows that exit)."""
     compute_dtype = jnp.bfloat16 if cfg.mixed_precision else jnp.float32
     net = tuple(state["net"])
-    inp = [tuple(triple) for triple in state["inp"]]
+    # Narrow-lane carries (RAFT_LANE_PACK8) hold packed containers;
+    # dequantize ONCE here, outside the scan. XLA keeps only what the
+    # scan body actually streams per iteration: the packed czrq container
+    # (prepare_gru_context_any re-packs from these dequantized values —
+    # bitwise the same container prepare built, pinned by the segment
+    # tests) and the once-per-segment corr-volume build.
+    inp = [
+        tuple(jnp.split(
+            _lane_unpack_feature(lvl, n.shape[2], compute_dtype),
+            3, axis=-1))
+        if _is_lane_packed(lvl) else tuple(lvl)
+        for lvl, n in zip(state["inp"], net)]
+    fmap1, fmap2 = state["fmap1"], state["fmap2"]
+    if _is_lane_packed(fmap1):
+        w8 = state["coords1"].shape[2]
+        fmap1 = _lane_unpack_feature(fmap1, w8, compute_dtype)
+        fmap2 = _lane_unpack_feature(fmap2, w8, compute_dtype)
     # flow_init only steers the fuse_motion flag here; the carried coords1
     # already contains any warm-start offset.
     fake_init = state["coords1"] if warm_start else None
     coords0, one_iteration, upsampled, _ = _refinement_closures(
-        params, cfg, net, inp, state["fmap1"], state["fmap2"],
+        params, cfg, net, inp, fmap1, fmap2,
         compute_dtype=compute_dtype, test_mode=True, flow_init=fake_init)
 
     def step(carry, _):
@@ -518,7 +637,10 @@ def raft_stereo_epilogue(params: Params, cfg: RAFTStereoConfig, state):
     bytes a segment ending at that carry would have. Returns
     ``(flow_low, flow_up)``.
     """
-    b, h, w, _ = state["fmap1"].shape
+    # coords1 carries the refinement geometry directly — state["fmap1"]
+    # may be a packed {"pk","scale"} container (RAFT_LANE_PACK8) whose
+    # width axis is the quad-packed ceil(W/4).
+    b, h, w = state["coords1"].shape[:3]
     coords0 = coords_grid(b, h, w)
     coords1 = state["coords1"]
     up_mask = apply_mask_head(params["update_block"], tuple(state["net"])[0])

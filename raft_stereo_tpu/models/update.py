@@ -208,9 +208,13 @@ def apply_update_block(p: Params, cfg: RAFTStereoConfig,
     FLOPs saved (the reference computes-and-discards it every iteration).
 
     ``fused_ctx``: per-level pre-folded context from
-    ``pallas_stream.prepare_gru_context`` (hoisted out of the scan);
+    ``pallas_stream.prepare_gru_context_any`` (hoisted out of the scan);
     non-None entries route that level through the streaming Pallas GRU
-    kernel. In the test-mode scan (``compute_mask=False``) the FlowHead is
+    kernel. Each entry is OPAQUE here: bf16 rows, or under
+    RAFT_LANE_PACK8 a ``(container, scale)`` pair the kernels
+    dequantize in-register (r24 narrow lanes) — this module never
+    inspects which, so the lane format can evolve behind the
+    ``prepare_gru_context_any`` seam. In the test-mode scan (``compute_mask=False``) the FlowHead is
     chained into the finest kernel and the x-delta comes back with it.
     ``space_mesh``: when the jit is sharded over a mesh ``space`` axis,
     non-None entries instead route through the halo-exchange shard_map

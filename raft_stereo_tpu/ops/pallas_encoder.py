@@ -290,13 +290,32 @@ def _run_stem(halves, w, bias, hh, wp_total, dtype, stats: bool):
 
 
 def _pass_kernel(*refs, kind: str, th: int, nb: int, nwb: int, wp: int,
-                 hh: int, stats: bool):
+                 hh: int, stats: bool, quant: bool = False):
     """Grid (nb+1, nwb+1), strips minor; all widths in packed columns.
     Step (i, s) lands input strip s of row block i into the full-width
     ring window, then convolves strip s-1 (whose right-halo column was
     just landed; the extra s=nwb step convolves the last strip, whose
-    right halo is image-edge zero pad)."""
-    i, s = pl.program_id(0), pl.program_id(1)
+    right halo is image-edge zero pad).
+
+    ``quant`` (RAFT_LANE_PACK8 quantize-on-exit, r24): the grid grows a
+    LEADING phase dim — (2, nb+1, nwb+1). Phase 0 runs the full pass but
+    only accumulates the row-masked fp32 amax of the bf16-ROUNDED
+    outputs; phase 1 re-runs it and emits width-group int8 containers
+    quantized with that global per-tensor scale, plus the (1, 1) scale
+    itself. Quantizing the ROUNDED values with the exact
+    ``max(amax, 1e-30)/127`` fp32 arithmetic of ``feature_scale8`` makes
+    the container bitwise identical to a host-side
+    ``quantize_pack_feature8`` of the streamed bf16 output — so the
+    geometry fallback in models/raft_stereo.py never changes a byte.
+    Requires nwb == 1 (the in-register pack needs the whole row in one
+    block) and wp % 4 == 0; stats never combines with quant."""
+    if quant:
+        assert not stats and nwb == 1 and wp % 4 == 0
+        ph = pl.program_id(0)
+        i, s = pl.program_id(1), pl.program_id(2)
+    else:
+        ph = None
+        i, s = pl.program_id(0), pl.program_id(1)
     k = 0
 
     def take(n):
@@ -313,10 +332,14 @@ def _pass_kernel(*refs, kind: str, th: int, nb: int, nwb: int, wp: int,
         (a_ref, ma_ref, va_ref, b2_ref, mb_ref, vb_ref) = take(6)
         (w_ref, b_ref) = take(2)
     out_ref = take(1)[0]
+    sc_ref = take(1)[0] if quant else None
     st_ref = take(1)[0] if stats else None
     scr_in, scr_prev = take(2)
+    scr_q = take(1)[0] if quant else None
     scr_st = take(1)[0] if stats else None
-    dtype = out_ref.dtype
+    # The streamed-chain storage dtype. The quant pass's out_ref holds
+    # fp32 bit containers, so it reads the dtype off the ring scratch.
+    dtype = scr_prev.dtype if quant else out_ref.dtype
 
     @pl.when((i == 0) & (s == 0))
     def _init():
@@ -324,6 +347,10 @@ def _pass_kernel(*refs, kind: str, th: int, nb: int, nwb: int, wp: int,
         _zeros(scr_prev)
         if stats:
             scr_st[...] = jnp.zeros(scr_st.shape, scr_st.dtype)
+        if quant:
+            @pl.when(ph == 0)
+            def _zq():
+                scr_q[...] = jnp.zeros(scr_q.shape, scr_q.dtype)
 
     @pl.when(s == 0)
     def _roll():
@@ -371,12 +398,33 @@ def _pass_kernel(*refs, kind: str, th: int, nb: int, nwb: int, wp: int,
         acc = _conv_rows(win, w_ref, th, wp)
         out = acc + b_ref[...].astype(jnp.float32)
         new = out.astype(dtype)
-        # Block-aligned emission: block i-1 = previous step's tail + this
-        # step's head (the conv lags one row); i=0 parks in the trash
-        # block.
-        out_ref[0:th - 1] = scr_prev[s - 1, 1:th]
-        out_ref[th - 1:th] = new[0:1]
-        scr_prev[s - 1] = new
+        if quant:
+            @pl.when(ph == 0)
+            def _amax():
+                # amax of the ROUNDED values, masked to real rows — the
+                # exact reduction feature_scale8 runs on the host.
+                m = jnp.max(jnp.abs(
+                    _row_mask(i, -1, th, hh, new.astype(jnp.float32))))
+                scr_q[0, 0] = jnp.maximum(scr_q[0, 0], m)
+
+            @pl.when(ph == 1)
+            def _emit():
+                # Assemble the SAME lagged block the plain pass emits,
+                # then quantize + width-group pack it in-register.
+                blk = jnp.concatenate(
+                    [scr_prev[s - 1, 1:th], new[0:1]], axis=0
+                ).astype(jnp.float32)
+                scale = jnp.maximum(scr_q[0, 0], 1e-30) / 127.0
+                out_ref[...] = _quant_pack_rows(blk, scale, wp)
+                sc_ref[0, 0] = scale
+            scr_prev[s - 1] = new
+        else:
+            # Block-aligned emission: block i-1 = previous step's tail +
+            # this step's head (the conv lags one row); i=0 parks in the
+            # trash block.
+            out_ref[0:th - 1] = scr_prev[s - 1, 1:th]
+            out_ref[th - 1:th] = new[0:1]
+            scr_prev[s - 1] = new
         if stats:
             # Rows outside [0, H) occur only at the first (row -1) and
             # flush (rows >= H) steps; interior steps skip the mask pass.
@@ -387,6 +435,14 @@ def _pass_kernel(*refs, kind: str, th: int, nb: int, nwb: int, wp: int,
             @pl.when((i == 0) | (i >= nb))
             def _st_edge():
                 _stats_update(scr_st, st_ref, _row_mask(i, -1, th, hh, out))
+
+
+def _pass_q8_kernel(*refs, **kw):
+    """Named entry point for the quantize-on-exit conv pass — thin wrapper
+    so the r24 containers' engagement is greppable in lowered jaxprs by
+    kernel NAME (the scratch/check_engagement.py contract), exactly like
+    the lane8 GRU wrappers in ops/pallas_stream.py."""
+    _pass_kernel(*refs, quant=True, **kw)
 
 
 def _point3_kernel(s_ref, ms_ref, vs_ref, y2_ref, m2_ref, v2_ref,
@@ -421,8 +477,57 @@ def _point2_kernel(x_ref, y_ref, m_ref, v_ref, out_ref, *, norm: bool):
     out_ref[...] = out.astype(out_ref.dtype)
 
 
+def _quant_pack_rows(blk: jax.Array, scale, wp: int):
+    """fp32 rows (th, wp, C) -> width-group int8 container (th, wp/4, C):
+    the in-register mirror of corr/pallas_reg.py's ``_qfeat8_impl`` —
+    identical clip/round/shift arithmetic, so kernel and host packs of
+    the same values are byte-equal."""
+    q = jnp.clip(jnp.round(blk / scale), -127.0, 127.0).astype(jnp.int32)
+    wq = wp // 4
+    packed = ((jax.lax.slice_in_dim(q, 0, wq, axis=1) & 0xFF)
+              | ((jax.lax.slice_in_dim(q, wq, 2 * wq, axis=1) & 0xFF) << 8)
+              | ((jax.lax.slice_in_dim(q, 2 * wq, 3 * wq, axis=1)
+                  & 0xFF) << 16)
+              | ((jax.lax.slice_in_dim(q, 3 * wq, 4 * wq, axis=1)
+                  & 0xFF) << 24))
+    return jax.lax.bitcast_convert_type(packed, jnp.float32)
+
+
+def _point2_q8_kernel(x_ref, y_ref, m_ref, v_ref, out_ref, sc_ref, scr_q, *,
+                      norm: bool, wp: int):
+    """point2 with the r24 quantize-on-exit epilogue: grid (2, nb, 1),
+    phase 0 accumulates the fp32 amax of the bf16-rounded exit, phase 1
+    re-runs the combine and emits the width-group container + scale.
+    point2 output is exact (no lag block), so no row masking is needed —
+    every computed row is real."""
+    ph, i, s = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    if norm:
+        out = jax.nn.relu(x_ref[...].astype(jnp.float32)
+                          + _normed(y_ref[...], m_ref[...], v_ref[...]))
+    else:
+        out = jax.nn.relu(x_ref[...].astype(jnp.float32)
+                          + jax.nn.relu(y_ref[...].astype(jnp.float32)))
+    new = out.astype(x_ref.dtype)
+
+    @pl.when((ph == 0) & (i == 0) & (s == 0))
+    def _zq():
+        scr_q[...] = jnp.zeros(scr_q.shape, scr_q.dtype)
+
+    @pl.when(ph == 0)
+    def _amax():
+        scr_q[0, 0] = jnp.maximum(
+            scr_q[0, 0], jnp.max(jnp.abs(new.astype(jnp.float32))))
+
+    @pl.when(ph == 1)
+    def _emit():
+        scale = jnp.maximum(scr_q[0, 0], 1e-30) / 127.0
+        out_ref[...] = _quant_pack_rows(
+            new.astype(jnp.float32), scale, wp)
+        sc_ref[0, 0] = scale
+
+
 def _run_pass(kind, inputs, w, bias, hh, wp_total, wp, dtype,
-              stats: bool, *, norm: bool = False):
+              stats: bool, *, norm: bool = False, quant: bool = False):
     """One streamed pass over (H?, wp_total, C) chain tensors — the
     parity-packed trunk layout (wp_total = W/2, C = 128) or the plain
     unpacked layout of the deeper stages (wp_total = W, C = 96/128).
@@ -437,25 +542,55 @@ def _run_pass(kind, inputs, w, bias, hh, wp_total, wp, dtype,
     ``stats`` = accumulate/emit per-channel stats (conv kinds only);
     ``norm`` = apply the computed instance norms in the point combines.
     They are SEPARATE flags on purpose: conflating them silently skipped
-    normalization on the instance trunk (the r4 point3 regression)."""
+    normalization on the instance trunk (the r4 point3 regression).
+
+    ``quant`` (r24): emit a width-group int8 container + (1, 1) scale
+    instead of the bf16 tensor — supported for the raw1 conv pass and
+    the point2 combine, single-strip (nwb == 1) wp % 4 == 0 geometry
+    only (see _pass_kernel). Returns ``(container, scale)``."""
     th = _enc_th(hh, wp)
     nb, nwb = hh // th, wp_total // wp
     point = kind in ("point2", "point3")
     ch_out = inputs[0][0].shape[-1] if point else w.shape[-1]
+    # quant adds a leading phase dim to the grid; index maps written in
+    # (i, s) get lifted to ignore it.
+    lift = ((lambda f: (lambda p, i, s: f(i, s))) if quant
+            else (lambda f: f))
 
     if point:
         in_specs, args = [], []
         for raw, m, v in inputs:
             in_specs.append(pl.BlockSpec((th, wp, raw.shape[-1]),
-                                         lambda i, s: (i, s, 0),
+                                         lift(lambda i, s: (i, s, 0)),
                                          memory_space=pltpu.VMEM))
             args.append(raw)
             for t in (m, v):
                 if t is None:
                     continue
-                in_specs.append(pl.BlockSpec(t.shape, lambda i, s: (0, 0),
+                in_specs.append(pl.BlockSpec(t.shape,
+                                             lift(lambda i, s: (0, 0)),
                                              memory_space=pltpu.VMEM))
                 args.append(t)
+        if quant:
+            assert kind == "point2" and nwb == 1 and wp % 4 == 0
+            return pl.pallas_call(
+                functools.partial(_point2_q8_kernel, norm=norm, wp=wp),
+                grid=(2, nb, nwb),
+                in_specs=in_specs,
+                out_specs=(
+                    pl.BlockSpec((th, wp // 4, ch_out),
+                                 lambda p, i, s: (i, s, 0),
+                                 memory_space=pltpu.VMEM),
+                    pl.BlockSpec((1, 1), lambda p, i, s: (0, 0),
+                                 memory_space=pltpu.VMEM)),
+                out_shape=(
+                    jax.ShapeDtypeStruct((hh, wp_total // 4, ch_out),
+                                         jnp.float32),
+                    jax.ShapeDtypeStruct((1, 1), jnp.float32)),
+                scratch_shapes=[pltpu.VMEM((1, 1), jnp.float32)],
+                compiler_params=compiler_params(vmem_limit_bytes=_ENC_VMEM),
+                interpret=_interpret(),
+            )(*args)
         pk = _point3_kernel if kind == "point3" else _point2_kernel
         return pl.pallas_call(
             functools.partial(pk, norm=norm),
@@ -474,21 +609,53 @@ def _run_pass(kind, inputs, w, bias, hh, wp_total, wp, dtype,
     ch_in = inputs[0][0].shape[-1]
     in_specs, args = [], []
     for raw, m, v in inputs:
-        in_specs.append(pl.BlockSpec((th, wp, raw.shape[-1]), idx_in,
+        in_specs.append(pl.BlockSpec((th, wp, raw.shape[-1]), lift(idx_in),
                                      memory_space=pltpu.VMEM))
         args.append(raw)
         for t in (m, v):
             if t is None:
                 continue
-            in_specs.append(pl.BlockSpec(t.shape, lambda i, s: (0, 0),
+            in_specs.append(pl.BlockSpec(t.shape, lift(lambda i, s: (0, 0)),
                                          memory_space=pltpu.VMEM))
             args.append(t)
 
     for t in (w, bias):
         in_specs.append(pl.BlockSpec(t.shape,
-                                     lambda i, s, nd=t.ndim: (0,) * nd,
+                                     lift(lambda i, s, nd=t.ndim: (0,) * nd),
                                      memory_space=pltpu.VMEM))
         args.append(t)
+
+    if quant:
+        assert kind == "raw1" and not stats and nwb == 1 and wp % 4 == 0
+        kernel = functools.partial(_pass_q8_kernel, kind=kind, th=th, nb=nb,
+                                   nwb=nwb, wp=wp, hh=hh, stats=stats)
+        outs = pl.pallas_call(
+            kernel,
+            grid=(2, nb + 1, nwb + 1),
+            in_specs=in_specs,
+            out_specs=(
+                # Phase-0 visits (amax only) park in the trash row-block
+                # alongside the usual i=0 / s=0 lag visits.
+                pl.BlockSpec(
+                    (th, wp // 4, ch_out),
+                    lambda p, i, s: (
+                        jnp.where((p == 0) | (i == 0) | (s == 0), nb, i - 1),
+                        jnp.where(s == 0, 0, s - 1), 0),
+                    memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, 1), lambda p, i, s: (0, 0),
+                             memory_space=pltpu.VMEM)),
+            out_shape=(
+                jax.ShapeDtypeStruct(((nb + 1) * th, wp_total // 4, ch_out),
+                                     jnp.float32),
+                jax.ShapeDtypeStruct((1, 1), jnp.float32)),
+            scratch_shapes=[
+                pltpu.VMEM((th + 2, wp_total + 16, ch_in), dtype),
+                pltpu.VMEM((nwb, th, wp, ch_out), dtype),
+                pltpu.VMEM((1, 1), jnp.float32)],
+            compiler_params=compiler_params(vmem_limit_bytes=_ENC_VMEM),
+            interpret=_interpret(),
+        )(*args)
+        return outs
 
     kernel = functools.partial(_pass_kernel, kind=kind, th=th, nb=nb,
                                nwb=nwb, wp=wp, hh=hh, stats=stats)
@@ -810,6 +977,26 @@ def head_conv_streamable(pc: dict, x) -> bool:
             and pc["w"].shape[:2] == (3, 3) and pc["w"].shape[2] == x.shape[-1])
 
 
+def _lane8_enabled() -> bool:
+    """``RAFT_LANE_PACK8`` read LOCALLY at trace time — this module
+    declares the ``_pass_q8_kernel``/``_point2_q8_kernel`` rung entry
+    points, so it must consult the kill switch itself (the breaker can
+    flip the env var and rebuild; same contract as ``_tail_enabled``)."""
+    return _os.environ.get("RAFT_LANE_PACK8", "0").strip().lower() in (
+        "1", "true", "yes", "on")
+
+
+def head_conv_q8_streamable(pc: dict, x) -> bool:
+    """Narrow-exit (r24) variant of :func:`head_conv_streamable`: the
+    in-register width-group pack needs the WHOLE row in one grid block
+    (single strip) and a quad-divisible width. Off unless
+    RAFT_LANE_PACK8 arms the lane — the epilogue changes the output
+    layout, not just the schedule, so it must never engage by default."""
+    return (_lane8_enabled() and head_conv_streamable(pc, x)
+            and _strip_cols(x.shape[2]) == x.shape[2]
+            and x.shape[2] % 4 == 0)
+
+
 def _stream_resblock_impl(p: dict, x: jax.Array, norm_fn: str) -> jax.Array:
     _, hh, width, ch = x.shape
     dtype = x.dtype
@@ -887,6 +1074,104 @@ def _hc_bwd(res, g):
 
 
 stream_head_conv.defvjp(_hc_fwd, _hc_bwd)
+
+
+def _stream_head_conv_q8_impl(pc: dict, x: jax.Array):
+    _, hh, width, ch = x.shape
+    wp = _strip_cols(width)
+    pk, scale = _run_pass("raw1", [(x[0], None, None)],
+                          pc["w"].astype(x.dtype),
+                          _bias_row(pc.get("b"), pc["w"].shape[-1]),
+                          hh, width, wp, x.dtype, False, quant=True)
+    return pk[:hh][None], scale.reshape(1, 1, 1, 1)
+
+
+@jax.custom_vjp
+def stream_head_conv_q8(pc: dict, x):
+    """Streamed 3x3 head conv with the r24 quantize-on-exit epilogue:
+    returns ``(container, scale)`` — a (1, H, W/4, C) fp32 width-group
+    int8 container plus its (1, 1, 1, 1) per-sample scale — and the bf16
+    head output never round-trips HBM. Bitwise identical to host-packing
+    the streamed bf16 output (quantize_pack_feature8 of stream_head_conv;
+    pinned in tests/test_lane_pack8.py). The container is an opaque bit
+    transport with zero cotangent, like every pack8 seam — and the packed
+    context path is inference-only, so the backward never actually runs."""
+    return _stream_head_conv_q8_impl(pc, x)
+
+
+def _hcq_fwd(pc, x):
+    return stream_head_conv_q8(pc, x), (pc, x)
+
+
+def _hcq_bwd(res, g):
+    pc, x = res
+    del g
+    return (jax.tree_util.tree_map(jnp.zeros_like, pc), jnp.zeros_like(x))
+
+
+stream_head_conv_q8.defvjp(_hcq_fwd, _hcq_bwd)
+
+
+def _stream_resblock_q8_impl(p: dict, x: jax.Array, norm_fn: str):
+    """:func:`_stream_resblock_impl` with the point2 exit emitting the
+    width-group container + scale directly (same single-strip gate as the
+    head conv; callers check :func:`resblock_q8_streamable`)."""
+    _, hh, width, ch = x.shape
+    dtype = x.dtype
+    instance = norm_fn == "instance"
+    if instance:
+        w1, b1 = p["conv1"]["w"], p["conv1"].get("b")
+        w2, b2 = p["conv2"]["w"], p["conv2"].get("b")
+    else:
+        w1, b1 = _fold_bn(p["conv1"], p["norm1"])
+        w2, b2 = _fold_bn(p["conv2"], p["norm2"])
+    wp = _strip_cols(width)
+    n = hh * width
+    x3 = x[0]
+
+    def mv(st):
+        return _stats_to_mv(st, n) if instance else _ident_mv(ch)
+
+    y1, st = _run_pass("raw1", [(x3, None, None)], w1.astype(dtype),
+                       _bias_row(b1, ch), hh, width, wp, dtype, instance)
+    m1, v1 = mv(st)
+    y2, st = _run_pass("mid1", [(y1, m1, v1)], w2.astype(dtype),
+                       _bias_row(b2, ch), hh, width, wp, dtype, instance)
+    m2, v2 = mv(st)
+    pk, scale = _run_pass("point2", [(x3, None, None), (y2, m2, v2)],
+                          None, None, hh, width, wp, dtype, False,
+                          norm=instance, quant=True)
+    return pk[None], scale.reshape(1, 1, 1, 1)
+
+
+def resblock_q8_streamable(p: dict, x, norm_fn: str) -> bool:
+    """Narrow-exit gate for :func:`stream_resblock_q8` — the resblock
+    gate plus the single-strip / quad-width geometry the in-register
+    pack needs, armed only under RAFT_LANE_PACK8."""
+    return (_lane8_enabled() and resblock_streamable(p, x, norm_fn)
+            and _strip_cols(x.shape[2]) == x.shape[2]
+            and x.shape[2] % 4 == 0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def stream_resblock_q8(norm_fn: str, p: dict, x):
+    """Streamed stride-1 residual block whose exit writes the r24
+    width-group container + per-sample scale instead of the bf16 tensor
+    (``_point2_q8_kernel``). Zero cotangent — bit-transport semantics."""
+    return _stream_resblock_q8_impl(p, x, norm_fn)
+
+
+def _rbq_fwd(norm_fn, p, x):
+    return stream_resblock_q8(norm_fn, p, x), (p, x)
+
+
+def _rbq_bwd(norm_fn, res, g):
+    p, x = res
+    del g
+    return (jax.tree_util.tree_map(jnp.zeros_like, p), jnp.zeros_like(x))
+
+
+stream_resblock_q8.defvjp(_rbq_fwd, _rbq_bwd)
 
 
 def _packed_cotangent(g: jax.Array) -> jax.Array:

@@ -48,8 +48,8 @@ from raft_stereo_tpu.corr.pallas_reg import (
     corr_coords_operand, gather_level_taps, make_batch_partitioned)
 from raft_stereo_tpu.ops.jax_compat import compiler_params
 from raft_stereo_tpu.ops.pallas_stream import (
-    _VMEM_LIMIT, _conv_rows, _dot, _dtype_ok, _interpret, _row_mask,
-    _shift, _zeros, flow_patches, gru_weights)
+    _VMEM_LIMIT, _conv_rows, _dot, _dtype_ok, _interpret, _lane8_rows,
+    _row_mask, _shift, _zeros, flow_patches, gru_weights)
 
 
 def fuse_iter_on() -> bool:
@@ -57,6 +57,15 @@ def fuse_iter_on() -> bool:
     registered in ENV_KNOBS so serving programs key on it."""
     return os.environ.get("RAFT_FUSE_ITER", "1").strip().lower() not in (
         "0", "false", "no", "off")
+
+
+def lane_pack8_on() -> bool:
+    """``RAFT_LANE_PACK8`` kill switch (default OFF). The resident stream
+    never packs on its own — prepare_gru_context_any decides — but the
+    switch is consulted here so a packed czrq arriving with the lane
+    disarmed fails loudly instead of silently serving stale quantization."""
+    return os.environ.get("RAFT_LANE_PACK8", "0").strip().lower() in (
+        "1", "true", "yes", "on")
 
 
 def resident_th(hh: int) -> int:
@@ -107,11 +116,15 @@ def _corr_rows(corr_ops, coords_blk, vol_refs, th: int, width: int, dtype):
 def _resident_kernel(coords_ref, flow_ref, pat_ref, h_ref, czrq_ref,
                      *rest, nops: int, nx2: int, th: int, nb: int,
                      width: int, ch: int, hh: int, c1: int,
-                     corr_static: dict, coffs):
+                     corr_static: dict, coffs, lane8: bool = False):
     """One grid step = corr+motion for row block ``i`` plus gru08+head for
     block ``i-1`` (the fused_gru1632 one-block-behind schedule)."""
-    vol_refs = rest[:nops]
-    k = nops
+    k = 0
+    if lane8:
+        czrq_scale_ref = rest[0]
+        k = 1
+    vol_refs = rest[k:k + nops]
+    k += nops
     x2_refs = rest[k:k + nx2]
     k += nx2
     (wc1_ref, wf1_ref, b1_ref, w2_ref, b2_ref, wf_ref, bf_ref,
@@ -199,7 +212,10 @@ def _resident_kernel(coords_ref, flow_ref, pat_ref, h_ref, czrq_ref,
             _zeros(scr_x, slice(2, 2 + th))
 
         acc_x = _conv_rows(scr_x, wx_ref, th, width)
-        acc_x = acc_x + czrq_ref[0].astype(jnp.float32)
+        if lane8:
+            acc_x = acc_x + _lane8_rows(czrq_ref, czrq_scale_ref, width)
+        else:
+            acc_x = acc_x + czrq_ref[0].astype(jnp.float32)
         acc_h = _conv_rows(scr_h[1:], whzr_ref, th, width)
         z_new = jax.nn.sigmoid(acc_h[..., :ch]
                                + acc_x[..., :ch]).astype(dtype)
@@ -229,6 +245,13 @@ def _resident_kernel(coords_ref, flow_ref, pat_ref, h_ref, czrq_ref,
                                                   f1.astype(dtype))
         dx = _conv_rows(scr_f1, w2h_ref, th, width)
         dx_ref[0] = dx[..., 0].astype(dx_ref.dtype)
+
+
+def _resident_lane8_kernel(*refs, **kw):
+    """Named alias of ``_resident_kernel`` with packed-czrq dequant
+    engaged (jaxpr-greppable engagement proof — the check_engagement
+    contract shared with ``_gru_lane8_kernel``)."""
+    _resident_kernel(*refs, lane8=True, **kw)
 
 
 def fused_iter_fwd_impl(p_enc: dict, p_gru: dict, head_p: dict,
@@ -279,8 +302,24 @@ def fused_iter_fwd_impl(p_enc: dict, p_gru: dict, head_p: dict,
         coffs.append(coffs[-1] + p.shape[-1])
     cx = coffs[-1]
 
+    # czrq is the bf16 rows or an (container, scale) pair under
+    # RAFT_LANE_PACK8 (prepare_gru_context_any) — the resident stream
+    # dequantizes in-register like the serial gru kernels.
+    lane8 = isinstance(czrq, tuple)
+    if lane8 and not lane_pack8_on():
+        raise RuntimeError(
+            "fused_iter_fwd_impl: packed czrq container with "
+            "RAFT_LANE_PACK8 disarmed — the kill switch must stay armed "
+            "for the lifetime of a packed state (flip it only between "
+            "prepare calls)")
+    if lane8:
+        czrq, czrq_s = czrq
+        czrq_s = czrq_s.reshape(b, 1).astype(jnp.float32)
+    wq = czrq.shape[2]
+
     # czrq rows must cover gru blocks j in [0, nb] (prepare_gru_context's
-    # lag-5 pad gives exactly (nb+1)*TH rows for TH > 5).
+    # lag-5 pad gives exactly (nb+1)*TH rows for TH > 5). Exact for the
+    # container too: pad rows are zero bytes on the symmetric grid.
     need = (nb + 1) * th
     if czrq.shape[1] < need:
         czrq = jnp.pad(czrq, ((0, 0), (0, need - czrq.shape[1]),
@@ -308,10 +347,12 @@ def fused_iter_fwd_impl(p_enc: dict, p_gru: dict, head_p: dict,
                      lambda bi, i: (0, bi, jnp.minimum(i, nb - 1), 0),
                      memory_space=pltpu.VMEM),
         pl.BlockSpec((1, th, width, ch), jblk4, memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, th, width, 3 * ch),
+        pl.BlockSpec((1, th, wq if lane8 else width, 3 * ch),
                      lambda bi, i: (bi, jnp.clip(i - 1, 0, nb), 0, 0),
                      memory_space=pltpu.VMEM),
-    ] + [pl.BlockSpec((1, pxb, v.shape[-1]), blk, memory_space=pltpu.VMEM)
+    ] + ([pl.BlockSpec((1, 1), lambda bi, i: (bi, 0),
+                       memory_space=pltpu.VMEM)] if lane8 else []) \
+      + [pl.BlockSpec((1, pxb, v.shape[-1]), blk, memory_space=pltpu.VMEM)
          for v in vol_ops] \
       + [pl.BlockSpec((1, th, width, p.shape[-1]), jblk4,
                       memory_space=pltpu.VMEM) for p in x2_list] \
@@ -348,12 +389,14 @@ def fused_iter_fwd_impl(p_enc: dict, p_gru: dict, head_p: dict,
                    "widths": tuple(corr_ops["widths"]),
                    "spec": tuple(corr_ops["spec"])}
     kernel = functools.partial(
-        _resident_kernel, nops=nops, nx2=len(x2_list), th=th, nb=nb,
+        _resident_lane8_kernel if lane8 else _resident_kernel,
+        nops=nops, nx2=len(x2_list), th=th, nb=nb,
         width=width, ch=ch, hh=hh, c1=c1,
         corr_static=corr_static, coffs=tuple(coffs))
-    inputs = [coords_aug, flow.astype(dtype), pat, h, czrq, *vol_ops,
-              *x2_list, wc1, wf1, b1, w2, b2, wf, bf, whzr, whq, wx_full,
-              w1h, b1h, w2h]
+    inputs = [coords_aug, flow.astype(dtype), pat, h, czrq] \
+        + ([czrq_s] if lane8 else []) \
+        + [*vol_ops, *x2_list, wc1, wf1, b1, w2, b2, wf, bf, whzr, whq,
+           wx_full, w1h, b1h, w2h]
 
     def call(*arrs):
         return pl.pallas_call(
@@ -371,8 +414,8 @@ def fused_iter_fwd_impl(p_enc: dict, p_gru: dict, head_p: dict,
 
     # Batch rides the outer grid dim; the tap-major patches carry batch
     # on axis 1 (the fused_motion partitioning rule).
-    axes_in = [0, 0, 1, 0, 0] + [0] * nops + [0] * len(x2_list) \
-        + [None] * 13
+    axes_in = [0, 0, 1, 0, 0] + ([0] if lane8 else []) + [0] * nops \
+        + [0] * len(x2_list) + [None] * 13
     call_p = make_batch_partitioned(
         call, axes_in, [a.ndim for a in inputs], [0, 0],
         [o.ndim for o in out_shape])

@@ -129,10 +129,28 @@ def _shift(ref, keep):
 # ---------------------------------------------------------------------------
 
 
+def _lane8_rows(pk_ref, scale_ref, width: int):
+    """Dequantize one (1, TH, Wq, C) width-group int8 container block
+    (corr/pallas_reg.py ``quantize_pack_feature8`` layout: byte b of lane
+    column j holds width position b*Wq + j) to (TH, width, C) fp32 rows
+    in-register: four sign-extending byte extracts concatenated on the
+    width (sublane) axis — no minor-dim reshape, Mosaic-friendly — then
+    one multiply by the per-sample scale riding a (1, 1) block."""
+    gi = jax.lax.bitcast_convert_type(pk_ref[0], jnp.int32)
+    parts = [(gi << 24) >> 24, (gi << 16) >> 24, (gi << 8) >> 24, gi >> 24]
+    q = jnp.concatenate(parts, axis=1)[:, :width]
+    return q.astype(jnp.float32) * scale_ref[0, 0]
+
+
 def _gru_kernel(h_ref, czrq_ref, *rest, np_: int, th: int, nb: int,
-                width: int, ch: int, head: bool, hh: int, coffs):
-    part_refs = rest[:np_]
-    k = np_
+                width: int, ch: int, head: bool, hh: int, coffs,
+                lane8: bool = False):
+    k = 0
+    if lane8:
+        czrq_scale_ref = rest[0]
+        k = 1
+    part_refs = rest[k:k + np_]
+    k += np_
     whzr_ref, whq_ref, wx_ref = rest[k:k + 3]
     k += 3
     if head:
@@ -178,7 +196,10 @@ def _gru_kernel(h_ref, czrq_ref, *rest, np_: int, th: int, nb: int,
     # ---- preact rows [i*TH-1, (i+1)*TH-1): all-gate x-side conv, z/r
     # h-side conv, nonlinearities (czrq arrives pre-shifted to these rows).
     acc_x = _conv_rows(scr_x, wx_ref, th, width)
-    acc_x = acc_x + czrq_ref[0].astype(jnp.float32)
+    if lane8:
+        acc_x = acc_x + _lane8_rows(czrq_ref, czrq_scale_ref, width)
+    else:
+        acc_x = acc_x + czrq_ref[0].astype(jnp.float32)
     acc_h = _conv_rows(scr_h[1:], whzr_ref, th, width)
 
     z_new = jax.nn.sigmoid(acc_h[..., :ch] + acc_x[..., :ch]).astype(dtype)
@@ -214,21 +235,40 @@ def _gru_kernel(h_ref, czrq_ref, *rest, np_: int, th: int, nb: int,
         dx_ref[0] = dx[..., 0].astype(dx_ref.dtype)
 
 
+def _gru_lane8_kernel(*refs, **kw):
+    """Named alias of ``_gru_kernel`` with the packed-czrq dequant engaged
+    — a distinct top-level name so jaxpr text proves RAFT_LANE_PACK8
+    engagement (scratch/check_engagement.py greps kernel names)."""
+    _gru_kernel(*refs, lane8=True, **kw)
+
+
 def _gru_pallas(h, parts, czrq, whzr, whq, wx_full, th: int, head):
     """Batch rides as the OUTER grid dimension: the row stream restarts
     (ring scratch re-zeroed at row step 0) for every sample, so training
     batches get the same fused scan body as B=1 eval (r3 fenced them to
     the XLA chain; reference analog: the CUDA sampler serving training
-    at batch 8, ``README.md:106``)."""
+    at batch 8, ``README.md:106``).
+
+    ``czrq`` is either the bf16 rows from ``prepare_gru_context`` or an
+    ``(container, scale)`` pair from ``prepare_gru_context_any`` under
+    RAFT_LANE_PACK8 — the container streams at half the bytes and the
+    kernel dequantizes in-register."""
     b, hh, width, ch = h.shape
     nb = hh // th
     lag = 5 if head else 3
     grid = pl.cdiv(hh + lag, th)
     np_ = len(parts)
+    lane8 = isinstance(czrq, tuple)
+    if lane8:
+        czrq_pk, czrq_scale = czrq
+        czrq_scale = czrq_scale.reshape(b, 1).astype(jnp.float32)
+    else:
+        czrq_pk, czrq_scale = czrq, None
     # czrq arrives pre-shifted/pre-padded from prepare_gru_context (hoisted
     # out of the scan — padding it here would re-run a 300 MB pass per
     # iteration).
-    assert czrq.shape[1] >= grid * th, (czrq.shape, grid, th)
+    assert czrq_pk.shape[1] >= grid * th, (czrq_pk.shape, grid, th)
+    wq = czrq_pk.shape[2]
 
     def idx_in(bi, i):
         return (bi, jnp.minimum(i, nb - 1), 0, 0)
@@ -236,13 +276,16 @@ def _gru_pallas(h, parts, czrq, whzr, whq, wx_full, th: int, head):
     coffs = [0]
     for p in parts:
         coffs.append(coffs[-1] + p.shape[-1])
-    kernel = functools.partial(_gru_kernel, np_=np_, th=th, nb=nb,
-                               width=width, ch=ch, head=head is not None,
-                               hh=hh, coffs=tuple(coffs))
+    kernel = functools.partial(
+        _gru_lane8_kernel if lane8 else _gru_kernel, np_=np_, th=th, nb=nb,
+        width=width, ch=ch, head=head is not None, hh=hh, coffs=tuple(coffs))
     in_specs = (
         [pl.BlockSpec((1, th, width, ch), idx_in, memory_space=pltpu.VMEM),
-         pl.BlockSpec((1, th, width, 3 * ch), lambda bi, i: (bi, i, 0, 0),
+         pl.BlockSpec((1, th, wq, 3 * ch) if lane8 else
+                      (1, th, width, 3 * ch), lambda bi, i: (bi, i, 0, 0),
                       memory_space=pltpu.VMEM)] +
+        ([pl.BlockSpec((1, 1), lambda bi, i: (bi, 0),
+                       memory_space=pltpu.VMEM)] if lane8 else []) +
         [pl.BlockSpec((1, th, width, p.shape[-1]), idx_in,
                       memory_space=pltpu.VMEM) for p in parts] +
         [pl.BlockSpec(w.shape, lambda bi, i, nd=w.ndim: (0,) * nd,
@@ -257,7 +300,8 @@ def _gru_pallas(h, parts, czrq, whzr, whq, wx_full, th: int, head):
                pltpu.VMEM((th + 2, width, ch), h.dtype),         # z ring
                pltpu.VMEM((th + 2, width, ch), jnp.float32),     # aq_x ring
                pltpu.VMEM((th + 2, width + 2, coffs[-1]), h.dtype)]  # x parts
-    inputs = [h, czrq, *parts, whzr, whq, wx_full]
+    inputs = [h, czrq_pk] + ([czrq_scale] if lane8 else []) \
+        + [*parts, whzr, whq, wx_full]
     if head is not None:
         w1, b1, w2 = head
         in_specs += [pl.BlockSpec(w1.shape, lambda bi, i: (0,) * 4,
@@ -297,7 +341,8 @@ def _gru_pallas(h, parts, czrq, whzr, whq, wx_full, th: int, head):
     # per-shard — the partitioning rule that lets fused training ride a
     # multi-chip data mesh (weights replicate).
     from raft_stereo_tpu.corr.pallas_reg import make_batch_partitioned
-    axes_in = [0, 0] + [0] * np_ + [None] * (len(inputs) - 2 - np_)
+    lead = [0, 0, 0] if lane8 else [0, 0]
+    axes_in = lead + [0] * np_ + [None] * (len(inputs) - len(lead) - np_)
     call_p = make_batch_partitioned(
         call, axes_in, [a.ndim for a in inputs],
         [0] * len(out_shape), [o.ndim for o in out_shape])
@@ -336,6 +381,56 @@ def prepare_gru_context(p: dict, context, dtype):
         return czrq
     rows = pl.cdiv(hh + 5, th) * th  # widest lag (head variant) = 5
     return jnp.pad(czrq, ((0, 0), (1, rows - hh - 1), (0, 0), (0, 0)))
+
+
+def lane_pack8_on() -> bool:
+    """Local RAFT_LANE_PACK8 consult for this module's packed-czrq kernel
+    variants (the breaker/lint contract: a module declaring a rung's entry
+    points reads that rung's switch itself — GL006). Same parse as
+    corr/pallas_reg.py's ``lane_pack8``."""
+    import os
+    return os.environ.get("RAFT_LANE_PACK8", "0").strip().lower() in (
+        "1", "true", "yes", "on")
+
+
+def prepare_gru_context_any(p: dict, context, dtype):
+    """``prepare_gru_context`` plus the r24 narrow-lane option: under
+    RAFT_LANE_PACK8 the loop-invariant czrq rows are quantized ONCE per
+    frame into a width-group int8 container (corr/pallas_reg.py seam) and
+    returned as an ``(container, scale)`` pair the fused kernels stream at
+    half the per-iteration HBM bytes, dequantizing in-register. The row
+    zero-padding above survives packing bit-exactly (symmetric grid: pad
+    rows quantize to zero bytes), and the scale is per-SAMPLE so batched
+    rows stay independent."""
+    czrq = prepare_gru_context(p, context, dtype)
+    if not lane_pack8_on():
+        return czrq
+    from raft_stereo_tpu.corr.pallas_reg import (feature_scale8,
+                                                 quantize_pack_feature8)
+    scale = feature_scale8(czrq)
+    return quantize_pack_feature8(czrq, scale), scale
+
+
+def plan_lane_dma_bytes(h: int, w: int, *, n_levels: int = 3, ch: int = 128,
+                        factor: int = 4, pack8: bool) -> float:
+    """Per-ITERATION HBM bytes the GRU scan body's czrq context streams
+    declare via their BlockSpecs, summed over the ``n_levels`` pyramid
+    scales (level i runs at 1/(factor * 2**i) resolution with 3*ch gate
+    channels). The analytic half of the r24 lane ledger: grid revisit /
+    flush factors are identical between the bf16 and container paths
+    (same TH, same index maps), so they cancel in the ratio and exact
+    per-row arithmetic suffices — computable at any geometry without a
+    compile. pack8 rows stream ``ceil(w/4)`` fp32 container lanes plus
+    one (1, 1) fp32 scale block instead of ``w`` bf16 lanes."""
+    total = 0.0
+    for i in range(n_levels):
+        f = factor << i
+        hh_i, w_i = -(-h // f), -(-w // f)
+        if pack8:
+            total += hh_i * float(-(-w_i // 4)) * 3 * ch * 4 + 4.0
+        else:
+            total += hh_i * float(w_i) * 3 * ch * 2
+    return total
 
 
 def fused_conv_gru_fwd_impl(p: dict, h, czrq, *x_list, head_p=None):
@@ -388,7 +483,10 @@ def _fused_gru_bwd(res, g):
     out, vjp = jax.vjp(lambda *a: _gru_oracle(a[0], a[1], a[2], *a[3:]),
                        p, h, context, *x_list)
     dp, dh, dctx, *dxs = vjp(g.astype(out.dtype))
-    return (dp, dh, jnp.zeros_like(czrq), dctx, *dxs)
+    # tree_map: czrq may be the bare bf16 rows or the r24 (container,
+    # scale) pair — both zero-cotangent (STE through ``context``).
+    return (dp, dh, jax.tree_util.tree_map(jnp.zeros_like, czrq),
+            dctx, *dxs)
 
 
 fused_conv_gru.defvjp(_fused_gru_fwd, _fused_gru_bwd)
@@ -429,7 +527,8 @@ def _fused_gru_head_bwd(res, g):
     gh, gdx = g
     dp, dhead, dh, dctx, *dxs = vjp((gh.astype(h2.dtype),
                                      gdx.astype(jnp.float32)))
-    return (dp, dhead, dh, jnp.zeros_like(czrq), dctx, *dxs)
+    return (dp, dhead, dh, jax.tree_util.tree_map(jnp.zeros_like, czrq),
+            dctx, *dxs)
 
 
 fused_gru_head.defvjp(_fused_gru_head_fwd, _fused_gru_head_bwd)
@@ -584,14 +683,19 @@ def _upsample_weights(h32: int, h16: int, th16: int, dtype=jnp.bfloat16):
     return jnp.asarray(wh).astype(dtype)
 
 
-def _gru1632_kernel(h16_ref, h32_ref, czrq16_ref, czrq32_ref, x0_ref, x1_ref,
-                    whzr16_ref, whq16_ref, wx16_ref,
-                    whzr32_ref, whq32_ref, wx32_ref,
-                    mw_ref, wh_ref, out16_ref, out32_ref,
-                    s32_h, s32_rh, s32_z, s32_aqx, s32_x, s_up,
-                    s16_h, s16_rh, s16_z, s16_aqx, s16_x, *,
+def _gru1632_kernel(h16_ref, h32_ref, czrq16_ref, czrq32_ref, *rest,
                     th16: int, nb16: int, w16: int, w32: int,
-                    c16: int, c32: int, cx0: int):
+                    c16: int, c32: int, cx0: int, lane8: bool = False):
+    k = 0
+    if lane8:
+        czrq16_s_ref, czrq32_s_ref = rest[:2]
+        k = 2
+    (x0_ref, x1_ref,
+     whzr16_ref, whq16_ref, wx16_ref,
+     whzr32_ref, whq32_ref, wx32_ref,
+     mw_ref, wh_ref, out16_ref, out32_ref,
+     s32_h, s32_rh, s32_z, s32_aqx, s32_x, s_up,
+     s16_h, s16_rh, s16_z, s16_aqx, s16_x) = rest[k:]
     th32 = th16 // 2
     win = s_up.shape[0]
     i = pl.program_id(1)  # row step; program_id(0) is the batch sample
@@ -618,7 +722,10 @@ def _gru1632_kernel(h16_ref, h32_ref, czrq16_ref, czrq32_ref, x0_ref, x1_ref,
         _zeros(s32_x, slice(2, 2 + th32))
 
     acc_x = _conv_rows(s32_x, wx32_ref, th32, w32)
-    acc_x = acc_x + czrq32_ref[0].astype(jnp.float32)
+    if lane8:
+        acc_x = acc_x + _lane8_rows(czrq32_ref, czrq32_s_ref, w32)
+    else:
+        acc_x = acc_x + czrq32_ref[0].astype(jnp.float32)
     acc_h = _conv_rows(s32_h[1:], whzr32_ref, th32, w32)
     z_new = jax.nn.sigmoid(acc_h[..., :c32] + acc_x[..., :c32]).astype(dtype)
     r_new = jax.nn.sigmoid(acc_h[..., c32:]
@@ -681,7 +788,10 @@ def _gru1632_kernel(h16_ref, h32_ref, czrq16_ref, czrq32_ref, x0_ref, x1_ref,
             _zeros(s16_x, slice(2, 2 + th16))
 
         acc_x16 = _conv_rows(s16_x, wx16_ref, th16, w16)
-        acc_x16 = acc_x16 + czrq16_ref[0].astype(jnp.float32)
+        if lane8:
+            acc_x16 = acc_x16 + _lane8_rows(czrq16_ref, czrq16_s_ref, w16)
+        else:
+            acc_x16 = acc_x16 + czrq16_ref[0].astype(jnp.float32)
         acc_h16 = _conv_rows(s16_h[1:], whzr16_ref, th16, w16)
         z16n = jax.nn.sigmoid(acc_h16[..., :c16]
                               + acc_x16[..., :c16]).astype(dtype)
@@ -699,6 +809,12 @@ def _gru1632_kernel(h16_ref, h32_ref, czrq16_ref, czrq32_ref, x0_ref, x1_ref,
         q16 = jnp.tanh(acc_q16).astype(dtype)
         z16 = s16_z[0:th16]
         out16_ref[0] = ((1 - z16) * s16_h[0:th16, 1:w16 + 1] + z16 * q16)
+
+
+def _gru1632_lane8_kernel(*refs, **kw):
+    """Named alias of ``_gru1632_kernel`` with packed-czrq dequant engaged
+    (jaxpr-greppable engagement proof, like ``_gru_lane8_kernel``)."""
+    _gru1632_kernel(*refs, lane8=True, **kw)
 
 
 def gru1632_is_fusable(h16, h32, *, any_batch: bool = False) -> bool:
@@ -747,10 +863,19 @@ def fused_gru1632_fwd_impl(p16: dict, p32: dict, h16, h32, czrq16, czrq32,
     mw = _lerp_matrix(w32, w16, dtype)  # (w16, w32), the XLA W matrix
     wh = _upsample_weights(hh32, hh16, th16, dtype)
 
+    lane8 = isinstance(czrq16, tuple)
+    if lane8:
+        czrq16, s16 = czrq16
+        czrq32, s32 = czrq32
+        s16 = s16.reshape(b, 1).astype(jnp.float32)
+        s32 = s32.reshape(b, 1).astype(jnp.float32)
+    wq16, wq32 = czrq16.shape[2], czrq32.shape[2]
+
     # czrq rows must cover every block index the schedule touches
     # (prepare_gru_context padded for the SERIAL kernels' geometry, whose
     # row block may differ); re-pad here is loop-invariant — XLA hoists
-    # it out of the scan.
+    # it out of the scan. Exact for containers too: pad rows are zero
+    # bytes on the symmetric int8 grid.
     def pad_rows(czrq, rows):
         return (jnp.pad(czrq, ((0, 0), (0, rows - czrq.shape[1]),
                                (0, 0), (0, 0)))
@@ -769,12 +894,14 @@ def fused_gru1632_fwd_impl(p16: dict, p32: dict, h16, h32, czrq16, czrq32,
         pl.BlockSpec((1, th32, w32, c32),
                      lambda bi, i: (bi, jnp.minimum(i, nb16 - 1), 0, 0),
                      memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, th16, w16, 3 * c16),
+        pl.BlockSpec((1, th16, wq16 if lane8 else w16, 3 * c16),
                      lambda bi, i: (bi, jnp.clip(i - 1, 0, nb16), 0, 0),
                      memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, th32, w32, 3 * c32),
+        pl.BlockSpec((1, th32, wq32 if lane8 else w32, 3 * c32),
                      lambda bi, i: (bi, jnp.minimum(i, grid - 1), 0, 0),
                      memory_space=pltpu.VMEM),
+    ] + ([pl.BlockSpec((1, 1), lambda bi, i: (bi, 0),
+                       memory_space=pltpu.VMEM)] * 2 if lane8 else []) + [
         pl.BlockSpec((1, th16, w16, cx0),
                      lambda bi, i: (bi, i16c(i), 0, 0),
                      memory_space=pltpu.VMEM),
@@ -811,10 +938,11 @@ def fused_gru1632_fwd_impl(p16: dict, p32: dict, h16, h32, czrq16, czrq32,
         pltpu.VMEM((th16 + 2, w16, c16), jnp.float32),    # gru16 aq_x
         pltpu.VMEM((th16 + 2, w16 + 2, cx0 + c32), dtype)]  # gru16 x
     kernel = functools.partial(
-        _gru1632_kernel, th16=th16, nb16=nb16, w16=w16, w32=w32,
+        _gru1632_lane8_kernel if lane8 else _gru1632_kernel,
+        th16=th16, nb16=nb16, w16=w16, w32=w32,
         c16=c16, c32=c32, cx0=cx0)
-    inputs = [h16, h32, czrq16, czrq32, x0p, x1p,
-              whzr16, whq16, wx16, whzr32, whq32, wx32, mw, wh]
+    inputs = [h16, h32, czrq16, czrq32] + ([s16, s32] if lane8 else []) \
+        + [x0p, x1p, whzr16, whq16, wx16, whzr32, whq32, wx32, mw, wh]
 
     def call(*arrs):
         return pl.pallas_call(
@@ -832,8 +960,8 @@ def fused_gru1632_fwd_impl(p16: dict, p32: dict, h16, h32, czrq16, czrq32,
 
     from raft_stereo_tpu.corr.pallas_reg import make_batch_partitioned
     call_p = make_batch_partitioned(
-        call, [0] * 6 + [None] * 8, [a.ndim for a in inputs],
-        [0, 0], [4, 4])
+        call, [0] * (8 if lane8 else 6) + [None] * 8,
+        [a.ndim for a in inputs], [0, 0], [4, 4])
     o16, o32 = call_p(*inputs)
     return o16[:, 3:3 + hh16], o32[:, 3:3 + hh32]
 
@@ -872,8 +1000,10 @@ def _fused_gru1632_bwd(res, g):
     g16, g32 = g
     dp16, dp32, dh16, dh32, dctx16, dctx32, dx0, dx1 = vjp(
         (g16.astype(h16n.dtype), g32.astype(h32n.dtype)))
-    return (dp16, dp32, dh16, dh32, jnp.zeros_like(czrq16),
-            jnp.zeros_like(czrq32), dctx16, dctx32, dx0, dx1)
+    return (dp16, dp32, dh16, dh32,
+            jax.tree_util.tree_map(jnp.zeros_like, czrq16),
+            jax.tree_util.tree_map(jnp.zeros_like, czrq32),
+            dctx16, dctx32, dx0, dx1)
 
 
 fused_gru1632.defvjp(_fused_gru1632_fwd, _fused_gru1632_bwd)

@@ -93,6 +93,17 @@ DEFAULT_LADDER: Tuple[FastPath, ...] = (
         matchers=("fuse_iter", "resident"),
     ),
     FastPath(
+        # Must precede corr_pack8: classify() walks the ladder in order
+        # and corr_pack8's "pack8" matcher is a substring of every
+        # "lane_pack8" failure message.
+        name="lane_pack8",
+        description="int8 quad-packed context containers for the "
+                    "per-iteration feature/context lanes "
+                    "(ops/pallas_stream.py RAFT_LANE_PACK8)",
+        env_var="RAFT_LANE_PACK8",
+        matchers=("lane_pack8", "lane8", "czrq8"),
+    ),
+    FastPath(
         name="corr_pack8",
         description="int8 quad-packed correlation containers "
                     "(corr/pallas_reg.py RAFT_CORR_PACK8)",

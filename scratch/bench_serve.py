@@ -436,6 +436,17 @@ def main() -> None:
             not in ("0", "false", "no", "off", ""):
         loopback = run_loopback(max_batch)
 
+    # r24 context-lane ledger at the serve bucket (exact arithmetic, no
+    # compile — the analytic half; check_engagement.py gates the <= 0.6
+    # bound at this geometry and headline).
+    from raft_stereo_tpu.ops.pallas_stream import plan_lane_dma_bytes
+    lane_bf16 = plan_lane_dma_bytes(h, w, pack8=False)
+    lane_int8 = plan_lane_dma_bytes(h, w, pack8=True)
+    lane_dma_doc = {"h": h, "w": w,
+                    "bf16_bytes_per_iter": lane_bf16,
+                    "int8_bytes_per_iter": lane_int8,
+                    "int8_over_bf16": round(lane_int8 / lane_bf16, 4)}
+
     doc = {
         "metric": (f"serve_requests_per_s_{h}x{w}_i{iters}_{corr}"
                    f"_b{max_batch}{'_tiny' if tiny else ''}"),
@@ -460,6 +471,11 @@ def main() -> None:
         # r19: per-kind compiler attribution off the batched session.
         "ledger_kinds": bat.get("ledger_kinds"),
         "ledger_attribution": bat.get("ledger_attribution"),
+        # r24: per-iteration context-lane bytes at THIS serve geometry,
+        # bf16 vs int8 containers (exact BlockSpec arithmetic —
+        # ops/pallas_stream.plan_lane_dma_bytes; <= 0.6 gated by
+        # check_engagement.py).
+        "lane_dma": lane_dma_doc,
         "backend": jax.default_backend(),
     }
     if loopback is not None:
@@ -491,7 +507,10 @@ def main() -> None:
                 # graftresident (r19): per-kind flops/bytes/MFU rows, so
                 # the measured-first ordering is in the trajectory.
                 "ledger_kinds": doc["ledger_kinds"],
-                "ledger_attribution": doc["ledger_attribution"]})
+                "ledger_attribution": doc["ledger_attribution"],
+                # graftlane (r24): context-lane bytes ride the same
+                # trajectory entry as unpinned diagnostics.
+                "lane_dma": lane_dma_doc})
     if loopback is not None:
         emit(doc["metric"].replace("serve_requests_per_s",
                                    "serve_loopback_requests_per_s"),

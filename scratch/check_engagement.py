@@ -1,5 +1,5 @@
-"""r19 engagement asserts: each new fast path PROVABLY engages — and its
-kill switch provably disengages it — at both acceptance geometries.
+"""r19/r24 engagement asserts: each new fast path PROVABLY engages — and
+its kill switch provably disengages it — at both acceptance geometries.
 
 The PR 2 "provably engages" ceremony, extended: instead of predicate
 checks alone, this traces the REAL serving programs (``build_program`` —
@@ -16,11 +16,22 @@ resident path is killed). Tracing executes nothing — CPU-safe, the
 graftverify precedent — and a jaxpr either contains a pallas_call to the
 named kernel or it does not: no heuristics.
 
-Also asserts the r19 acceptance ratio analytically: the int8 quad-packed
-correlation containers' per-iteration DMA at headline geometry must be
-<= 0.6x the bf16 pair-packed layout's (corr/pallas_reg.plan_dma_bytes —
-exact BlockSpec arithmetic; the driver's on-chip run corroborates with
-the advance rows' compiler bytes_est).
+r24 adds the narrow-lane kernel set (``_resident_lane8_kernel``,
+``_gru1632_lane8_kernel``, ``_gru_lane8_kernel`` under the RAFT_FUSE_ITER=0
+fallback, and the encoder-exit ``_pass_q8_kernel``/``_point2_q8_kernel``):
+each is asserted present by name in the armed (RAFT_LANE_PACK8=1) traces
+and absent from every default trace — the kill switch provably disengages
+the whole lane.
+
+Also asserts the acceptance ratios analytically: the int8 quad-packed
+correlation containers' per-iteration DMA must be <= 0.6x the bf16
+pair-packed layout's (corr/pallas_reg.plan_dma_bytes — exact BlockSpec
+arithmetic; the driver's on-chip run corroborates with the advance rows'
+compiler bytes_est), at headline AND serve-batch geometry (the r19 script
+only checked headline — the serve bucket's shallower pyramid shifts the
+level mix, so the bound is re-proved where batched serving actually
+runs). The r24 context-lane ratio (ops/pallas_stream.plan_lane_dma_bytes)
+gets the same <= 0.6 bound at both geometries.
 
 Prints one JSON line; exit 1 on any failed check.
 """
@@ -59,11 +70,17 @@ def main() -> int:
             jax.random.PRNGKey(0))
 
     @functools.lru_cache(maxsize=None)
-    def state_spec(b: int, h: int, w: int):
+    def _state_spec(b: int, h: int, w: int, lane8: str):
         prep = build_program("prepare", cfg, 0)
         img = jax.ShapeDtypeStruct((b, h, w, 3), jnp.float32)
         (state,) = jax.eval_shape(prep, params_spec(), img, img)
         return state
+
+    def state_spec(b: int, h: int, w: int):
+        # Called inside the trace's env window: the carry structure
+        # depends on RAFT_LANE_PACK8 (r24 packed context containers ride
+        # the state pytree), so the cache re-keys on the live switch.
+        return _state_spec(b, h, w, os.environ.get("RAFT_LANE_PACK8", ""))
 
     @functools.lru_cache(maxsize=None)
     def advance_text(b: int, h: int, w: int, env_items) -> str:
@@ -71,6 +88,15 @@ def main() -> int:
         with _env_overrides(env):
             fn = build_program("advance", cfg, 8)
             jaxpr = jax.make_jaxpr(fn)(params_spec(), state_spec(b, h, w))
+        return str(jaxpr)
+
+    @functools.lru_cache(maxsize=None)
+    def prepare_text(b: int, h: int, w: int, env_items) -> str:
+        env = resolve_env(dict(env_items), base_env)
+        with _env_overrides(env):
+            fn = build_program("prepare", cfg, 0)
+            img = jax.ShapeDtypeStruct((b, h, w, 3), jnp.float32)
+            jaxpr = jax.make_jaxpr(fn)(params_spec(), img, img)
         return str(jaxpr)
 
     checks = {}
@@ -92,10 +118,57 @@ def main() -> int:
     check("pack8_changes_headline_program", t_p8 != t)
     check("pack8_resident_still_engaged", "_resident_kernel" in t_p8)
 
+    # -- r24 narrow lanes at headline b=1 ---------------------------------
+    LANE8_KERNELS = ("_resident_lane8_kernel", "_gru1632_lane8_kernel",
+                     "_gru_lane8_kernel", "_pass_q8_kernel",
+                     "_point2_q8_kernel")
+    armed = (("RAFT_LANE_PACK8", "1"),)
+    t_l8 = advance_text(1, 2016, 2976, armed)
+    check("lane8_headline_resident_lane8_engages",
+          "_resident_lane8_kernel" in t_l8)
+    check("lane8_headline_gru1632_lane8_engages",
+          "_gru1632_lane8_kernel" in t_l8)
+    t_l8_nofuse = advance_text(1, 2016, 2976,
+                               armed + (("RAFT_FUSE_ITER", "0"),))
+    check("lane8_fuse_iter_off_gru_lane8_engages",
+          "_gru_lane8_kernel" in t_l8_nofuse
+          and "_resident_lane8_kernel" not in t_l8_nofuse)
+    tp_l8 = prepare_text(1, 2016, 2976, armed)
+    check("lane8_headline_prepare_pass_q8_engages", "_pass_q8_kernel" in tp_l8)
+
+    # The optional resblock narrow exit (_point2_q8_kernel) is a library
+    # entry point (not yet wired into a serving program kind) — prove the
+    # kernel by name from its own public seam, armed vs disarmed.
+    from raft_stereo_tpu.models.layers import init_residual_block
+    from raft_stereo_tpu.ops.pallas_encoder import (stream_resblock,
+                                                    stream_resblock_q8)
+    rb_p = jax.eval_shape(functools.partial(
+        init_residual_block, in_planes=128, planes=128, norm_fn="instance",
+        stride=1), jax.random.PRNGKey(0))
+    rb_x = jax.ShapeDtypeStruct((1, 64, 128, 128), jnp.bfloat16)
+    with _env_overrides(resolve_env(dict(armed), base_env)):
+        t_rbq = str(jax.make_jaxpr(functools.partial(
+            stream_resblock_q8, "instance"))(rb_p, rb_x))
+    check("lane8_resblock_point2_q8_engages", "_point2_q8_kernel" in t_rbq)
+    with _env_overrides(dict(base_env)):
+        t_rb = str(jax.make_jaxpr(functools.partial(
+            stream_resblock, "instance"))(rb_p, rb_x))
+    check("lane8_off_resblock_has_no_q8", "q8" not in t_rb)
+
+    # Kill switch: every default (RAFT_LANE_PACK8 unset) trace in this
+    # battery must be free of ALL lane8 kernels.
+    for name, text in (("headline_advance", t), ("fuse_iter_off", t_off),
+                       ("corr_pack8_only", t_p8),
+                       ("headline_prepare", prepare_text(1, 2016, 2976, ()))):
+        check(f"lane8_off_disengages_{name}",
+              not any(k in text for k in LANE8_KERNELS))
+
     # -- serve-batch bucket b=4/8 -----------------------------------------
     for b in (4, 8):
         tb = advance_text(b, 384, 1248, ())
         check(f"serve_b{b}_resident_engages", "_resident_kernel" in tb)
+        check(f"serve_b{b}_lane8_off_disengaged",
+              not any(k in tb for k in LANE8_KERNELS))
         tb_off = advance_text(b, 384, 1248, (("RAFT_STREAM_BATCH", "0"),))
         check(f"serve_b{b}_stream_batch_off_runs_xla_twins",
               "_resident_kernel" not in tb_off
@@ -103,22 +176,43 @@ def main() -> int:
               and "_gru1632_kernel" not in tb_off)
         check(f"serve_b{b}_corr_kernel_stays_engaged_when_off",
               "_lookup_kernel" in tb_off)
+        tb_l8 = advance_text(b, 384, 1248, armed)
+        check(f"serve_b{b}_lane8_resident_lane8_engages",
+              "_resident_lane8_kernel" in tb_l8)
 
-    # -- int8 correlation DMA ratio at headline (analytic, exact) ---------
+    # -- int8 correlation DMA ratio (analytic, exact) ---------------------
+    # r24 bugfix: the r19 script asserted the bound at headline only;
+    # batched serving runs the serve bucket, whose shallower pyramid
+    # changes the level mix — prove the bound at both.
     factor = cfg.downsample_factor
-    widths = level_widths(2976 // factor, cfg.corr_levels)
-    bf16_px = plan_dma_bytes(widths, True, False)
-    int8_px = plan_dma_bytes(widths, True, True)
-    ratio = int8_px / bf16_px
-    check("headline_int8_corr_dma_ratio_le_0.6", ratio <= 0.6)
+    corr = {}
+    for gname, w_img in (("headline", 2976), ("serve", 1248)):
+        widths = level_widths(w_img // factor, cfg.corr_levels)
+        bf16_px = plan_dma_bytes(widths, True, False)
+        int8_px = plan_dma_bytes(widths, True, True)
+        ratio = int8_px / bf16_px
+        check(f"{gname}_int8_corr_dma_ratio_le_0.6", ratio <= 0.6)
+        corr[gname] = {"bf16": bf16_px, "int8": int8_px,
+                       "ratio": round(ratio, 4)}
+
+    # -- r24 context-lane DMA ratio (analytic, exact) ---------------------
+    from raft_stereo_tpu.ops.pallas_stream import plan_lane_dma_bytes
+    lane = {}
+    for gname, (h_img, w_img) in (("headline", (2016, 2976)),
+                                  ("serve", (384, 1248))):
+        bf16_b = plan_lane_dma_bytes(h_img, w_img, pack8=False)
+        int8_b = plan_lane_dma_bytes(h_img, w_img, pack8=True)
+        ratio = int8_b / bf16_b
+        check(f"{gname}_lane_dma_ratio_le_0.6", ratio <= 0.6)
+        lane[gname] = {"bf16": bf16_b, "int8": int8_b,
+                       "ratio": round(ratio, 4)}
 
     ok = all(checks.values())
     print(json.dumps({
         "ok": ok,
         "checks": checks,
-        "corr_dma_ratio_headline": round(ratio, 4),
-        "corr_dma_bf16_bytes_per_px": bf16_px,
-        "corr_dma_int8_bytes_per_px": int8_px,
+        "corr_dma": corr,
+        "lane_dma": lane,
     }))
     return 0 if ok else 1
 

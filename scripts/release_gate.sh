@@ -200,19 +200,40 @@ env JAX_PLATFORMS=cpu python -m pytest tests/test_fused_stream.py \
     -p no:cacheprovider -p no:xdist -p no:randomly \
     || { echo "FAIL: resident-iteration battery"; fail=1; }
 
-# r19 engagement asserts (the PR 2 "provably engages" ceremony,
-# extended): trace the REAL serving advance program to a jaxpr at
-# headline (2016x2976 b=1) AND the serve-batch bucket (b=4/8) and assert
-# each new kernel is present by name — plus its kill switch provably
-# disengaging it — and the int8 correlation DMA ratio <= 0.6x bf16 at
-# headline (exact BlockSpec arithmetic; CPU-safe, nothing executes).
-step "r19 engagement asserts (resident/pack8/stream-batch at both geometries)"
+# r19/r24 engagement asserts (the PR 2 "provably engages" ceremony,
+# extended): trace the REAL serving programs to a jaxpr at headline
+# (2016x2976 b=1) AND the serve-batch bucket (b=4/8) and assert each new
+# kernel is present by name — plus its kill switch provably disengaging
+# it — and the int8 correlation AND context-lane DMA ratios <= 0.6x bf16
+# at BOTH geometries (exact BlockSpec arithmetic; CPU-safe, nothing
+# executes).
+step "r19/r24 engagement asserts (resident/pack8/lane8 at both geometries)"
 if env JAX_PLATFORMS=cpu python scratch/check_engagement.py > engagement.json; then
     cat engagement.json
 else
     echo "--- engagement.json ---"; cat engagement.json
-    echo "FAIL: r19 engagement asserts"; fail=1
+    echo "FAIL: r19/r24 engagement asserts"; fail=1
 fi
+
+# graftlane battery (ISSUE 20, DESIGN.md r24): the packed-context pins —
+# container error budget (<= scale/2), batched-rows scale independence,
+# the lane-math geometry battery, STE gradient bitwiseness, armed
+# forward == prepare+segments bitwise, the encoder-exit q8 epilogue's
+# bitwise-to-host-pack contract, and RAFT_LANE_PACK8=0 byte-identity.
+step "narrow-lane battery (graftlane: packed context containers)"
+env JAX_PLATFORMS=cpu python -m pytest tests/test_lane_pack8.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly \
+    || { echo "FAIL: narrow-lane battery"; fail=1; }
+
+# graftlane serve battery (ISSUE 20 satellite): the FULL serve battery
+# once more from the DOUBLE-ARMED base (both pack opt-ins on) — the
+# breaker ladder, canary and fault storm must hold in the operational
+# state the r24 rung exists to degrade from, not only at defaults.
+step "serving fault storm from the double-armed base (corr+lane pack8)"
+env JAX_PLATFORMS=cpu RAFT_CORR_PACK8=1 RAFT_LANE_PACK8=1 \
+    python -m pytest tests/test_serve.py -q -m serve \
+    -p no:cacheprovider -p no:xdist -p no:randomly \
+    || { echo "FAIL: double-armed serving fault storm"; fail=1; }
 
 # graftfleet battery (ISSUE 16, DESIGN.md r20): the fleet supervisor
 # lifecycle against stub instances (tests/fleet_stub.py speaks the

@@ -93,6 +93,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "count=8)")
     parser.add_argument('--max_pixels', type=int, default=8 << 20,
                         help="admission cap on per-image area")
+    # graftlane (r24) + r19 pack opt-ins: CLI sugar over the env kill
+    # switches. setdefault semantics — an EXPLICIT RAFT_*_PACK8 env value
+    # (including 0) wins over the flag, so an operator's kill-switch
+    # export is never silently re-armed by a stale launch script.
+    parser.add_argument('--pack8', action='store_true',
+                        help="arm the int8 quad-packed correlation "
+                        "containers (RAFT_CORR_PACK8=1 unless that env "
+                        "var is already set)")
+    parser.add_argument('--lane_pack8', action='store_true',
+                        help="arm the int8 packed context lanes for "
+                        "per-iteration feature/context traffic "
+                        "(RAFT_LANE_PACK8=1 unless that env var is "
+                        "already set)")
     parser.add_argument('--warmup', default=None,
                         help="comma-separated HxW image shapes to "
                         "pre-compile, e.g. '544x960,736x1280'")
@@ -287,6 +300,15 @@ def serve(args) -> int:
         raise SystemExit("batch mode needs -l/--left_imgs and "
                          "-r/--right_imgs (or serve the network with "
                          "--http_port)")
+
+    # Pack opt-ins must land before ANY program trace (the switches are
+    # read at trace time); explicit env always wins over the flag.
+    if args.pack8 or args.lane_pack8:
+        import os
+        if args.pack8:
+            os.environ.setdefault("RAFT_CORR_PACK8", "1")
+        if args.lane_pack8:
+            os.environ.setdefault("RAFT_LANE_PACK8", "1")
 
     import jax
     import numpy as np

@@ -975,7 +975,7 @@ def test_real_tree_checks_are_not_vacuous():
     files = collect_files([str(PACKAGE)], base=str(REPO))
     proj = Project(files)
     ladder = proj.ladder()
-    assert ladder is not None and len(ladder) == 9
+    assert ladder is not None and len(ladder) == 10
     assert {r.name for r in ladder} >= {"corr_kernel", "fused_update"}
     fields = proj.config_fields()
     assert fields is not None and "corr_implementation" in fields

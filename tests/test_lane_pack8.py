@@ -1,0 +1,378 @@
+"""graftlane battery (r24): int8 packed containers for the per-iteration
+feature/context lanes (RAFT_LANE_PACK8).
+
+Pins, mirroring the r19 corr-pack8 discipline (tests/test_corr.py):
+
+- container error budget: dequant may differ from the source rows by at
+  most ``scale/2`` (symmetric scheme, scale = per-sample amax/127), and
+  zero pad rows survive packing as EXACT zeros;
+- per-SAMPLE scales: batched rows quantize independently of their
+  batchmates (the r19 review-round regression class);
+- the lane ledger's exact arithmetic (plan_lane_dma_bytes) and the
+  <= 0.6x acceptance ratio across geometries, odd widths included;
+- the lane8 kernels' in-register dequant matches the host dequant at f32
+  to within FMA fusion of the ``q * scale`` multiply (a few ULPs, never
+  a quantization-sized error), for both the serial GRU kernel and the
+  resident mega-kernel;
+- STE gradients: the container is zero-cotangent and the XLA-oracle
+  backward reads ``context`` — so grads are BITWISE identical packed vs
+  unpacked;
+- the encoder-exit q8 epilogue (stream_head_conv_q8 / stream_resblock_q8)
+  is bitwise identical to host-packing the streamed bf16 output;
+- end-to-end: the armed forward == prepare + segments bitwise (containers
+  ride the carry), prepare_warm consumes packed containers bit-identically
+  to the cold prepare, and RAFT_LANE_PACK8 unset vs "0" is byte-for-byte
+  the same program output with a container-free carry.
+
+The end-to-end ERROR budget is op-level by design: like corr_pack8, the
+deployment-weights protection is the serving parity canary (the lane_pack8
+rung trips when drift leaves the band), not a random-weights bound — at
+random init the GRU amplifies quantization noise chaotically (measured
+~3.5 px at the canary geometry for the LANDED corr_pack8 rung too).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_stereo_tpu.config import RAFTStereoConfig
+from raft_stereo_tpu.corr.pallas_reg import (feature_scale8,
+                                             quantize_pack_feature8,
+                                             unpack_feature8)
+from raft_stereo_tpu.models import (init_raft_stereo, raft_stereo_forward,
+                                    raft_stereo_prepare, raft_stereo_segment)
+from raft_stereo_tpu.models.update import init_conv_gru
+from raft_stereo_tpu.ops.pallas_stream import (fused_conv_gru,
+                                               fused_conv_gru_fwd_impl,
+                                               plan_lane_dma_bytes,
+                                               prepare_gru_context,
+                                               prepare_gru_context_any)
+
+pytestmark = pytest.mark.kernel_battery
+
+
+# ---------------------------------------------------------------------------
+# Container: error budget, zero rows, batched independence, lane math.
+
+
+@pytest.mark.parametrize("w", [40, 37, 78, 186])
+def test_lane_container_error_budget_pinned(rng, w):
+    """Dequant error <= scale/2 per sample at quad and non-quad widths,
+    and rows that are exactly zero stay EXACTLY zero (symmetric grid:
+    q == 0 <-> 0.0 — the padding contract prepare_gru_context relies on),
+    with the (B, H, ceil(W/4), C) fp32 container layout pinned."""
+    x = jnp.asarray(rng.standard_normal((2, 12, w, 16)), jnp.float32)
+    x = x.at[:, -3:].set(0.0)  # zero pad rows
+    scale = feature_scale8(x)
+    pk = quantize_pack_feature8(x, scale)
+    assert pk.shape == (2, 12, -(-w // 4), 16) and pk.dtype == jnp.float32
+    assert scale.shape == (2, 1, 1, 1)
+    got = unpack_feature8(pk, scale, w)
+    err = np.asarray(jnp.max(jnp.abs(got - x), axis=(1, 2, 3)))
+    bound = 0.5 * np.asarray(scale).reshape(-1)
+    assert (err <= bound * (1 + 1e-4)).all(), (err, bound)
+    assert float(jnp.max(jnp.abs(got[:, -3:]))) == 0.0
+
+
+def test_lane_container_batched_rows_independent(rng):
+    """Per-sample scales: one high-contrast batchmate must not move
+    another sample's quantization grid — sample i's container bytes and
+    scale are BITWISE equal to the solo B=1 pack of the same rows."""
+    x = jnp.asarray(rng.standard_normal((2, 8, 40, 16)), jnp.float32)
+    x = x.at[1].multiply(23.0)  # outlier batchmate
+    scale = feature_scale8(x)
+    pk = quantize_pack_feature8(x, scale)
+    for i in range(2):
+        solo_scale = feature_scale8(x[i:i + 1])
+        solo_pk = quantize_pack_feature8(x[i:i + 1], solo_scale)
+        assert np.asarray(scale[i:i + 1]).tobytes() == \
+            np.asarray(solo_scale).tobytes(), f"row {i}"
+        assert np.asarray(pk[i:i + 1]).tobytes() == \
+            np.asarray(solo_pk).tobytes(), f"row {i}"
+
+
+def test_plan_lane_dma_ratio_battery():
+    """The lane ledger's exact arithmetic: bf16 rows stream h*w*3*ch*2
+    bytes per level, containers h*ceil(w/4)*3*ch*4 bytes plus one (1,1)
+    f32 scale — and the acceptance ratio <= 0.6 holds at headline, the
+    serve bucket, odd widths and shallow pyramids alike."""
+    # Exact spot check at headline (1/4-res 504x744, three levels).
+    bf16 = plan_lane_dma_bytes(2016, 2976, pack8=False)
+    int8 = plan_lane_dma_bytes(2016, 2976, pack8=True)
+    assert bf16 == sum((-(-504 // 2 ** i)) * (-(-744 // 2 ** i)) * 3 * 128 * 2
+                       for i in range(3))
+    assert int8 == sum((-(-504 // 2 ** i)) * (-(-(-(-744 // 2 ** i)) // 4))
+                       * 3 * 128 * 4 + 4 for i in range(3))
+    for h_img, w_img, kw in [(2016, 2976, {}), (384, 1248, {}),
+                             (200, 316, {}), (40, 60, {"n_levels": 2}),
+                             (377, 1111, {}), (64, 96, {"ch": 32})]:
+        r = (plan_lane_dma_bytes(h_img, w_img, pack8=True, **kw)
+             / plan_lane_dma_bytes(h_img, w_img, pack8=False, **kw))
+        assert r <= 0.6, (h_img, w_img, kw, r)
+
+
+# ---------------------------------------------------------------------------
+# Kernels: in-register dequant parity + STE gradients.
+
+
+def _gru_case(key, h_, w_, ch, dtype):
+    p = init_conv_gru(key, ch, 2 * ch)
+    ks = jax.random.split(key, 6)
+    h = jax.random.normal(ks[0], (1, h_, w_, ch), dtype) * 0.5
+    xs = [jax.random.normal(k, (1, h_, w_, ch), dtype) for k in ks[1:3]]
+    ctx = tuple(jax.random.normal(k, (1, h_, w_, ch), dtype) * 0.3
+                for k in ks[3:6])
+    return p, h, xs, ctx
+
+
+def test_lane_gru_kernel_matches_host_dequant_to_fma_ulps(monkeypatch):
+    """The lane8 GRU kernel's in-register dequant (_lane8_rows: four
+    sign-extending byte extracts, one f32 multiply by the per-sample
+    scale) matches feeding the host-dequantized rows to the bf16-path
+    kernel to within FMA fusion: the ONLY divergence is that XLA may fuse
+    ``q * scale`` into the accumulating add (product never rounded to
+    f32), so the budget is a few ULPs of the tanh-bounded hidden state —
+    NOT a quantization-sized error (that would be ~scale/2 ≈ 5e-3)."""
+    dtype = jnp.float32
+    w_ = 24
+    p, h, xs, ctx = _gru_case(jax.random.PRNGKey(0), 16, w_, 32, dtype)
+    monkeypatch.setenv("RAFT_LANE_PACK8", "1")
+    packed = prepare_gru_context_any(p, ctx, dtype)
+    assert isinstance(packed, tuple)
+    pk, scale = packed
+    rows = unpack_feature8(pk, scale, w_).astype(dtype)
+    got, _ = fused_conv_gru_fwd_impl(p, h, packed, *xs)
+    ref, _ = fused_conv_gru_fwd_impl(p, h, rows, *xs)
+    err = float(jnp.max(jnp.abs(got - ref)))
+    assert err <= 1e-6, err  # measured 2.4e-7 (1-2 ULPs)
+
+
+def _resident_case(key, B, hh, ww, ch, d, dtype, levels=4, radius=4):
+    from raft_stereo_tpu.corr.pallas_reg import build_corr_operands
+    from raft_stereo_tpu.models.update import (init_flow_head,
+                                               init_motion_encoder)
+    cfg = RAFTStereoConfig(corr_levels=levels, corr_radius=radius)
+    ks = jax.random.split(key, 12)
+    f1 = jax.random.normal(ks[0], (B, hh, ww, d), dtype)
+    f2 = jax.random.normal(ks[1], (B, hh, ww, d), dtype)
+    ops = build_corr_operands(f1, f2, num_levels=levels, radius=radius,
+                              out_dtype=dtype)
+    coords_x = jax.random.uniform(ks[2], (B, hh, ww), jnp.float32) * ww
+    flow = jnp.concatenate(
+        [jax.random.normal(ks[3], (B, hh, ww, 1), dtype),
+         jnp.zeros((B, hh, ww, 1), dtype)], -1)
+    penc = init_motion_encoder(ks[4], cfg)
+    pgru = init_conv_gru(ks[5], ch, 128 + ch)
+    phead = init_flow_head(ks[6], ch, 64, 2)
+    h = jax.random.normal(ks[7], (B, hh, ww, ch), dtype) * 0.5
+    up = jax.random.normal(ks[8], (B, hh, ww, ch), dtype)
+    ctx = tuple(jax.random.normal(k, (B, hh, ww, ch), dtype) * 0.3
+                for k in ks[9:12])
+    return ops, coords_x, flow, penc, pgru, phead, h, up, ctx
+
+
+def test_lane_resident_kernel_matches_host_dequant_to_fma_ulps(monkeypatch):
+    """Same FMA-ULP pin for the resident mega-kernel (its czrq dequant
+    shares _lane8_rows with the serial kernels) — and the loud rejection
+    of a packed czrq arriving with the switch disarmed (stale
+    quantization must never serve silently)."""
+    from raft_stereo_tpu.ops.pallas_resident import fused_iter_fwd_impl
+    dtype = jnp.float32
+    ww = 24
+    (ops, coords_x, flow, penc, pgru, phead, h, up,
+     ctx) = _resident_case(jax.random.PRNGKey(3), 1, 16, ww, 32, 16, dtype)
+    monkeypatch.setenv("RAFT_LANE_PACK8", "1")
+    packed = prepare_gru_context_any(pgru, ctx, dtype)
+    assert isinstance(packed, tuple)
+    pk, scale = packed
+    rows = unpack_feature8(pk, scale, ww).astype(dtype)
+    h_got, dx_got = fused_iter_fwd_impl(penc, pgru, phead, ops, h, packed,
+                                        coords_x, flow, up)
+    h_ref, dx_ref = fused_iter_fwd_impl(penc, pgru, phead, ops, h, rows,
+                                        coords_x, flow, up)
+    assert float(jnp.max(jnp.abs(h_got - h_ref))) <= 1e-6   # measured 1.8e-7
+    assert float(jnp.max(jnp.abs(dx_got - dx_ref))) <= 1e-5  # measured 1.4e-6
+    # Kill-switch lifetime contract: a packed state outliving the armed
+    # window fails LOUDLY instead of dequantizing stale bytes.
+    monkeypatch.delenv("RAFT_LANE_PACK8")
+    with pytest.raises(RuntimeError, match="RAFT_LANE_PACK8"):
+        fused_iter_fwd_impl(penc, pgru, phead, ops, h, packed,
+                            coords_x, flow, up)
+
+
+def test_lane_ste_grads_bitwise(monkeypatch):
+    """The czrq operand — rows or (container, scale) pair — carries ZERO
+    cotangent; the oracle backward reads ``context``. So grads wrt
+    (params, h, context, x) are BITWISE identical packed vs unpacked."""
+    dtype = jnp.float32
+    p, h, xs, ctx = _gru_case(jax.random.PRNGKey(1), 16, 24, 32, dtype)
+    rows = prepare_gru_context(p, ctx, dtype)
+    monkeypatch.setenv("RAFT_LANE_PACK8", "1")
+    packed = prepare_gru_context_any(p, ctx, dtype)
+    assert isinstance(packed, tuple)
+
+    def loss(p_, czrq, h_, ctx_, xs_):
+        return jnp.sum(fused_conv_gru(p_, h_, czrq, ctx_, *xs_)
+                       .astype(jnp.float32))
+
+    g_rows = jax.grad(loss, argnums=(0, 2, 3, 4))(p, rows, h, ctx, xs)
+    g_pack = jax.grad(loss, argnums=(0, 2, 3, 4))(p, packed, h, ctx, xs)
+    for a, b in zip(jax.tree_util.tree_leaves(g_rows),
+                    jax.tree_util.tree_leaves(g_pack)):
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+    # And the container itself is zero-cotangent.
+    g_czrq = jax.grad(loss, argnums=1)(p, packed, h, ctx, xs)
+    assert all(float(jnp.max(jnp.abs(leaf))) == 0.0
+               for leaf in jax.tree_util.tree_leaves(g_czrq))
+
+
+# ---------------------------------------------------------------------------
+# Encoder exit: the q8 epilogue's bitwise-to-host-pack contract.
+
+
+def test_encoder_q8_epilogue_bitwise_vs_host_pack(monkeypatch):
+    """stream_head_conv_q8 / stream_resblock_q8 write the container +
+    scale DIRECTLY from the streaming pass — bitwise identical to
+    host-packing the streamed bf16 output (the epilogue quantizes the
+    bf16-rounded rows with the same amax scale arithmetic as
+    quantize_pack_feature8), with zero cotangent, and the q8 gates refuse
+    whenever the lane is disarmed."""
+    from raft_stereo_tpu.models.layers import init_conv, init_residual_block
+    from raft_stereo_tpu.ops.pallas_encoder import (
+        head_conv_q8_streamable, resblock_q8_streamable, stream_head_conv,
+        stream_head_conv_q8, stream_resblock, stream_resblock_q8)
+    monkeypatch.setenv("RAFT_LANE_PACK8", "1")
+    x = jax.random.normal(jax.random.PRNGKey(0), (1, 16, 64, 96),
+                          jnp.bfloat16)
+    pc = init_conv(jax.random.PRNGKey(1), 3, 3, 96, 96)
+    assert head_conv_q8_streamable(pc, x)
+    pk, scale = stream_head_conv_q8(pc, x)
+    ref = stream_head_conv(pc, x)
+    ref_scale = feature_scale8(ref)
+    assert np.asarray(scale).tobytes() == np.asarray(ref_scale).tobytes()
+    assert np.asarray(pk).tobytes() == \
+        np.asarray(quantize_pack_feature8(ref, ref_scale)).tobytes()
+
+    xr = jax.random.normal(jax.random.PRNGKey(2), (1, 16, 128, 32),
+                           jnp.bfloat16)
+    pr = init_residual_block(jax.random.PRNGKey(3), 32, 32, "instance",
+                             stride=1)
+    assert resblock_q8_streamable(pr, xr, "instance")
+    pk_r, scale_r = stream_resblock_q8("instance", pr, xr)
+    ref_r = stream_resblock("instance", pr, xr)
+    rs = feature_scale8(ref_r)
+    assert np.asarray(scale_r).tobytes() == np.asarray(rs).tobytes()
+    assert np.asarray(pk_r).tobytes() == \
+        np.asarray(quantize_pack_feature8(ref_r, rs)).tobytes()
+    # Zero cotangent (bit-transport semantics).
+    g = jax.grad(lambda x_: jnp.sum(stream_head_conv_q8(pc, x_)[0]
+                                    .astype(jnp.float32)))(x)
+    assert float(jnp.max(jnp.abs(g.astype(jnp.float32)))) == 0.0
+    # Disarmed, the q8 gates must refuse — layout changes never engage
+    # by default.
+    monkeypatch.setenv("RAFT_LANE_PACK8", "0")
+    assert not head_conv_q8_streamable(pc, x)
+    assert not resblock_q8_streamable(pr, xr, "instance")
+
+
+# ---------------------------------------------------------------------------
+# End to end: the armed model path and its kill switch.
+
+
+def _e2e_case(seed=0, hw=(64, 96)):
+    cfg = RAFTStereoConfig(corr_implementation="reg_tpu",
+                           mixed_precision=True)
+    params = init_raft_stereo(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(seed)
+    i1 = jnp.asarray(rng.uniform(0, 255, (1, *hw, 3)), jnp.float32)
+    i2 = jnp.asarray(rng.uniform(0, 255, (1, *hw, 3)), jnp.float32)
+    return cfg, params, i1, i2
+
+
+def _packed_keys(state):
+    """Carry keys holding lane containers ({"pk", "scale"} dicts)."""
+    def has_pk(v):
+        if isinstance(v, dict):
+            return "pk" in v
+        if isinstance(v, (list, tuple)):
+            return any(has_pk(leaf) for leaf in v)
+        return False
+    return sorted(k for k, v in state.items() if has_pk(v))
+
+
+def test_lane_armed_forward_equals_prepare_segments(monkeypatch):
+    """Armed: one 4-iter forward == prepare + 2x 2-iter segments, bit for
+    bit — the packed containers ride the carry and the segments consume
+    them through the same producers the forward fake-quantized through
+    (the anytime invariant every serving mode stands on)."""
+    monkeypatch.setenv("RAFT_LANE_PACK8", "1")
+    cfg, params, i1, i2 = _e2e_case()
+    low_ref, up_ref = raft_stereo_forward(params, cfg, i1, i2, iters=4,
+                                          test_mode=True)
+    state = raft_stereo_prepare(params, cfg, i1, i2)
+    assert _packed_keys(state) == ["fmap1", "fmap2", "inp"]
+    for _ in range(2):
+        state, low, up = raft_stereo_segment(params, cfg, state, iters=2)
+    assert np.asarray(up).tobytes() == np.asarray(up_ref).tobytes()
+    assert np.asarray(low).tobytes() == np.asarray(low_ref).tobytes()
+
+
+def test_lane_prepare_warm_consumes_packed_bitwise(monkeypatch):
+    """Armed warm start: prepare_warm with zero flow is bitwise the cold
+    prepare (packed container leaves INCLUDED), and the warm advance
+    chain consumes the packed carry bit-identically to the cold chain."""
+    from raft_stereo_tpu.serve.session import build_program
+    monkeypatch.setenv("RAFT_LANE_PACK8", "1")
+    cfg, params, i1, i2 = _e2e_case(seed=7)
+    f = cfg.downsample_factor
+    zeros = jnp.zeros((1, i1.shape[1] // f, i1.shape[2] // f, 1),
+                      jnp.float32)
+    (cold,) = build_program("prepare", cfg, 0)(params, i1, i2)
+    (warm,) = build_program("prepare_warm", cfg, 0)(params, i1, i2, zeros)
+    flat_c, tree_c = jax.tree_util.tree_flatten(cold)
+    flat_w, tree_w = jax.tree_util.tree_flatten(warm)
+    assert tree_c == tree_w
+    for a, b in zip(flat_c, flat_w):
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+    adv = build_program("advance", cfg, 2)
+    sc, _, _ = adv(params, cold)
+    sw, _, _ = adv(params, warm)
+    for a, b in zip(jax.tree_util.tree_leaves(sc),
+                    jax.tree_util.tree_leaves(sw)):
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+
+
+def test_lane_default_off_byte_identity(monkeypatch):
+    """RAFT_LANE_PACK8 unset and "0" are the SAME program: byte-identical
+    outputs and a container-free carry (the kill-switch contract the
+    breaker's lane_pack8 rung disengages through)."""
+    cfg, params, i1, i2 = _e2e_case(seed=3)
+    monkeypatch.delenv("RAFT_LANE_PACK8", raising=False)
+    low_a, up_a = raft_stereo_forward(params, cfg, i1, i2, iters=2,
+                                      test_mode=True)
+    state = raft_stereo_prepare(params, cfg, i1, i2)
+    assert _packed_keys(state) == []
+    monkeypatch.setenv("RAFT_LANE_PACK8", "0")
+    low_b, up_b = raft_stereo_forward(params, cfg, i1, i2, iters=2,
+                                      test_mode=True)
+    assert np.asarray(up_a).tobytes() == np.asarray(up_b).tobytes()
+    assert np.asarray(low_a).tobytes() == np.asarray(low_b).tobytes()
+
+
+def test_lane_train_mode_untouched(monkeypatch):
+    """The packed context path is INFERENCE-ONLY by construction
+    (``pack_ctx = test_mode and ...``): the training forward is bitwise
+    unchanged by the switch — quantization never perturbs the train loss
+    surface or its gradients."""
+    cfg, params, i1, i2 = _e2e_case(seed=5, hw=(32, 64))
+    monkeypatch.delenv("RAFT_LANE_PACK8", raising=False)
+    preds_off = raft_stereo_forward(params, cfg, i1, i2, iters=2,
+                                    test_mode=False)
+    monkeypatch.setenv("RAFT_LANE_PACK8", "1")
+    preds_on = raft_stereo_forward(params, cfg, i1, i2, iters=2,
+                                   test_mode=False)
+    for a, b in zip(jax.tree_util.tree_leaves(preds_off),
+                    jax.tree_util.tree_leaves(preds_on)):
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes()

@@ -147,7 +147,7 @@ def test_clean_tree_small_geometry_resolves_all_entries():
 
 def test_headline_registry_structure():
     """Registry vacuity guard: the headline registry must carry the full
-    ladder walk (9 rungs + untripped) and one flip probe per registered
+    ladder walk (10 rungs + untripped) and one flip probe per registered
     env knob — a knob added to ENV_KNOBS without a probe surfaces as a
     GV102 finding rather than silent shrinkage, and this pins the
     expected counts so the extraction itself can't rot."""
@@ -156,7 +156,7 @@ def test_headline_registry_structure():
     assert {"serve/full", "serve/prepare", "serve/prepare_warm",
             "serve/segment", "serve/advance",
             "serve/epilogue", "eval/forward", "train/step"} <= names
-    assert len(reg.ladder_variants) == 10  # untripped + 9 rungs
+    assert len(reg.ladder_variants) == 11  # untripped + 10 rungs
     from raft_stereo_tpu.serve.guard import DEFAULT_LADDER
     assert [label for label, _ in reg.ladder_variants[1:]] == \
         [p.name for p in DEFAULT_LADDER]
@@ -169,7 +169,7 @@ def test_headline_registry_structure():
 
 
 def test_headline_ladder_pairwise_non_vacuous():
-    """The acceptance proof, in-process: all nine breaker rungs produce
+    """The acceptance proof, in-process: all ten breaker rungs produce
     pairwise-different programs at headline geometry (the full CLI run
     additionally proves the knob side; release_gate.sh runs it)."""
     reg = default_registry("headline")
@@ -178,7 +178,7 @@ def test_headline_ladder_pairwise_non_vacuous():
                             knob_flips=[])
     rep = run_trace_analysis(trimmed, checkers=[LadderVacuityChecker()])
     assert rep.findings == [], "\n".join(f.render() for f in rep.findings)
-    assert rep.entries_traced == 10
+    assert rep.entries_traced == 11
 
 
 def test_scrubbed_text_is_deterministic():
